@@ -1,0 +1,31 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference mounted at /root/reference).
+
+Architecture (TPU-first, not a port):
+- programs are serializable IR descs (paddle_tpu.core.ir) built by a fluid-
+  compatible Python API (paddle_tpu.fluid);
+- execution is trace-once → XLA-compile → run-many (paddle_tpu.core.lowering)
+  instead of the reference's per-op interpreter;
+- autodiff derives every op's backward from jax.vjp over its emitter;
+- data/model parallelism is jax.sharding over a device Mesh with XLA
+  collectives on ICI (paddle_tpu.parallel), replacing ParallelExecutor+NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu import fluid  # noqa: F401
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: python/paddle/__init__.py exposes paddle.batch
+    (reader/decorator.py batch)."""
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader

@@ -1,0 +1,20 @@
+"""paddle_tpu.core — IR, registry, lowering, executor, scope.
+
+The TPU-native replacement for the reference's paddle/fluid/framework +
+platform + memory layers: programs are serializable descs (ir.py), lowered
+whole-block to XLA (lowering.py), executed through compiled-executable
+caches (executor.py) against a Scope of PJRT-backed arrays (scope.py).
+"""
+
+from paddle_tpu.core.ir import BlockDesc, OpDesc, ProgramDesc, VarDesc, VarType
+from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor, Place,
+                                      TPUPlace)
+from paddle_tpu.core.registry import OPS, register_op
+
+__all__ = [
+    "BlockDesc", "OpDesc", "ProgramDesc", "VarDesc", "VarType",
+    "Scope", "global_scope",
+    "CPUPlace", "CUDAPlace", "Executor", "Place", "TPUPlace",
+    "OPS", "register_op",
+]

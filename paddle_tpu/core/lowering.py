@@ -1,0 +1,169 @@
+"""Block lowering: ProgramDesc block → one pure JAX function → XLA.
+
+This replaces the reference's entire interpreter stack: where
+`Executor::RunPreparedContext` loops `op->Run(scope, place)` per step with
+per-call kernel dispatch and runtime InferShape
+(reference: framework/executor.cc:413-456, operator.cc:912-966), we walk the
+block ONCE at trace time, emitting each op's JAX computation into a single
+function that XLA compiles and fuses. Parameters are threaded functionally
+(state-in/state-out) with buffer donation so optimizer updates stay in-place
+in HBM — the functional equivalent of the reference's mutable Scope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu.core import ir
+from paddle_tpu.core.registry import EmitContext, get_op
+
+# ensure all builtin emitters are registered on import
+import paddle_tpu.ops  # noqa: F401
+
+
+@dataclass(frozen=True)
+class BlockSignature:
+    """Static analysis of a block: which names are feeds, which come from the
+    scope (split into mutated state vs read-only consts), which are fetched."""
+
+    feed_names: Tuple[str, ...]
+    fetch_names: Tuple[str, ...]
+    state_names: Tuple[str, ...]       # scope vars read and/or (re)written
+    const_names: Tuple[str, ...]       # scope vars only read
+    created_persistable: Tuple[str, ...]  # persistables first created here
+
+
+def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
+                  fetch_names: Sequence[str]) -> BlockSignature:
+    defined = set(feed_names)
+    from_scope: List[str] = []
+    written: set = set()
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        for name in op.input_names():
+            if name not in defined and name not in from_scope:
+                from_scope.append(name)
+        for name in op.output_names():
+            defined.add(name)
+            written.add(name)
+
+    def is_persistable(n: str) -> bool:
+        return block.has_var(n) and block.var(n).persistable
+
+    state, const, created = [], [], []
+    for n in from_scope:
+        if n in written and is_persistable(n):
+            state.append(n)
+        else:
+            const.append(n)
+    for n in written:
+        if is_persistable(n) and n not in from_scope:
+            created.append(n)
+
+    # fetches not produced by the block must come from the scope
+    for n in fetch_names:
+        if n not in defined and n not in from_scope and n not in const:
+            const.append(n)
+
+    return BlockSignature(
+        feed_names=tuple(feed_names),
+        fetch_names=tuple(fetch_names),
+        state_names=tuple(state),
+        const_names=tuple(const),
+        created_persistable=tuple(sorted(created)),
+    )
+
+
+def build_block_fn(program: ir.ProgramDesc, block_idx: int,
+                   sig: BlockSignature, is_test: bool = False):
+    """Returns fn(state: dict, consts: dict, feeds: dict, step_seed) ->
+    (fetches: list, new_state: dict). Pure — safe to jit/pjit/shard_map."""
+
+    block = program.block(block_idx)
+    seed0 = program.random_seed
+
+    def fn(state: Dict[str, Any], consts: Dict[str, Any],
+           feeds: Dict[str, Any], step_seed):
+        env: Dict[str, Any] = {}
+        env.update(consts)
+        env.update(state)
+        env.update(feeds)
+        base_key = jax.random.fold_in(jax.random.key(seed0), step_seed)
+        for i, op in enumerate(block.ops):
+            if op.type in ("feed", "fetch"):
+                continue
+            spec = get_op(op.type)
+            ctx = EmitContext(base_key=base_key, op_index=i, is_test=is_test)
+            ins = {}
+            for slot, names in op.inputs.items():
+                try:
+                    ins[slot] = [env[n] for n in names]
+                except KeyError as e:
+                    raise KeyError(
+                        f"op {op.type!r} input {slot} references undefined var "
+                        f"{e.args[0]!r}; did you run the startup program?") from e
+            outs = spec.emit(ctx, ins, op.attrs)
+            for slot, names in op.outputs.items():
+                vals = outs.get(slot)
+                if vals is None:
+                    continue
+                for n, v in zip(names, vals):
+                    env[n] = v
+        fetches = [env[n] for n in sig.fetch_names]
+        new_state = {n: env[n] for n in sig.state_names if n in env}
+        for n in sig.created_persistable:
+            if n in env:
+                new_state[n] = env[n]
+        return fetches, new_state
+
+    return fn
+
+
+class CompiledBlock:
+    """A compiled executable for (program block, feed/fetch signature) —
+    the analogue of the reference's per-program executor cache
+    (reference: executor.py:222 _get_program_cache_key / use_program_cache),
+    except the cached object is an XLA executable, not a list of op objects."""
+
+    def __init__(self, program: ir.ProgramDesc, block_idx: int,
+                 feed_names: Sequence[str], fetch_names: Sequence[str],
+                 is_test: bool = False, donate: bool = True):
+        block = program.block(block_idx)
+        self.sig = analyze_block(block, feed_names, fetch_names)
+        self.block = block
+        fn = build_block_fn(program, block_idx, self.sig, is_test=is_test)
+        # donate the mutated-state dict: optimizer updates reuse the same HBM
+        # buffers (reference keeps params in-place in the Scope; we get the
+        # same via XLA input_output_aliasing)
+        self.fn = jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
+
+    def feed_dtype(self, name: str) -> Optional[str]:
+        if self.block.has_var(name):
+            return self.block.var(name).dtype
+        return None
+
+    def __call__(self, scope, feeds: Dict[str, Any], step_seed: int):
+        state = {}
+        for n in self.sig.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} not initialized in scope — run the "
+                    f"startup program first (reference: two-program "
+                    f"convention, framework.py default_startup_program)")
+            state[n] = v
+        consts = {}
+        for n in self.sig.const_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(f"variable {n!r} not found in scope")
+            consts[n] = v
+        fetches, new_state = self.fn(state, consts, feeds, np.uint32(step_seed))
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        return fetches

@@ -1,0 +1,105 @@
+"""Operator registry: the TPU-native replacement for the reference's kernel
+registry + dispatch machinery (reference: paddle/fluid/framework/op_registry.h:197
+REGISTER_OPERATOR, operator.cc:912 OperatorWithKernel::RunImpl).
+
+Where the reference registers per-(place, dtype, layout) kernel functors and
+dispatches at every step, we register one *emitter* per op: a pure function
+that receives traced JAX values and returns traced JAX values. The whole
+block's emitters are traced once and fused/compiled by XLA — there is no
+per-op dispatch at run time, and dtype/layout specialization is XLA's job.
+
+Emitter signature::
+
+    def emit(ctx: EmitContext, ins: Dict[slot, List[Array]], attrs: Dict) \
+            -> Dict[slot, List[Array]]
+
+following the reference's multi-slot input/output convention
+(e.g. ins["X"][0], returns {"Out": [y]}).
+
+Grad ops are not registered per-op: reverse-mode rules come from `jax.vjp`
+over the forward emitter (see paddle_tpu.core.backward), replacing the
+reference's hand-written GradOpDescMaker classes
+(reference: framework/grad_op_desc_maker.h).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+@dataclass
+class EmitContext:
+    """Per-op emission context.
+
+    rng keys are derived deterministically from (program seed, step seed,
+    op index) so that a re-emission of the same op (e.g. inside a vjp
+    recompute) sees the same randomness — the functional replacement for the
+    reference's per-op `seed` attributes (e.g. dropout_op.cc attr "seed").
+    """
+
+    base_key: Any  # jax PRNG key for this program execution
+    op_index: int = 0
+    is_test: bool = False
+    # set during multi-device lowering: the mesh and the data-parallel axis
+    mesh: Any = None
+    data_axis: Optional[str] = None
+
+    def key(self, salt: int = 0):
+        return jax.random.fold_in(jax.random.fold_in(self.base_key, self.op_index), salt)
+
+
+@dataclass
+class OpSpec:
+    type: str
+    emit: Callable
+    # ops excluded from autodiff (optimizer updates, metrics, rng state...)
+    no_grad: bool = False
+    # flat input indices (slot order) that can never carry gradient
+    # (integer ids, labels); autodiff skips them without tracing
+    nondiff_inputs: tuple = ()
+    # docstring-level reference citation
+    ref: str = ""
+
+
+OPS: Dict[str, OpSpec] = {}
+
+
+def register_op(op_type: str, *, no_grad: bool = False, ref: str = ""):
+    """Register an emitter for `op_type` (capability parity with
+    REGISTER_OPERATOR / REGISTER_OP_CUDA_KERNEL, op_registry.h:197,237)."""
+
+    def deco(fn: Callable) -> Callable:
+        if op_type in OPS:
+            raise ValueError(f"op {op_type!r} registered twice")
+        OPS[op_type] = OpSpec(type=op_type, emit=fn, no_grad=no_grad, ref=ref)
+        return fn
+
+    return deco
+
+
+def get_op(op_type: str) -> OpSpec:
+    spec = OPS.get(op_type)
+    if spec is None:
+        raise KeyError(
+            f"no emitter registered for op {op_type!r}; registered: "
+            f"{sorted(OPS)[:40]}..."
+        )
+    return spec
+
+
+def has_op(op_type: str) -> bool:
+    return op_type in OPS
+
+
+# -- helpers for emitters ---------------------------------------------------
+
+def first(ins: Dict[str, List[Any]], slot: str, default=None):
+    vals = ins.get(slot) or []
+    return vals[0] if vals else default
+
+
+def single(x) -> Dict[str, List[Any]]:
+    return {"Out": [x]}

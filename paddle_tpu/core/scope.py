@@ -1,0 +1,64 @@
+"""Scope: hierarchical name → value symbol table holding device buffers.
+
+Capability parity with the reference's Scope/Variable
+(reference: paddle/fluid/framework/scope.h:48 Scope, variable.h:26 Variable;
+pybind at pybind.cc:505). Values are jax.Arrays living in TPU HBM (PJRT
+buffers) — the reference's `memory::Alloc` + LoDTensor storage collapses
+into the PJRT buffer behind each array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._parent = parent
+        self._vars: Dict[str, Any] = {}
+        self._kids: List["Scope"] = []
+
+    # reference: scope.h:56 NewScope
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    # reference: scope.h Var()
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    # reference: scope.h FindVar — walks up the parent chain
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def erase(self, names) -> None:
+        for n in names:
+            self._vars.pop(n, None)
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def drop_kids(self) -> None:
+        self._kids.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    """reference: pybind.cc exposes the same singleton to executor.py."""
+    return _global_scope
+
+
+def _reset_global_scope_for_tests() -> None:
+    global _global_scope
+    _global_scope = Scope()

@@ -1,0 +1,78 @@
+"""Build-time shape inference via abstract evaluation of op emitters.
+
+The reference runs C++ InferShape both at graph-build time (from Python
+append_op) and again at every execution (reference: framework/operator.cc:963
+— "InferShape *at runtime per call*"). TPU-native design: the emitter itself
+is the single source of truth — `jax.eval_shape` abstractly evaluates it once
+at build time; at run time shapes are static under XLA so no per-step
+inference exists at all.
+
+The dynamic batch dimension (-1 in VarDesc.shape) is threaded through
+abstract eval as a sentinel prime and mapped back to -1 in the result.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import ir
+from paddle_tpu.core.registry import EmitContext, get_op, has_op
+
+_SENTINEL = 6079  # prime, unlikely to appear as a real static dim
+
+
+def _to_struct(v: ir.VarDesc):
+    shape = tuple(_SENTINEL if d == -1 else d for d in (v.shape or ()))
+    return jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype))
+
+
+def _from_abstract(shape) -> Tuple[int, ...]:
+    out = []
+    for d in shape:
+        if d >= _SENTINEL and d % _SENTINEL == 0:
+            out.append(-1)
+        else:
+            out.append(int(d))
+    return tuple(out)
+
+
+def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc
+                     ) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
+    """Returns {output var name: (shape with -1 batch dims, dtype)} or None
+    if inference is not possible (emitter needs concrete values)."""
+    if not has_op(op.type):
+        return None
+    spec = get_op(op.type)
+
+    ins_structs = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if not block.has_var(n) or block.var(n).shape is None:
+                return None
+            vals.append(_to_struct(block.var(n)))
+        ins_structs[slot] = vals
+
+    ctx = EmitContext(base_key=None, op_index=0, is_test=False)
+
+    def f(ins):
+        # base key must be created inside the traced fn
+        ctx2 = EmitContext(base_key=jax.random.key(0), op_index=0, is_test=False)
+        return spec.emit(ctx2, ins, op.attrs)
+
+    try:
+        outs = jax.eval_shape(f, ins_structs)
+    except Exception:
+        return None
+
+    result: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, a in zip(names, vals):
+            result[n] = (_from_abstract(a.shape), str(a.dtype))
+    return result

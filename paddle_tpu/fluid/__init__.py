@@ -1,0 +1,23 @@
+"""paddle_tpu.fluid — the user-facing API namespace, mirroring the
+reference's `paddle.fluid` (python/paddle/fluid/__init__.py) so a reference
+user finds the same entry points: Executor, Program/program_guard, layers,
+optimizer, initializer, ParamAttr, nets, backward, io, metrics, profiler."""
+
+from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor,
+                                      TPUPlace)
+from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu.fluid import backward, clip, initializer, layers, nets
+from paddle_tpu.fluid import optimizer, param_attr, regularizer, unique_name
+from paddle_tpu.fluid.framework import (Program, default_main_program,
+                                        default_startup_program,
+                                        program_guard)
+from paddle_tpu.fluid.param_attr import ParamAttr
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "Executor", "TPUPlace",
+    "Scope", "global_scope",
+    "backward", "clip", "initializer", "layers", "nets", "optimizer",
+    "param_attr", "regularizer", "unique_name",
+    "Program", "default_main_program", "default_startup_program",
+    "program_guard", "ParamAttr",
+]

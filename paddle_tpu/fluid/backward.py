@@ -1,0 +1,32 @@
+"""Python-facing autodiff entry (reference: python/paddle/fluid/backward.py:394
+append_backward). The heavy lifting is the IR-level reverse walk in
+paddle_tpu.ops.grad_ops.append_backward_desc; this wrapper resolves
+Parameters and returns (param, grad) Variable pairs for the optimizer."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from paddle_tpu.fluid import framework
+from paddle_tpu.ops.grad_ops import append_backward_desc
+
+
+def append_backward(loss, parameter_list: Optional[List[str]] = None,
+                    no_grad_set=None, callbacks=None
+                    ) -> List[Tuple[framework.Variable, framework.Variable]]:
+    program = loss.block.program
+    block = program.desc.global_block
+    grad_map = append_backward_desc(block, loss.name, no_grad_set)
+    program.desc.bump_version()
+
+    gblock = program.global_block()
+    params_grads = []
+    for p in gblock.all_parameters():
+        if not getattr(p, "trainable", True):
+            continue
+        if parameter_list is not None and p.name not in parameter_list:
+            continue
+        gname = grad_map.get(p.name)
+        if gname:
+            params_grads.append((p, gblock.var(gname)))
+    return params_grads

@@ -1,0 +1,357 @@
+"""Python graph builder: Program / Block / Variable / Parameter.
+
+Capability parity with the reference's python mirrors of the proto IR
+(reference: python/paddle/fluid/framework.py — Variable :232, Operator :546,
+Block :992, Program :1510; two-program convention; Program.clone :1711;
+program_guard). These wrappers mutate the paddle_tpu.core.ir descs directly;
+shape inference happens once at append_op time by abstract evaluation of the
+op's JAX emitter (replacing the reference's C++ InferShape calls).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core import ir
+from paddle_tpu.core.shape_inference import infer_op_outputs
+from paddle_tpu.fluid import unique_name
+
+
+class Variable:
+    """reference: framework.py:232 — a symbolic tensor in a Block."""
+
+    def __init__(self, block: "Block", desc: ir.VarDesc):
+        self.block = block
+        self.desc = desc
+
+    # -- properties mirrored from the reference API ------------------------
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self):
+        return tuple(self.desc.shape) if self.desc.shape is not None else None
+
+    @property
+    def dtype(self) -> str:
+        return self.desc.dtype
+
+    @property
+    def lod_level(self) -> int:
+        return self.desc.lod_level
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, v: bool):
+        self.desc.persistable = v
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self.desc.stop_gradient = v
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    # numpy-style sugar on symbolic vars lowers to ops
+    def __add__(self, other):
+        from paddle_tpu.fluid.layers import elementwise_add
+        return elementwise_add(self, _to_variable(other, self))
+
+    def __sub__(self, other):
+        from paddle_tpu.fluid.layers import elementwise_sub
+        return elementwise_sub(self, _to_variable(other, self))
+
+    def __mul__(self, other):
+        from paddle_tpu.fluid.layers import elementwise_mul
+        return elementwise_mul(self, _to_variable(other, self))
+
+    def __truediv__(self, other):
+        from paddle_tpu.fluid.layers import elementwise_div
+        return elementwise_div(self, _to_variable(other, self))
+
+
+def _to_variable(x, like: Variable) -> Variable:
+    if isinstance(x, Variable):
+        return x
+    from paddle_tpu.fluid.layers import fill_constant
+    return fill_constant(shape=[1], dtype=like.dtype, value=float(x))
+
+
+class Parameter(Variable):
+    """reference: framework.py Parameter — a persistable trainable var with
+    optimizer/regularizer attributes."""
+
+    def __init__(self, block, desc, trainable=True, optimize_attr=None,
+                 regularizer=None, gradient_clip_attr=None, do_model_average=False):
+        super().__init__(block, desc)
+        self.trainable = trainable
+        self.optimize_attr = optimize_attr or {"learning_rate": 1.0}
+        self.regularizer = regularizer
+        self.gradient_clip_attr = gradient_clip_attr
+        self.do_model_average = do_model_average
+        desc.is_parameter = True
+        desc.persistable = True
+        desc.stop_gradient = False
+
+
+class Operator:
+    """reference: framework.py:546 — thin wrapper over an OpDesc."""
+
+    def __init__(self, block: "Block", desc: ir.OpDesc):
+        self.block = block
+        self.desc = desc
+
+    @property
+    def type(self) -> str:
+        return self.desc.type
+
+    def input(self, slot):
+        return self.desc.input(slot)
+
+    def output(self, slot):
+        return self.desc.output(slot)
+
+    @property
+    def attrs(self):
+        return self.desc.attrs
+
+
+class Block:
+    """reference: framework.py:992."""
+
+    def __init__(self, program: "Program", idx: int):
+        self.program = program
+        self.idx = idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def desc(self) -> ir.BlockDesc:
+        return self.program.desc.block(self.idx)
+
+    # -- var management ----------------------------------------------------
+    def create_var(self, name: Optional[str] = None, shape=None, dtype="float32",
+                   lod_level: int = 0, persistable: bool = False,
+                   stop_gradient: bool = False,
+                   type: ir.VarType = ir.VarType.LOD_TENSOR) -> Variable:
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        desc = ir.VarDesc(name=name, type=type,
+                          shape=list(shape) if shape is not None else None,
+                          dtype=dtype, lod_level=lod_level,
+                          persistable=persistable, stop_gradient=stop_gradient)
+        self.desc.add_var(desc)
+        v = Variable(self, desc)
+        self.vars[name] = v
+        self.program.desc.bump_version()
+        return v
+
+    def create_parameter(self, name: str, shape, dtype="float32",
+                         **kwargs) -> Parameter:
+        desc = ir.VarDesc(name=name, shape=list(shape), dtype=dtype,
+                          persistable=True)
+        self.desc.add_var(desc)
+        p = Parameter(self, desc, **kwargs)
+        self.vars[name] = p
+        self.program.desc.bump_version()
+        return p
+
+    def var(self, name: str) -> Variable:
+        v = self.vars.get(name)
+        if v is None:
+            if self.desc.has_var(name):
+                v = Variable(self, self.desc.var(name))
+                self.vars[name] = v
+            else:
+                raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars or self.desc.has_var(name)
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- op management -----------------------------------------------------
+    def append_op(self, type: str, inputs: Optional[Dict[str, Any]] = None,
+                  outputs: Optional[Dict[str, Any]] = None,
+                  attrs: Optional[Dict[str, Any]] = None) -> Operator:
+        op_desc = ir.OpDesc(
+            type=type,
+            inputs=_names_of(inputs),
+            outputs=_names_of(outputs),
+            attrs=dict(attrs or {}),
+        )
+        self.desc.append_op(op_desc)
+        op = Operator(self, op_desc)
+        self.ops.append(op)
+        self.program.desc.bump_version()
+        self._infer_shapes(op_desc)
+        return op
+
+    def _infer_shapes(self, op_desc: ir.OpDesc):
+        inferred = infer_op_outputs(self.desc, op_desc)
+        if not inferred:
+            return
+        for name, (shape, dtype) in inferred.items():
+            if self.desc.has_var(name):
+                vd = self.desc.var(name)
+                if vd.shape is None or tuple(vd.shape) != shape:
+                    vd.shape = list(shape)
+                vd.dtype = dtype
+
+
+def _names_of(slot_map) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for slot, vals in (slot_map or {}).items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        names = [v.name if isinstance(v, Variable) else str(v) for v in vals]
+        if names:
+            out[slot] = names
+    return out
+
+
+class Program:
+    """reference: framework.py:1510 — the user-visible program object."""
+
+    def __init__(self):
+        self.desc = ir.ProgramDesc()
+        self.blocks = [Block(self, 0)]
+        self._current_block_idx = 0
+        self._is_test = False
+        self._seed = 0
+
+    @property
+    def random_seed(self) -> int:
+        return self.desc.random_seed
+
+    @random_seed.setter
+    def random_seed(self, s: int):
+        self.desc.random_seed = int(s)
+        self.desc.bump_version()
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def create_block(self) -> Block:
+        parent = self._current_block_idx
+        self.desc.append_block(parent)
+        b = Block(self, len(self.blocks))
+        self.blocks.append(b)
+        self._current_block_idx = b.idx
+        return b
+
+    def rollback(self):
+        parent = self.desc.block(self._current_block_idx).parent_idx
+        self._current_block_idx = max(parent, 0)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """reference: framework.py:1711 Program.clone(for_test=True) —
+        the inference-graph convention; test mode flips is_test semantics
+        of dropout/batch_norm at lowering."""
+        p = Program()
+        p.desc = self.desc.clone()
+        p.blocks = [Block(p, i) for i in range(len(p.desc.blocks))]
+        for b in p.blocks:
+            for name, vd in b.desc.vars.items():
+                src_block = self.blocks[b.idx] if b.idx < len(self.blocks) else None
+                if src_block is not None and isinstance(src_block.vars.get(name), Parameter):
+                    b.vars[name] = Parameter(b, vd)
+                else:
+                    b.vars[name] = Variable(b, vd)
+            b.ops = [Operator(b, od) for od in b.desc.ops]
+        p._is_test = for_test
+        p._seed = self._seed
+        p.desc.random_seed = self.desc.random_seed
+        return p
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def to_string(self, throw_on_error=False) -> str:
+        import json
+        return json.dumps(self.desc.to_dict(), indent=1)
+
+    def __repr__(self):
+        nops = sum(len(b.desc.ops) for b in self.blocks)
+        return f"Program(blocks={len(self.blocks)}, ops={nops})"
+
+
+# ---------------------------------------------------------------------------
+# two-program convention + guards (reference: framework.py
+# default_main_program/default_startup_program, program_guard)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+def reset_default_programs():
+    """Test hook: fresh default programs (the reference gets this by
+    constructing new Programs per test via program_guard)."""
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+
+
+# dtype helper mirroring fluid's convert_np_dtype_to_dtype_
+def convert_dtype(dtype) -> str:
+    if isinstance(dtype, str):
+        return dtype
+    return np.dtype(dtype).name

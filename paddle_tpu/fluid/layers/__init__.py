@@ -1,0 +1,22 @@
+"""fluid.layers namespace (reference: python/paddle/fluid/layers/__init__.py)."""
+
+from paddle_tpu.fluid.layers.io import data  # noqa: F401
+from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
+    argmax, argmin, assign, cast, concat, fill_constant,
+    fill_constant_batch_size_like, ones, shape, sums, zeros, zeros_like)
+from paddle_tpu.fluid.layers.nn import (  # noqa: F401
+    accuracy, auc, batch_norm, clip, conv2d, conv2d_transpose, cross_entropy,
+    dropout, embedding, expand, fc, gather, huber_loss, l2_normalize,
+    label_smooth, layer_norm, log, matmul, mean, mul, one_hot, pool2d,
+    reduce_max, reduce_mean, reduce_min, reduce_prod, reduce_sum, reshape,
+    scale, sigmoid_cross_entropy_with_logits, slice, softmax,
+    softmax_with_cross_entropy, split, square_error_cost, squeeze, stack,
+    topk, transpose, unsqueeze)
+from paddle_tpu.fluid.layers.ops import (  # noqa: F401
+    abs, ceil, cos, elementwise_add, elementwise_div, elementwise_max,
+    elementwise_min, elementwise_mod, elementwise_mul, elementwise_pow,
+    elementwise_sub, elu, equal, exp, floor, gelu, greater_equal,
+    greater_than, hard_sigmoid, leaky_relu, less_equal, less_than,
+    logsigmoid, not_equal, pow, reciprocal, relu, relu6, round, rsqrt,
+    sigmoid, sin, softplus, softsign, sqrt, square, swish, tanh,
+    tanh_shrink)

@@ -1,0 +1,24 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py — data() at
+:data; py_reader :485 and double_buffer are delivered by the host-side
+prefetching pipeline in paddle_tpu.data, since on TPU the in-graph reader-op
+queue is replaced by host→device async transfer)."""
+
+from __future__ import annotations
+
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True, type=None):
+    """reference: layers/io.py data() — declares a feed target. The -1 batch
+    dim binds at compile time from the feed signature."""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        return block.var(name)
+    return block.create_var(name=name, shape=shape, dtype=dtype,
+                            lod_level=lod_level, stop_gradient=stop_gradient)

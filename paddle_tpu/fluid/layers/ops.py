@@ -1,0 +1,119 @@
+"""Auto-generated layer wrappers for unary activations and elementwise
+binary ops (reference: python/paddle/fluid/layers/ops.py +
+layer_function_generator.py — wrappers generated from OpProto; here
+generated from the emitter registry)."""
+
+from __future__ import annotations
+
+import sys
+
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+_UNARY = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
+    "abs", "ceil", "floor", "cos", "sin", "round", "reciprocal", "square",
+    "softplus", "softsign", "relu", "gelu",
+]
+
+_BINARY = [
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod",
+]
+
+_COMPARE = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+]
+
+_mod = sys.modules[__name__]
+
+
+def _make_unary(op):
+    def layer(x, name=None):
+        helper = LayerHelper(op, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+    layer.__name__ = op
+    return layer
+
+
+def _make_binary(op):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]}, attrs={"axis": axis})
+        return helper.append_activation(out, act)
+    layer.__name__ = op
+    return layer
+
+
+def _make_compare(op):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op)
+        if cond is None:
+            cond = helper.create_variable_for_type_inference("bool")
+        helper.append_op(op, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [cond]})
+        cond.stop_gradient = True
+        return cond
+    layer.__name__ = op
+    return layer
+
+
+for _op in _UNARY:
+    setattr(_mod, _op, _make_unary(_op))
+for _op in _BINARY:
+    setattr(_mod, _op, _make_binary(_op))
+for _op in _COMPARE:
+    setattr(_mod, _op, _make_compare(_op))
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("leaky_relu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def relu6(x, threshold=6.0, name=None):
+    helper = LayerHelper("relu6", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("relu6", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"threshold": threshold})
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper("elu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("elu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper("swish", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("swish", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"beta": beta})
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper("hard_sigmoid", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("hard_sigmoid", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"slope": slope, "offset": offset})
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"factor": factor})
+    return out

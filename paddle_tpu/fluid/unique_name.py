@@ -1,0 +1,37 @@
+"""Unique name generator (reference: python/paddle/fluid/unique_name.py —
+per-prefix counters with a guard() context that isolates name scopes, used
+by every layer to name parameters/temporaries)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class NameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        i = self.ids[key]
+        self.ids[key] += 1
+        return "_".join(x for x in (self.prefix, key, str(i)) if x != "")
+
+
+_generator = NameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    global _generator
+    old = _generator
+    _generator = NameGenerator(new_prefix)
+    try:
+        yield
+    finally:
+        _generator = old

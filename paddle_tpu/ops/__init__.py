@@ -1,0 +1,10 @@
+"""Op emitter corpus — importing this package registers all builtin ops
+(capability parity with the reference's static-initializer op registration,
+framework/op_registry.h:197)."""
+
+from paddle_tpu.ops import basic  # noqa: F401
+from paddle_tpu.ops import math_ops  # noqa: F401
+from paddle_tpu.ops import nn_ops  # noqa: F401
+from paddle_tpu.ops import optimizer_ops  # noqa: F401
+from paddle_tpu.ops import metric_ops  # noqa: F401
+from paddle_tpu.ops import grad_ops  # noqa: F401

@@ -1,0 +1,227 @@
+"""The universal gradient op.
+
+The reference synthesizes one hand-written grad op per forward op via
+GradOpDescMaker classes (reference: framework/grad_op_desc_maker.h, invoked
+from python backward.py:394 through core.get_grad_op_desc). TPU-native
+re-design: a single `__vjp__` op whose emitter re-traces the forward
+emitter under `jax.vjp` — every op's backward rule is derived automatically
+and XLA's CSE merges the re-traced forward with the original, so there is no
+duplicate compute in the compiled executable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import ir
+from paddle_tpu.core.registry import EmitContext, get_op, register_op
+
+
+def _slot_layout(slots: Dict[str, List[str]]) -> List[Tuple[str, int]]:
+    return [(slot, len(names)) for slot, names in sorted(slots.items())]
+
+
+def _flatten(d: Dict[str, List[Any]], layout) -> List[Any]:
+    out = []
+    for slot, n in layout:
+        vals = d.get(slot) or []
+        if len(vals) < n:
+            raise ValueError(f"slot {slot} produced {len(vals)} values, expected {n}")
+        out.extend(vals[:n])
+    return out
+
+
+def _unflatten(vals: List[Any], layout) -> Dict[str, List[Any]]:
+    d = {}
+    i = 0
+    for slot, n in layout:
+        d[slot] = list(vals[i:i + n])
+        i += n
+    return d
+
+
+@register_op("__vjp__", no_grad=True, ref="framework/grad_op_desc_maker.h (capability)")
+def _vjp_emit(ctx: EmitContext, ins, attrs):
+    fwd_op = ir.OpDesc.from_dict(attrs["fwd_op"])
+    spec = get_op(fwd_op.type)
+    in_layout = _slot_layout(fwd_op.inputs)
+    out_layout = _slot_layout(fwd_op.outputs)
+    flat_in = ins.get("FwdIn", [])
+    diff_mask = attrs["in_grad_mask"]      # per flat fwd input
+    og_mask = attrs["out_grad_mask"]       # per flat fwd output: grad provided?
+    fwd_ctx = EmitContext(base_key=ctx.base_key, op_index=attrs["fwd_op_index"],
+                          is_test=ctx.is_test)
+
+    diff_idx = [i for i, m in enumerate(diff_mask) if m]
+
+    def forward_flat(diff_vals):
+        vals = list(flat_in)
+        for i, v in zip(diff_idx, diff_vals):
+            vals[i] = v
+        outs = spec.emit(fwd_ctx, _unflatten(vals, in_layout), fwd_op.attrs)
+        return tuple(_flatten(outs, out_layout))
+
+    # determine which declared outputs are float (can carry cotangents)
+    out_avals = jax.eval_shape(forward_flat, tuple(flat_in[i] for i in diff_idx))
+    float_out = [k for k, a in enumerate(out_avals)
+                 if jnp.issubdtype(a.dtype, jnp.inexact)]
+
+    def forward_float_only(diff_vals):
+        outs = forward_flat(diff_vals)
+        return tuple(outs[k] for k in float_out)
+
+    primals, vjp_fn = jax.vjp(forward_float_only,
+                              tuple(flat_in[i] for i in diff_idx))
+    ograds = ins.get("OutGrad", [])
+    og_by_flat: Dict[int, Any] = {}
+    j = 0
+    for k, present in enumerate(og_mask):
+        if present:
+            og_by_flat[k] = ograds[j]
+            j += 1
+    cotangents = []
+    for pos, k in enumerate(float_out):
+        g = og_by_flat.get(k)
+        p = primals[pos]
+        if g is None:
+            cotangents.append(jnp.zeros_like(p))
+        else:
+            cotangents.append(g.reshape(p.shape).astype(p.dtype))
+    (gin,) = vjp_fn(tuple(cotangents))
+    return {"InGrad": list(gin)}
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def append_backward_desc(block: ir.BlockDesc, loss_name: str,
+                         no_grad_set=None) -> Dict[str, str]:
+    """Reverse-mode autodiff over the block's op list.
+
+    Capability parity with `append_backward` (reference:
+    python/paddle/fluid/backward.py:394; op walk :252; sum-aggregation
+    insertion :148,195): walks ops in reverse, appends one `__vjp__` op per
+    relevant forward op, inserts `sum` ops where a var's gradient fans in
+    from several consumers, and returns {var_name: grad_var_name}.
+    """
+    no_grad_set = set(no_grad_set or ())
+
+    def var_stops(n: str) -> bool:
+        if n in no_grad_set:
+            return True
+        if block.has_var(n):
+            v = block.var(n)
+            if v.stop_gradient:
+                return True
+            if not v.dtype.startswith(("float", "bfloat")):
+                return True
+        return False
+
+    # relevance: ops backward-reachable from the loss
+    n_fwd = len(block.ops)
+    needed = {loss_name}
+    relevant = [False] * n_fwd
+    for i in range(n_fwd - 1, -1, -1):
+        op = block.ops[i]
+        if op.type in ("feed", "fetch") or get_op(op.type).no_grad:
+            continue
+        if set(op.output_names()) & needed:
+            relevant[i] = True
+            needed.update(op.input_names())
+
+    # loss@GRAD = ones
+    loss_var = block.var(loss_name)
+    loss_grad = loss_name + GRAD_SUFFIX
+    block.append_op(ir.OpDesc(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss_var.shape or []), "value": 1.0,
+               "dtype": loss_var.dtype},
+    ))
+    _add_grad_var(block, loss_grad, loss_var)
+
+    # pending[v] = list of partial-grad var names awaiting aggregation
+    pending: Dict[str, List[str]] = {loss_name: [loss_grad]}
+    finalized: Dict[str, str] = {}
+
+    def finalize(v: str) -> str:
+        if v in finalized:
+            return finalized[v]
+        parts = pending.get(v, [])
+        if not parts:
+            return ""
+        gname = v + GRAD_SUFFIX
+        if len(parts) == 1:
+            gname = parts[0]
+        else:
+            block.append_op(ir.OpDesc(type="sum", inputs={"X": list(parts)},
+                                      outputs={"Out": [gname]}))
+            _add_grad_var(block, gname, block.var(v) if block.has_var(v) else None)
+        finalized[v] = gname
+        return gname
+
+    for i in range(n_fwd - 1, -1, -1):
+        if not relevant[i]:
+            continue
+        op = block.ops[i]
+        in_layout = _slot_layout(op.inputs)
+        out_layout = _slot_layout(op.outputs)
+        flat_in = _flatten({s: list(ns) for s, ns in op.inputs.items()}, in_layout)
+        flat_out = _flatten({s: list(ns) for s, ns in op.outputs.items()}, out_layout)
+
+        og_names, og_mask = [], []
+        for o in flat_out:
+            g = finalize(o)
+            og_mask.append(bool(g))
+            if g:
+                og_names.append(g)
+        if not any(og_mask):
+            continue
+
+        in_grad_mask = [not var_stops(n) for n in flat_in]
+        if not any(in_grad_mask):
+            continue
+
+        grad_out_names = []
+        for n, m in zip(flat_in, in_grad_mask):
+            if not m:
+                continue
+            parts = pending.setdefault(n, [])
+            gname = n + GRAD_SUFFIX if not parts else f"{n}{GRAD_SUFFIX}@RENAME@{len(parts)}"
+            parts.append(gname)
+            grad_out_names.append(gname)
+            _add_grad_var(block, gname, block.var(n) if block.has_var(n) else None)
+
+        block.append_op(ir.OpDesc(
+            type="__vjp__",
+            inputs={"FwdIn": list(flat_in), "OutGrad": og_names},
+            outputs={"InGrad": grad_out_names},
+            attrs={
+                "fwd_op": op.to_dict(),
+                "fwd_op_index": i,
+                "in_grad_mask": in_grad_mask,
+                "out_grad_mask": og_mask,
+            },
+        ))
+
+    # finalize remaining grads (parameters are usually leaves)
+    grad_map: Dict[str, str] = {}
+    for v in list(pending):
+        g = finalize(v)
+        if g:
+            grad_map[v] = g
+    return grad_map
+
+
+def _add_grad_var(block: ir.BlockDesc, gname: str, base: "ir.VarDesc | None"):
+    if block.has_var(gname):
+        return
+    block.add_var(ir.VarDesc(
+        name=gname,
+        shape=list(base.shape) if base is not None and base.shape else None,
+        dtype=base.dtype if base is not None else "float32",
+        stop_gradient=True,
+    ))

@@ -1,0 +1,80 @@
+"""Metric ops (reference: operators/metrics/accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax
+
+from paddle_tpu.core.registry import first, register_op
+
+
+@register_op("accuracy", no_grad=True, ref="operators/metrics/accuracy_op.cc")
+def _accuracy(ctx, ins, attrs):
+    # fluid feeds Out (topk values), Indices (topk indices), Label
+    idx = first(ins, "Indices")
+    label = first(ins, "Label").reshape(-1, 1)
+    correct_mask = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct_mask.astype(jnp.float32))
+    total = idx.shape[0]
+    return {
+        "Accuracy": [(num_correct / total).reshape(1)],
+        "Correct": [num_correct.astype(jnp.int32).reshape(1)],
+        "Total": [jnp.asarray([total], dtype=jnp.int32)],
+    }
+
+
+@register_op("auc", no_grad=True, ref="operators/metrics/auc_op.cc")
+def _auc(ctx, ins, attrs):
+    """Streaming AUC via confusion-matrix histogram buckets; the stat
+    buffers (StatPos/StatNeg) are persistable state written back by the
+    executor, mirroring the reference's in-place stat update."""
+    pred = first(ins, "Predict")     # [N, 2] probabilities
+    label = first(ins, "Label").reshape(-1)
+    stat_pos = first(ins, "StatPos")
+    stat_neg = first(ins, "StatNeg")
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_score = pred[:, -1]
+    bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1.0 - is_pos)
+    # trapezoid area over descending thresholds
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {
+        "AUC": [auc.reshape(1)],
+        "StatPosOut": [stat_pos],
+        "StatNegOut": [stat_neg],
+    }
+
+
+@register_op("precision_recall", no_grad=True,
+             ref="operators/metrics/precision_recall_op.cc")
+def _precision_recall(ctx, ins, attrs):
+    max_probs = first(ins, "MaxProbs")
+    idx = first(ins, "Indices").reshape(-1)
+    label = first(ins, "Labels").reshape(-1)
+    cls_num = attrs.get("class_number")
+    correct = (idx == label)
+    tp = jax.ops.segment_sum(correct.astype(jnp.float32), label, num_segments=cls_num)
+    pred_cnt = jax.ops.segment_sum(jnp.ones_like(idx, dtype=jnp.float32), idx, num_segments=cls_num)
+    true_cnt = jax.ops.segment_sum(jnp.ones_like(label, dtype=jnp.float32), label, num_segments=cls_num)
+    precision = tp / jnp.maximum(pred_cnt, 1.0)
+    recall = tp / jnp.maximum(true_cnt, 1.0)
+    f1 = 2.0 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(pred_cnt), 1.0)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(true_cnt), 1.0)
+    micro_f = 2.0 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-12)
+    metrics = jnp.concatenate([macro, jnp.stack([micro_p, micro_r, micro_f])])
+    states = jnp.stack([tp, pred_cnt - tp, true_cnt - tp,
+                        jnp.full_like(tp, float(idx.shape[0])) - pred_cnt - true_cnt + tp], axis=1)
+    return {"BatchMetrics": [metrics], "AccumMetrics": [metrics],
+            "AccumStatesInfo": [states]}

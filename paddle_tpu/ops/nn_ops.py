@@ -1,0 +1,414 @@
+"""Neural-net ops: conv, pooling, normalization, embedding, losses.
+
+Parity targets: operators/conv_op.cc (+conv_cudnn_op.cu.cc),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+lookup_table_op.cc, softmax_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc.
+
+TPU notes: convs lower to XLA's conv_general_dilated which tiles onto the
+MXU; there is no cudnn-vs-plain kernel choice to make (XLA autotunes).
+Layout is NCHW at the API for reference parity; XLA's layout assignment
+re-tiles internally for TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import first, register_op, single
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register_op("conv2d", ref="operators/conv_op.cc:44 Conv2DOp; conv_cudnn_op.cu.cc")
+def _conv2d(ctx, ins, attrs):
+    x = first(ins, "Input")          # NCHW
+    w = first(ins, "Filter")         # OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", ref="operators/conv_op.cc (depthwise registered alias)")
+def _depthwise_conv2d(ctx, ins, attrs):
+    x = first(ins, "Input")
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return _conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose", ref="operators/conv_transpose_op.cc")
+def _conv2d_transpose(ctx, ins, attrs):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")         # IOHW in fluid's transpose conv
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv3d", ref="operators/conv_op.cc Conv3DOp")
+def _conv3d(ctx, ins, attrs):
+    x = first(ins, "Input")          # NCDHW
+    w = first(ins, "Filter")         # OIDHW
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        feature_group_count=attrs.get("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@register_op("pool2d", ref="operators/pool_op.cc")
+def _pool2d(ctx, ins, attrs):
+    x = first(ins, "X")              # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = x.shape[2:]
+        pads = (0, 0)
+        strides = (1, 1)
+    window = (1, 1) + tuple(ksize)
+    strides4 = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, padding)
+            out = summed / counts
+        else:
+            out = summed / float(ksize[0] * ksize[1])
+    return single(out)
+
+
+@register_op("pool3d", ref="operators/pool_op.cc Pool3D")
+def _pool3d(ctx, ins, attrs):
+    x = first(ins, "X")
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2, 2]), 3)
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides5, padding)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides5, padding)
+        out = summed / float(np.prod(ksize))
+    return single(out)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm", ref="operators/batch_norm_op.cc:40")
+def _batch_norm(ctx, ins, attrs):
+    """Train mode: batch statistics + EMA update of Mean/Variance (the
+    reference writes MeanOut/VarianceOut aliased onto the running stats;
+    here they are returned and the executor writes them back to the Scope).
+    Test mode: running statistics."""
+    x = first(ins, "X")              # NCHW (or NC / NCL / NCDHW)
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    mean = first(ins, "Mean")
+    var = first(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    axes = (0,) + tuple(range(2, x.ndim))
+    if is_test or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        saved_mean = mean
+        saved_var = var
+        mean_out, var_out = mean, var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        # EMA update is state maintenance, not on the loss path
+        use_mean_s = jax.lax.stop_gradient(use_mean)
+        use_var_s = jax.lax.stop_gradient(use_var)
+        mean_out = mean * momentum + use_mean_s * (1.0 - momentum)
+        var_out = var * momentum + use_var_s * (1.0 - momentum)
+        saved_mean = use_mean
+        saved_var = use_var
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
+    y = (x - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op("layer_norm", ref="operators/layer_norm_op.cc")
+def _layer_norm(ctx, ins, attrs):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {
+        "Y": [y],
+        "Mean": [mean.reshape(x.shape[:begin])],
+        "Variance": [var.reshape(x.shape[:begin])],
+    }
+
+
+@register_op("group_norm", ref="operators/group_norm_op.cc")
+def _group_norm(ctx, ins, attrs):
+    x = first(ins, "X")              # NCHW
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": [y], "Mean": [mean.reshape(n, groups)], "Variance": [var.reshape(n, groups)]}
+
+
+@register_op("lrn", ref="operators/lrn_op.cc")
+def _lrn(ctx, ins, attrs):
+    x = first(ins, "X")              # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)]
+    sq_pad = jnp.pad(sq, pad)
+    window = jax.lax.reduce_window(sq_pad, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1), "VALID")
+    return {"Out": [x / jnp.power(k + alpha * window, beta)], "MidOut": [window]}
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+@register_op("dropout", ref="operators/dropout_op.cc")
+def _dropout(ctx, ins, attrs):
+    x = first(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": [jnp.ones_like(x)]}
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.key(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# embedding (the sparse-table capability; reference: lookup_table_op.cc,
+# distributed prefetch path nn.py:345-359 → here a dense gather that shards
+# over the mesh's model axis for the pserver-sharded-table capability)
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_table", ref="operators/lookup_table_op.cc")
+def _lookup_table(ctx, ins, attrs):
+    w = first(ins, "W")
+    ids = first(ins, "Ids")
+    padding_idx = attrs.get("padding_idx", -1)
+    flat = ids.reshape(-1)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
+    out_shape = tuple(ids.shape[:-1] if ids.shape and ids.shape[-1] == 1 else ids.shape) + (w.shape[-1],)
+    return single(out.reshape(out_shape))
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses
+# ---------------------------------------------------------------------------
+
+@register_op("softmax", ref="operators/softmax_op.cc")
+def _softmax(ctx, ins, attrs):
+    return single(jax.nn.softmax(first(ins, "X"), axis=-1))
+
+
+@register_op("log_softmax", ref="operators/softmax_op.cc (log variant)")
+def _log_softmax(ctx, ins, attrs):
+    return single(jax.nn.log_softmax(first(ins, "X"), axis=-1))
+
+
+def _gather_label_prob(prob, label):
+    # label: [N, 1] or [N] int -> pick prob[i, label[i]]
+    lab = label.reshape(-1)
+    return jnp.take_along_axis(prob, lab[:, None].astype(jnp.int32), axis=-1)
+
+
+@register_op("cross_entropy", ref="operators/cross_entropy_op.cc")
+def _cross_entropy(ctx, ins, attrs):
+    x = first(ins, "X")              # probabilities [N, D]
+    label = first(ins, "Label")
+    eps = 1e-9
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        picked = _gather_label_prob(x, label)
+        loss = -jnp.log(picked + eps)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(label.reshape(-1, 1) == ignore, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy",
+             ref="operators/softmax_with_cross_entropy_op.cc")
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = first(ins, "Logits")
+    label = first(ins, "Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.reshape(-1)
+        picked = jnp.take_along_axis(logp, lab[:, None].astype(jnp.int32), axis=-1)
+        loss = -picked
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(label.reshape(-1, 1) == ignore, 0.0, loss)
+    return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             ref="operators/sigmoid_cross_entropy_with_logits_op.cc")
+def _sigmoid_ce(ctx, ins, attrs):
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        cnt = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / cnt
+    return single(loss)
+
+
+@register_op("square_error_cost", ref="operators/squared_l2_distance_op.cc / nn.py square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    return single(jnp.square(x - y))
+
+
+@register_op("huber_loss", ref="operators/huber_loss_op.cc")
+def _huber_loss(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    delta = attrs.get("delta", 1.0)
+    diff = y - x
+    absd = jnp.abs(diff)
+    loss = jnp.where(absd <= delta, 0.5 * diff * diff, delta * (absd - 0.5 * delta))
+    return {"Out": [loss], "Residual": [diff]}
+
+
+@register_op("smooth_l1_loss", ref="operators/smooth_l1_loss_op.cc")
+def _smooth_l1(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff, diff - 0.5 / s2)
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [x - y]}
+
+
+@register_op("label_smooth", ref="operators/label_smooth_op.cc")
+def _label_smooth(ctx, ins, attrs):
+    x = first(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    dist = ins.get("PriorDist")
+    if dist:
+        out = (1.0 - eps) * x + eps * dist[0]
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    return single(out)
+
+
+# ---------------------------------------------------------------------------
+# sequence-ish dense helpers
+# ---------------------------------------------------------------------------
+
+@register_op("im2sequence", ref="operators/im2sequence_op.cc")
+def _im2sequence(ctx, ins, attrs):
+    raise NotImplementedError("im2sequence: use conv patches via segment ids")
+
+
+@register_op("pad", ref="operators/pad_op.cc")
+def _pad(ctx, ins, attrs):
+    x = first(ins, "X")
+    paddings = attrs.get("paddings", [0] * (2 * x.ndim))
+    pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return single(jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0)))

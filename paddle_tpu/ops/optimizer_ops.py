@@ -1,0 +1,255 @@
+"""Optimizer update ops.
+
+Parity targets: operators/optimizers/ (sgd_op.cc, momentum_op.cc +
+lars_momentum_op.cc, adam_op.h, adagrad_op.cc, adadelta_op.cc, adamax_op.cc,
+rmsprop_op.cc, ftrl_op.cc, decayed_adagrad_op.cc, proximal_gd_op.cc,
+proximal_adagrad_op.cc).
+
+These are `no_grad` state-transition ops: the executor returns their outputs
+(ParamOut, MomentOut, ...) and writes them back into the Scope under the
+same variable names — the functional equivalent of the reference's in-place
+updates, kept zero-copy on TPU via buffer donation (input_output_aliases).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import first, register_op
+
+
+@register_op("sgd", no_grad=True, ref="operators/optimizers/sgd_op.cc")
+def _sgd(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    lr = first(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()) * g]}
+
+
+@register_op("momentum", no_grad=True, ref="operators/optimizers/momentum_op.cc")
+def _momentum(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    v = first(ins, "Velocity")
+    lr = first(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu", 0.9)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("lars_momentum", no_grad=True,
+             ref="operators/optimizers/lars_momentum_op.cc")
+def _lars_momentum(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    v = first(ins, "Velocity")
+    lr = first(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-12),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam", no_grad=True, ref="operators/optimizers/adam_op.h")
+def _adam(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m1 = first(ins, "Moment1")
+    m2 = first(ins, "Moment2")
+    b1p = first(ins, "Beta1Pow").reshape(())
+    b2p = first(ins, "Beta2Pow").reshape(())
+    lr = first(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1_out = b1 * m1 + (1.0 - b1) * g
+    m2_out = b2 * m2 + (1.0 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {
+        "ParamOut": [p_out],
+        "Moment1Out": [m1_out],
+        "Moment2Out": [m2_out],
+        "Beta1PowOut": [b1p.reshape(1) * b1],
+        "Beta2PowOut": [b2p.reshape(1) * b2],
+    }
+
+
+@register_op("adamax", no_grad=True, ref="operators/optimizers/adamax_op.cc")
+def _adamax(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m = first(ins, "Moment")
+    inf_norm = first(ins, "InfNorm")
+    b1p = first(ins, "Beta1Pow").reshape(())
+    lr = first(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1.0 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1.0 - b1p)
+    return {
+        "ParamOut": [p - lr_t * m_out / inf_out],
+        "MomentOut": [m_out],
+        "InfNormOut": [inf_out],
+    }
+
+
+@register_op("adagrad", no_grad=True, ref="operators/optimizers/adagrad_op.cc")
+def _adagrad(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    mom = first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_out) + eps)],
+            "MomentOut": [mom_out]}
+
+
+@register_op("decayed_adagrad", no_grad=True,
+             ref="operators/optimizers/decayed_adagrad_op.cc")
+def _decayed_adagrad(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    mom = first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(())
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1.0 - decay) * jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_out) + eps)],
+            "MomentOut": [mom_out]}
+
+
+@register_op("adadelta", no_grad=True, ref="operators/optimizers/adadelta_op.cc")
+def _adadelta(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    avg_sq_grad = first(ins, "AvgSquaredGrad")
+    avg_sq_upd = first(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg_out = rho * avg_sq_grad + (1.0 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_upd + (1.0 - rho) * jnp.square(update)
+    return {"ParamOut": [p + update],
+            "AvgSquaredGradOut": [asg_out],
+            "AvgSquaredUpdateOut": [asu_out]}
+
+
+@register_op("rmsprop", no_grad=True, ref="operators/optimizers/rmsprop_op.cc")
+def _rmsprop(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    ms = first(ins, "MeanSquare")
+    mom = first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(())
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    outs = {}
+    if attrs.get("centered", False):
+        mg = first(ins, "MeanGrad")
+        ms_out = rho * ms + (1.0 - rho) * jnp.square(g)
+        mg_out = rho * mg + (1.0 - rho) * g
+        mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+        outs["MeanGradOut"] = [mg_out]
+    else:
+        ms_out = rho * ms + (1.0 - rho) * jnp.square(g)
+        mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    outs.update({"ParamOut": [p - mom_out], "MomentOut": [mom_out],
+                 "MeanSquareOut": [ms_out]})
+    return outs
+
+
+@register_op("ftrl", no_grad=True, ref="operators/optimizers/ftrl_op.cc")
+def _ftrl(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    sq_accum = first(ins, "SquaredAccumulator")
+    lin_accum = first(ins, "LinearAccumulator")
+    lr = first(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_accum = sq_accum + jnp.square(g)
+    lin_out = lin_accum + g - (
+        (jnp.power(new_accum, -power) - jnp.power(sq_accum, -power)) / lr) * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    y = jnp.power(new_accum, -power) / lr + 2.0 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_accum],
+            "LinearAccumOut": [lin_out]}
+
+
+@register_op("proximal_gd", no_grad=True, ref="operators/optimizers/proximal_gd_op.cc")
+def _proximal_gd(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    lr = first(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": [p_out]}
+
+
+@register_op("proximal_adagrad", no_grad=True,
+             ref="operators/optimizers/proximal_adagrad_op.cc")
+def _proximal_adagrad(ctx, ins, attrs):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    mom = first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    mom_out = mom + jnp.square(g)
+    eff_lr = lr / jnp.sqrt(mom_out)
+    prox = p - eff_lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0) / (1.0 + eff_lr * l2)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+# -- gradient clipping helpers (reference: python clip.py lowers to these) --
+
+@register_op("clip_by_norm", no_grad=True, ref="operators/clip_by_norm_op.cc")
+def _clip_by_norm(ctx, ins, attrs):
+    x = first(ins, "X")
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / (norm + 1e-12)), x)]}
+
+
+@register_op("global_norm_clip_apply", no_grad=True,
+             ref="python clip.py GradientClipByGlobalNorm (scale step)")
+def _global_norm_clip_apply(ctx, ins, attrs):
+    x = first(ins, "X")
+    gnorm = first(ins, "GlobalNorm").reshape(())
+    clip_norm = attrs.get("clip_norm", 1.0)
+    scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+    return {"Out": [x * scale]}
+
+
+# -- EMA over params (reference: optimizer.py ModelAverage) -----------------
+
+@register_op("ema_accumulate", no_grad=True,
+             ref="python optimizer.py ModelAverage capability, TPU-native EMA form")
+def _ema_accumulate(ctx, ins, attrs):
+    p = first(ins, "Param")
+    ema = first(ins, "Ema")
+    decay = attrs.get("decay", 0.999)
+    return {"EmaOut": [decay * ema + (1.0 - decay) * p]}

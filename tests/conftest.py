@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU backend BEFORE jax
+imports, so sharding/mesh tests run without real TPU chips (mirrors the
+reference's trick of testing distributed paths on localhost —
+test_dist_base.py forks localhost processes; we use XLA virtual devices)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs + a fresh global scope
+    (the reference achieves the same with new Program()s per test)."""
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+    framework.reset_default_programs()
+    scope_mod._reset_global_scope_for_tests()
+    yield

@@ -1,0 +1,161 @@
+"""Book-style training tests (reference: python/paddle/fluid/tests/book/ —
+8 classic models trained a few iterations asserting loss decrease)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _fit_a_line(optimizer, steps=30):
+    """reference: tests/book/test_fit_a_line.py capability."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, act=None)
+        cost = layers.square_error_cost(input=pred, label=y)
+        avg_cost = layers.mean(cost)
+        optimizer.minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    true_w = rng.rand(13, 1).astype(np.float32)
+    losses = []
+    for _ in range(steps):
+        xv = rng.rand(32, 13).astype(np.float32)
+        yv = xv @ true_w + 0.1
+        (loss,) = exe.run(main, feed={"x": xv, "y": yv},
+                          fetch_list=[avg_cost])
+        losses.append(float(loss))
+    return losses
+
+
+def test_fit_a_line_sgd():
+    losses = _fit_a_line(fluid.optimizer.SGD(learning_rate=0.05))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_fit_a_line_adam():
+    losses = _fit_a_line(fluid.optimizer.Adam(learning_rate=0.05))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_fit_a_line_momentum():
+    losses = _fit_a_line(
+        fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_mnist_mlp_converges():
+    """reference: tests/book/test_recognize_digits.py (MLP flavour):
+    softmax classifier trains to lower loss + accuracy fetch."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[784], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        h = layers.fc(input=img, size=64, act="relu")
+        pred = layers.fc(input=h, size=10, act="softmax")
+        cost = layers.cross_entropy(input=pred, label=label)
+        avg_cost = layers.mean(cost)
+        acc = layers.accuracy(input=pred, label=label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # synthetic separable data: class = argmax of 10 fixed projections
+    proj = rng.rand(784, 10).astype(np.float32)
+    losses, accs = [], []
+    for _ in range(40):
+        xv = rng.rand(64, 784).astype(np.float32)
+        yv = np.argmax(xv @ proj, axis=1).astype(np.int64)[:, None]
+        loss, a = exe.run(main, feed={"img": xv, "label": yv},
+                          fetch_list=[avg_cost, acc])
+        losses.append(float(loss))
+        accs.append(float(a))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert np.mean(accs[-5:]) > np.mean(accs[:5])
+
+
+def test_mnist_cnn_trains():
+    """reference: benchmark/fluid/models/mnist.py cnn_model capability —
+    conv/pool/fc stack with Adam."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        conv1 = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        conv2 = fluid.nets.simple_img_conv_pool(
+            input=conv1, filter_size=5, num_filters=16, pool_size=2,
+            pool_stride=2, act="relu")
+        pred = layers.fc(input=conv2, size=10, act="softmax")
+        cost = layers.cross_entropy(input=pred, label=label)
+        avg_cost = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(15):
+        xv = rng.rand(16, 1, 28, 28).astype(np.float32)
+        yv = (xv.sum(axis=(1, 2, 3)) > 392).astype(np.int64)[:, None]
+        (loss,) = exe.run(main, feed={"img": xv, "label": yv},
+                          fetch_list=[avg_cost])
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_batch_norm_train_and_test_mode():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4, 8, 8], dtype="float32")
+        y = layers.batch_norm(input=x)
+        out = layers.mean(y)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(4, 4, 8, 8).astype(np.float32) * 5
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+    # running stats must have moved off their init (0 mean, 1 var)
+    import paddle_tpu.fluid as F
+    scope = F.global_scope()
+    moved = [n for n in scope.local_var_names() if ".mean" in n]
+    assert moved
+    mean_val = np.asarray(scope.find_var(moved[0]))
+    assert np.abs(mean_val).sum() > 0
+    # test mode runs without batch stats
+    (tv,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out.name])
+    assert np.isfinite(tv).all()
+
+
+def test_regularizer_and_grad_clip():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(
+            learning_rate=0.1,
+            regularization=fluid.regularizer.L2Decay(0.01))
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+        opt.minimize(loss)
+    fluid.clip.set_gradient_clip(None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((8, 4), np.float32)
+    yv = np.ones((8, 1), np.float32) * 100  # big target → big grads, clipped
+    (l0,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    (l1,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert np.isfinite(l1)
