@@ -1,0 +1,142 @@
+"""OpTest harness: numeric-vs-analytic gradient checking.
+
+Capability parity with the reference's OpTest base class
+(reference: python/paddle/fluid/tests/unittests/op_test.py —
+get_numeric_gradient :43, check_output_with_place :303, check_grad :414):
+builds a one-op program, runs forward, and validates the __vjp__-derived
+analytic gradients against central finite differences.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def run_single_op(op_type: str, inputs: Dict[str, Dict[str, np.ndarray]],
+                  attrs: Optional[dict] = None, out_slots=("Out",),
+                  n_out: int = 1):
+    """Run one op forward; inputs: {slot: {var_name: array}}.
+    Returns {output_name: np.ndarray}."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_map = {}
+        feed = {}
+        for slot, vars_ in inputs.items():
+            in_map[slot] = []
+            for name, arr in vars_.items():
+                block.create_var(name=name, shape=list(arr.shape),
+                                 dtype=str(arr.dtype), stop_gradient=False)
+                in_map[slot].append(name)
+                feed[name] = arr
+        out_map = {}
+        out_names = []
+        for slot in out_slots:
+            outs = []
+            for i in range(n_out):
+                nm = f"__out_{slot}_{i}"
+                block.create_var(name=nm, dtype="float32")
+                outs.append(nm)
+                out_names.append(nm)
+            out_map[slot] = outs
+        block.append_op(op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs or {})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    vals = exe.run(main, feed=feed, fetch_list=out_names)
+    return dict(zip(out_names, vals))
+
+
+def check_grad(op_type: str, inputs: Dict[str, Dict[str, np.ndarray]],
+               attrs: Optional[dict] = None, out_slot: str = "Out",
+               grad_vars=None, delta: float = 1e-3, rtol: float = 1e-2,
+               atol: float = 1e-4, seed: int = 0,
+               extra_out_slots=()):
+    """Central-difference gradient check (reference: op_test.py:414
+    check_grad with tolerance knobs :418)."""
+    rng = np.random.RandomState(seed)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_map, feed = {}, {}
+        for slot, vars_ in inputs.items():
+            in_map[slot] = []
+            for name, arr in vars_.items():
+                block.create_var(name=name, shape=list(arr.shape),
+                                 dtype=str(arr.dtype), stop_gradient=False)
+                in_map[slot].append(name)
+                feed[name] = arr
+        out_name = "__out"
+        block.create_var(name=out_name, dtype="float32")
+        out_map = {out_slot: [out_name]}
+        for i, s in enumerate(extra_out_slots):
+            nm = f"__extra_{i}"
+            block.create_var(name=nm, dtype="float32")
+            out_map[s] = [nm]
+        block.append_op(op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs or {})
+        out_var = block.var(out_name)
+        # weighted-sum loss so asymmetric grads are exercised
+        out_shape = out_var.shape
+        w = np.asarray(rng.rand(*[d for d in out_shape]),
+                       dtype=np.float32) + 0.5
+        wname = "__w"
+        block.create_var(name=wname, shape=list(w.shape), dtype="float32",
+                         stop_gradient=True)
+        feed[wname] = w
+        prod = "__prod"
+        block.create_var(name=prod, dtype="float32")
+        block.append_op("elementwise_mul",
+                        inputs={"X": [out_name], "Y": [wname]},
+                        outputs={"Out": [prod]})
+        loss = "__loss"
+        block.create_var(name=loss, dtype="float32")
+        block.append_op("reduce_sum", inputs={"X": [prod]},
+                        outputs={"Out": [loss]}, attrs={"reduce_all": True})
+
+        from paddle_tpu.ops.grad_ops import append_backward_desc
+        grad_map = append_backward_desc(main.desc.global_block, loss)
+        main.desc.bump_version()
+
+    targets = grad_vars
+    if targets is None:
+        targets = [n for vars_ in inputs.values() for n, a in vars_.items()
+                   if np.issubdtype(a.dtype, np.floating)]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    analytic = {}
+    fetch = [grad_map[t] for t in targets]
+    vals = exe.run(main, feed=feed, fetch_list=fetch + [loss])
+    for t, v in zip(targets, vals[:-1]):
+        analytic[t] = v
+
+    def loss_at(feed_override):
+        f = dict(feed)
+        f.update(feed_override)
+        (lv,) = exe.run(main, feed=f, fetch_list=[loss])
+        return float(np.asarray(lv).reshape(()))
+
+    for t in targets:
+        base = feed[t].astype(np.float64)
+        numeric = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            lp = loss_at({t: base.reshape(feed[t].shape).astype(feed[t].dtype)})
+            flat[i] = orig - delta
+            lm = loss_at({t: base.reshape(feed[t].shape).astype(feed[t].dtype)})
+            flat[i] = orig
+            num_flat[i] = (lp - lm) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[t].reshape(numeric.shape), numeric, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for {op_type}/{t}")

@@ -1,0 +1,219 @@
+"""Per-op numeric gradient checks (reference: the ~300 OpTest subclasses in
+python/paddle/fluid/tests/unittests/ — here one parametrized sweep since all
+backward rules derive from a single __vjp__ mechanism)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(*shape, seed=0, lo=0.1, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def test_forward_elementwise_add():
+    out = run_single_op("elementwise_add",
+                        {"X": {"x": _r(2, 3)}, "Y": {"y": _r(2, 3, seed=1)}})
+    np.testing.assert_allclose(out["__out_Out_0"], _r(2, 3) + _r(2, 3, seed=1),
+                               rtol=1e-5)
+
+
+def test_forward_broadcast_axis():
+    x = _r(2, 3, 4)
+    y = _r(3, seed=1)
+    out = run_single_op("elementwise_add", {"X": {"x": x}, "Y": {"y": y}},
+                        attrs={"axis": 1})
+    np.testing.assert_allclose(out["__out_Out_0"],
+                               x + y[None, :, None], rtol=1e-5)
+
+
+@pytest.mark.parametrize("op", ["elementwise_add", "elementwise_sub",
+                                "elementwise_mul", "elementwise_div",
+                                "elementwise_max", "elementwise_pow"])
+def test_grad_elementwise(op):
+    check_grad(op, {"X": {"x": _r(2, 3)}, "Y": {"y": _r(2, 3, seed=1)}})
+
+
+def test_grad_elementwise_broadcast():
+    check_grad("elementwise_add",
+               {"X": {"x": _r(2, 3)}, "Y": {"y": _r(3, seed=1)}},
+               attrs={"axis": -1})
+
+
+@pytest.mark.parametrize("op", ["tanh", "sigmoid", "exp", "log", "sqrt",
+                                "square", "softplus", "gelu", "abs"])
+def test_grad_activation(op):
+    check_grad(op, {"X": {"x": _r(2, 5, lo=0.2, hi=2.0)}})
+
+
+def test_grad_relu():
+    # keep values away from the kink
+    x = _r(2, 5) + 0.5
+    x[0, :2] = -x[0, :2]
+    check_grad("relu", {"X": {"x": x}})
+
+
+def test_grad_mul():
+    check_grad("mul", {"X": {"x": _r(3, 4)}, "Y": {"y": _r(4, 5, seed=1)}})
+
+
+def test_grad_mul_flattened():
+    check_grad("mul", {"X": {"x": _r(2, 2, 3)}, "Y": {"y": _r(6, 4, seed=1)}},
+               attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+
+
+def test_grad_matmul():
+    check_grad("matmul", {"X": {"x": _r(2, 3, 4)}, "Y": {"y": _r(2, 4, 5, seed=1)}})
+
+
+def test_grad_matmul_transpose():
+    check_grad("matmul", {"X": {"x": _r(4, 3)}, "Y": {"y": _r(4, 5, seed=1)}},
+               attrs={"transpose_X": True})
+
+
+def test_grad_softmax():
+    check_grad("softmax", {"X": {"x": _r(3, 6)}}, rtol=2e-2)
+
+
+def test_grad_reduce_sum():
+    check_grad("reduce_sum", {"X": {"x": _r(2, 3, 4)}}, attrs={"dim": [1]})
+
+
+def test_grad_reduce_mean_all():
+    check_grad("reduce_mean", {"X": {"x": _r(2, 3)}},
+               attrs={"reduce_all": True})
+
+
+def test_grad_mean():
+    check_grad("mean", {"X": {"x": _r(3, 4)}})
+
+
+def test_grad_scale():
+    check_grad("scale", {"X": {"x": _r(2, 3)}},
+               attrs={"scale": 2.5, "bias": 0.3})
+
+
+def test_grad_reshape():
+    check_grad("reshape", {"X": {"x": _r(2, 6)}}, attrs={"shape": [3, 4]})
+
+
+def test_grad_transpose():
+    check_grad("transpose", {"X": {"x": _r(2, 3, 4)}},
+               attrs={"axis": [2, 0, 1]})
+
+
+def test_grad_concat():
+    check_grad("concat", {"X": {"a": _r(2, 3), "b": _r(2, 2, seed=1)}},
+               attrs={"axis": 1})
+
+
+def test_grad_slice():
+    check_grad("slice", {"Input": {"x": _r(4, 5)}},
+               attrs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]})
+
+
+def test_grad_conv2d():
+    check_grad("conv2d",
+               {"Input": {"x": _r(1, 2, 5, 5)},
+                "Filter": {"w": _r(3, 2, 3, 3, seed=1, lo=-0.5, hi=0.5)}},
+               attrs={"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1},
+               out_slot="Output", delta=5e-3, rtol=3e-2, atol=5e-3)
+
+
+def test_grad_pool2d_avg():
+    check_grad("pool2d", {"X": {"x": _r(1, 2, 4, 4)}},
+               attrs={"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]})
+
+
+def test_grad_pool2d_max():
+    # distinct values so max is stable under perturbation
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4) / 7.0
+    check_grad("pool2d", {"X": {"x": x}},
+               attrs={"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]})
+
+
+def test_grad_layer_norm():
+    check_grad("layer_norm",
+               {"X": {"x": _r(3, 8)}, "Scale": {"s": _r(8, seed=1)},
+                "Bias": {"b": _r(8, seed=2)}},
+               attrs={"begin_norm_axis": 1}, out_slot="Y",
+               extra_out_slots=("Mean", "Variance"), rtol=2e-2, atol=1e-3)
+
+
+def test_grad_lookup_table():
+    ids = np.array([[1], [3], [0]], dtype=np.int32)
+    check_grad("lookup_table",
+               {"W": {"w": _r(5, 4)}, "Ids": {"ids": ids}},
+               grad_vars=["w"])
+
+
+def test_grad_cross_entropy():
+    probs = _r(3, 4, lo=0.1, hi=0.9)
+    probs = probs / probs.sum(axis=1, keepdims=True)
+    label = np.array([[0], [2], [1]], dtype=np.int32)
+    check_grad("cross_entropy",
+               {"X": {"x": probs}, "Label": {"l": label}},
+               out_slot="Y", grad_vars=["x"], rtol=2e-2)
+
+
+def test_grad_softmax_with_cross_entropy():
+    logits = _r(3, 5, lo=-1.0, hi=1.0)
+    label = np.array([[0], [2], [4]], dtype=np.int32)
+    check_grad("softmax_with_cross_entropy",
+               {"Logits": {"x": logits}, "Label": {"l": label}},
+               out_slot="Loss", extra_out_slots=("Softmax",),
+               grad_vars=["x"], rtol=2e-2)
+
+
+def test_grad_sigmoid_ce_logits():
+    check_grad("sigmoid_cross_entropy_with_logits",
+               {"X": {"x": _r(3, 4, lo=-1, hi=1)},
+                "Label": {"l": _r(3, 4, seed=1, lo=0, hi=1)}},
+               grad_vars=["x"])
+
+
+def test_grad_square_error_cost():
+    check_grad("square_error_cost",
+               {"X": {"x": _r(3, 2)}, "Y": {"y": _r(3, 2, seed=1)}})
+
+
+def test_grad_batch_norm_train():
+    check_grad("batch_norm",
+               {"X": {"x": _r(4, 3, 2, 2)}, "Scale": {"s": _r(3, seed=1)},
+                "Bias": {"b": _r(3, seed=2)},
+                "Mean": {"m": np.zeros(3, np.float32)},
+                "Variance": {"v": np.ones(3, np.float32)}},
+               attrs={"is_test": False, "momentum": 0.9, "epsilon": 1e-5},
+               out_slot="Y",
+               extra_out_slots=("MeanOut", "VarianceOut", "SavedMean",
+                                "SavedVariance"),
+               grad_vars=["x", "s", "b"], delta=5e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_grad_sum_fanin():
+    """A var consumed by two ops must receive the sum of both grads
+    (reference: backward.py:148 sum insertion)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        x = block.create_var(name="x", shape=[2, 2], dtype="float32",
+                             stop_gradient=False)
+        a = layers.scale(block.var("x"), scale=2.0)
+        b = layers.scale(block.var("x"), scale=3.0)
+        s = layers.elementwise_add(a, b)
+        loss = layers.reduce_sum(s)
+        from paddle_tpu.ops.grad_ops import append_backward_desc
+        gmap = append_backward_desc(main.desc.global_block, loss.name)
+        main.desc.bump_version()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    (gx,) = exe.run(main, feed={"x": xv}, fetch_list=[gmap["x"]])
+    np.testing.assert_allclose(gx, np.full((2, 2), 5.0), rtol=1e-6)
