@@ -70,12 +70,26 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    @staticmethod
+    def _dist_key(dist):
+        # key by content, not identity: a user mutating the (mutable)
+        # DistributeConfig between runs must get a fresh compile
+        if dist is None:
+            return None
+        return (dist.mesh, dist.data_axis,
+                tuple(sorted((k, tuple(v))
+                             for k, v in (dist.param_axes or {}).items())),
+                dist.reduce_strategy)
+
     def _compiled(self, program, feed_names, fetch_names, is_test: bool):
         desc = program.desc if hasattr(program, "desc") else program
-        key = (desc.version_token, tuple(feed_names), tuple(fetch_names), is_test)
+        dist = getattr(program, "dist_config", None)
+        key = (desc.version_token, tuple(feed_names), tuple(fetch_names),
+               is_test, self._dist_key(dist))
         cb = self._cache.get(key)
         if cb is None:
-            cb = CompiledBlock(desc, 0, feed_names, fetch_names, is_test=is_test)
+            cb = CompiledBlock(desc, 0, feed_names, fetch_names,
+                               is_test=is_test, dist=dist)
             self._cache[key] = cb
         return cb
 
@@ -99,13 +113,31 @@ class Executor:
         cb = self._compiled(program, feed_names, fetch_names, is_test)
 
         feeds = {}
+        dist_mode = cb.dist is not None and cb.dist.mesh is not None
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
+            if isinstance(val, jax.Array):
+                # already on device (e.g. a prefetched pipeline batch or a
+                # benchmark-resident tensor) — keep it device-side, but
+                # still honour the declared dtype and, under a mesh, reshard
+                # (device-to-device) to the feed's sharding so a committed
+                # single-device array doesn't clash with in_shardings
+                if want is not None and str(val.dtype) != want:
+                    val = val.astype(want)
+                sh = cb.feed_sharding(name) if dist_mode else None
+                if sh is not None:
+                    val = jax.device_put(val, sh)
+                feeds[name] = val
+                continue
             arr = np.asarray(val)
             if want is not None and str(arr.dtype) != want:
                 arr = arr.astype(want)
-            feeds[name] = jax.device_put(arr, self.device)
+            if dist_mode:
+                # jit's in_shardings places/shards the host array itself
+                feeds[name] = arr
+            else:
+                feeds[name] = jax.device_put(arr, self.device)
 
         self._step += 1
         outs = cb(scope, feeds, self._step)

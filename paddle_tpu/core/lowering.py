@@ -28,32 +28,51 @@ import paddle_tpu.ops  # noqa: F401
 @dataclass(frozen=True)
 class BlockSignature:
     """Static analysis of a block: which names are feeds, which come from the
-    scope (split into mutated state vs read-only consts), which are fetched."""
+    scope (split into mutated state vs read-only consts), which are fetched,
+    and which ops are live for this (feed, fetch) signature."""
 
     feed_names: Tuple[str, ...]
     fetch_names: Tuple[str, ...]
     state_names: Tuple[str, ...]       # scope vars read and/or (re)written
     const_names: Tuple[str, ...]       # scope vars only read
     created_persistable: Tuple[str, ...]  # persistables first created here
+    live_ops: Tuple[int, ...]          # indices of ops that execute
 
 
 def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
                   fetch_names: Sequence[str]) -> BlockSignature:
+    def is_persistable(n: str) -> bool:
+        return block.has_var(n) and block.var(n).persistable
+
+    # Liveness: an op executes if it contributes to a fetch or writes
+    # persistable state. The reference interprets every op in the block
+    # (executor.cc:448) and errors on un-fed inputs; here dead subgraphs
+    # (e.g. the loss ops of a clone(for_test) program when only the
+    # prediction is fetched) are pruned at trace time, so their feeds are
+    # not required.
+    needed = set(fetch_names)
+    live_rev: List[int] = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if op.type in ("feed", "fetch"):
+            continue
+        outs = op.output_names()
+        if (set(outs) & needed) or any(is_persistable(n) for n in outs):
+            live_rev.append(i)
+            needed.update(op.input_names())
+    live = tuple(reversed(live_rev))
+
     defined = set(feed_names)
     from_scope: List[str] = []
     written: set = set()
-    for op in block.ops:
-        if op.type in ("feed", "fetch"):
-            continue
+    for i in live:
+        op = block.ops[i]
         for name in op.input_names():
             if name not in defined and name not in from_scope:
                 from_scope.append(name)
         for name in op.output_names():
             defined.add(name)
             written.add(name)
-
-    def is_persistable(n: str) -> bool:
-        return block.has_var(n) and block.var(n).persistable
 
     state, const, created = [], [], []
     for n in from_scope:
@@ -76,6 +95,7 @@ def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
         state_names=tuple(state),
         const_names=tuple(const),
         created_persistable=tuple(sorted(created)),
+        live_ops=live,
     )
 
 
@@ -93,12 +113,20 @@ def build_block_fn(program: ir.ProgramDesc, block_idx: int,
         env.update(consts)
         env.update(state)
         env.update(feeds)
-        base_key = jax.random.fold_in(jax.random.key(seed0), step_seed)
-        for i, op in enumerate(block.ops):
-            if op.type in ("feed", "fetch"):
-                continue
+        # Randomness semantics mirror the reference's seed convention
+        # (python/paddle/fluid/framework.py Program.random_seed): a nonzero
+        # program seed makes every run reproducible (interpreter semantics —
+        # fixed per-op seeds); seed 0 draws fresh randomness each step.
+        if seed0 != 0:
+            base_key = jax.random.key(seed0)
+        else:
+            base_key = jax.random.fold_in(jax.random.key(0), step_seed)
+        step_base = base_key
+        for i in sig.live_ops:
+            op = block.ops[i]
             spec = get_op(op.type)
-            ctx = EmitContext(base_key=base_key, op_index=i, is_test=is_test)
+            ctx = EmitContext(base_key=base_key, step_base_key=step_base,
+                              op_index=i, is_test=is_test)
             ins = {}
             for slot, names in op.inputs.items():
                 try:
@@ -128,24 +156,80 @@ class CompiledBlock:
     """A compiled executable for (program block, feed/fetch signature) —
     the analogue of the reference's per-program executor cache
     (reference: executor.py:222 _get_program_cache_key / use_program_cache),
-    except the cached object is an XLA executable, not a list of op objects."""
+    except the cached object is an XLA executable, not a list of op objects.
+
+    With a DistributeConfig, this is also the ParallelExecutor replacement
+    (reference: parallel_executor.cc:191): feeds shard over the mesh's data
+    axis, params replicate (or shard per param_axes), and XLA emits the
+    gradient reduction over ICI that the reference ran as NCCL allreduce
+    op-handles (details/all_reduce_op_handle.cc:103)."""
 
     def __init__(self, program: ir.ProgramDesc, block_idx: int,
                  feed_names: Sequence[str], fetch_names: Sequence[str],
-                 is_test: bool = False, donate: bool = True):
+                 is_test: bool = False, donate: bool = True, dist=None):
         block = program.block(block_idx)
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block
+        self.dist = dist
         fn = build_block_fn(program, block_idx, self.sig, is_test=is_test)
+        jit_kwargs = {}
+        if donate:
+            jit_kwargs["donate_argnums"] = (0,)
+        if dist is not None and dist.mesh is not None:
+            jit_kwargs["in_shardings"] = self._input_shardings()
         # donate the mutated-state dict: optimizer updates reuse the same HBM
         # buffers (reference keeps params in-place in the Scope; we get the
         # same via XLA input_output_aliasing)
-        self.fn = jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
+        self.fn = jax.jit(fn, **jit_kwargs)
+
+    def _input_shardings(self):
+        import re
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.dist.mesh
+        repl = NamedSharding(mesh, P())
+
+        def param_sharding(name):
+            for pattern, axes in (self.dist.param_axes or {}).items():
+                if re.fullmatch(pattern, name):
+                    return NamedSharding(mesh, P(*axes))
+            return repl
+
+        def feed_sharding(name):
+            axis = self.dist.data_axis
+            if axis is None or axis not in mesh.axis_names:
+                return repl
+            v = self.block.var(name) if self.block.has_var(name) else None
+            if v is not None and v.shape and len(v.shape) >= 1:
+                d0 = v.shape[0]
+                axis_size = mesh.shape[axis]
+                # shard the batch dim whether declared dynamic (-1) or as a
+                # concrete size divisible by the data axis
+                if d0 == -1 or (d0 > 0 and d0 % axis_size == 0):
+                    ndim = len(v.shape)
+                    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+                import warnings
+                warnings.warn(
+                    f"feed {name!r} batch dim {d0} not divisible by data "
+                    f"axis {axis!r} (size {axis_size}); replicating — every "
+                    f"device computes the full batch")
+            return repl
+
+        state_sh = {n: param_sharding(n) for n in self.sig.state_names}
+        const_sh = {n: param_sharding(n) for n in self.sig.const_names}
+        feed_sh = {n: feed_sharding(n) for n in self.sig.feed_names}
+        return (state_sh, const_sh, feed_sh, repl)
 
     def feed_dtype(self, name: str) -> Optional[str]:
         if self.block.has_var(name):
             return self.block.var(name).dtype
         return None
+
+    def feed_sharding(self, name: str):
+        if self.dist is None or self.dist.mesh is None:
+            return None
+        if not hasattr(self, "_feed_sh_cache"):
+            self._feed_sh_cache = self._input_shardings()[2]
+        return self._feed_sh_cache.get(name)
 
     def __call__(self, scope, feeds: Dict[str, Any], step_seed: int):
         state = {}
@@ -161,7 +245,14 @@ class CompiledBlock:
         for n in self.sig.const_names:
             v = scope.find_var(n)
             if v is None:
-                raise RuntimeError(f"variable {n!r} not found in scope")
+                if self.block.has_var(n) and not self.block.var(n).persistable:
+                    raise RuntimeError(
+                        f"variable {n!r} is neither fed nor initialized — "
+                        f"add it to the feed dict (an op in the program "
+                        f"consumes it)")
+                raise RuntimeError(
+                    f"persistable variable {n!r} not found in scope — run "
+                    f"the startup program first")
             consts[n] = v
         fetches, new_state = self.fn(state, consts, feeds, np.uint32(step_seed))
         for n, v in new_state.items():

@@ -34,13 +34,18 @@ import jax
 class EmitContext:
     """Per-op emission context.
 
-    rng keys are derived deterministically from (program seed, step seed,
-    op index) so that a re-emission of the same op (e.g. inside a vjp
-    recompute) sees the same randomness — the functional replacement for the
-    reference's per-op `seed` attributes (e.g. dropout_op.cc attr "seed").
+    Two rng streams, both deterministic given (program seed, op index) so a
+    re-emission of the same op inside a vjp recompute sees identical
+    randomness (the functional replacement for the reference's per-op `seed`
+    attrs, e.g. dropout_op.cc):
+
+    - key():      program-level — initializers; re-running the startup
+                  program reproduces the same parameters.
+    - step_key(): per-execution — dropout/sampling vary across steps.
     """
 
-    base_key: Any  # jax PRNG key for this program execution
+    base_key: Any              # key(program.random_seed)
+    step_base_key: Any = None  # fold_in(base_key, step_seed)
     op_index: int = 0
     is_test: bool = False
     # set during multi-device lowering: the mesh and the data-parallel axis
@@ -48,7 +53,13 @@ class EmitContext:
     data_axis: Optional[str] = None
 
     def key(self, salt: int = 0):
-        return jax.random.fold_in(jax.random.fold_in(self.base_key, self.op_index), salt)
+        return jax.random.fold_in(
+            jax.random.fold_in(self.base_key, self.op_index), salt)
+
+    def step_key(self, salt: int = 0):
+        base = self.step_base_key if self.step_base_key is not None else self.base_key
+        return jax.random.fold_in(
+            jax.random.fold_in(base, self.op_index), salt)
 
 
 @dataclass

@@ -1,0 +1,12 @@
+"""paddle_tpu.data — host-side input pipeline + dataset zoo.
+
+Replaces the reference's in-graph reader-op stack (operators/reader/:
+create_py_reader_op.cc, buffered_reader.cc double-buffering, blocking_queue.h;
+python layers/io.py py_reader :485) with a host prefetcher that overlaps
+CPU batch prep + H2D transfer with TPU compute — the TPU-idiomatic shape of
+the same capability.
+"""
+
+from paddle_tpu.data.pipeline import DataLoader, PyReader
+
+__all__ = ["DataLoader", "PyReader"]

@@ -1,0 +1,108 @@
+"""Prefetching input pipeline.
+
+Capability parity with the reference's py_reader + double_buffer
+(reference: python/paddle/fluid/layers/io.py:485 py_reader,
+operators/reader/buffered_reader.cc, blocking_queue.h): a producer thread
+converts numpy batches and issues async H2D `device_put`s into a bounded
+queue, so the next batch's transfer overlaps the current step's compute —
+double-buffering without reader ops in the graph.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+
+class DataLoader:
+    """Iterate feed dicts with device-side prefetch.
+
+    loader = DataLoader(feed_names, reader, capacity=2)
+    for feeds in loader:         # feeds values are on-device jax.Arrays
+        exe.run(main, feed=feeds, fetch_list=[...])
+    """
+
+    _END = object()
+
+    def __init__(self, feed_names, batch_reader: Callable[[], Iterable],
+                 capacity: int = 2, device=None, feeder=None):
+        self.feed_names = list(feed_names)
+        self.batch_reader = batch_reader
+        self.capacity = capacity
+        self.device = device
+        self.feeder = feeder
+
+    def _convert(self, batch) -> Dict[str, object]:
+        import jax
+        if isinstance(batch, dict):
+            arrays = batch
+        elif self.feeder is not None:
+            arrays = self.feeder.feed(batch)
+        else:
+            cols = list(zip(*batch))
+            arrays = {n: np.asarray(c) for n, c in zip(self.feed_names, cols)}
+        if self.device is not None:
+            return {k: jax.device_put(v, self.device)
+                    for k, v in arrays.items()}
+        return {k: jax.device_put(v) for k, v in arrays.items()}
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
+        exc: list = []
+
+        def produce():
+            try:
+                for b in self.batch_reader():
+                    q.put(self._convert(b))
+            except Exception as e:  # surfaced on the consumer side
+                exc.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                if exc:
+                    raise exc[0]
+                return
+            yield item
+
+
+class PyReader:
+    """API-parity shim for fluid.layers.py_reader users
+    (reference: io.py:485): decorate_paddle_reader + start()/reset() +
+    iteration, backed by DataLoader."""
+
+    def __init__(self, feed_list, capacity: int = 2, use_double_buffer=True,
+                 iterable: bool = True):
+        self.feed_vars = list(feed_list)
+        self.capacity = capacity
+        self._reader = None
+        self._loader: Optional[DataLoader] = None
+
+    def decorate_paddle_reader(self, reader, places=None):
+        from paddle_tpu.fluid.data_feeder import DataFeeder
+        feeder = DataFeeder(self.feed_vars)
+        names = [v if isinstance(v, str) else v.name for v in self.feed_vars]
+        self._loader = DataLoader(names, reader, capacity=self.capacity,
+                                  feeder=feeder)
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, reader, places=None):
+        names = [v if isinstance(v, str) else v.name for v in self.feed_vars]
+        self._loader = DataLoader(names, reader, capacity=self.capacity)
+
+    def start(self):
+        self._iter = iter(self._loader)
+
+    def reset(self):
+        self._iter = None
+
+    def __iter__(self):
+        return iter(self._loader)

@@ -1,0 +1,12 @@
+"""Dataset zoo (reference: python/paddle/dataset/ — mnist, cifar,
+uci_housing, imdb, movielens, wmt14/16, flowers...).
+
+Loaders look for cached arrays under $PADDLE_TPU_DATA_HOME (same role as the
+reference's ~/.cache/paddle/dataset download cache); in air-gapped
+environments they fall back to deterministic synthetic data with the real
+shapes/vocab sizes so training pipelines and benchmarks run unchanged.
+"""
+
+from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing
+
+__all__ = ["cifar", "imdb", "mnist", "uci_housing"]

@@ -1,0 +1,37 @@
+"""CIFAR reader (reference: python/paddle/dataset/cifar.py — train10/test10,
+train100/test100 yielding (3072-float image, label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+
+def _reader(split: str, classes: int, n_synth: int, seed: int):
+    def reader():
+        data = common.cached_npz(f"cifar{classes}_{split}")
+        if data is not None:
+            xs, ys = data["x"], data["y"]
+        else:
+            xs, ys = common.synthetic_classification(
+                n_synth, (3, 32, 32), classes, seed)
+        for x, y in zip(xs, ys):
+            yield x.reshape(3072).astype(np.float32), int(y)
+    return reader
+
+
+def train10():
+    return _reader("train", 10, 1024, 70)
+
+
+def test10():
+    return _reader("test", 10, 256, 71)
+
+
+def train100():
+    return _reader("train", 100, 1024, 72)
+
+
+def test100():
+    return _reader("test", 100, 256, 73)

@@ -1,0 +1,30 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py —
+download cache + md5; here: local cache lookup with synthetic fallback)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def cached_npz(name: str):
+    path = os.path.join(DATA_HOME, name + ".npz")
+    if os.path.exists(path):
+        return np.load(path)
+    return None
+
+
+def synthetic_classification(n, feature_shape, n_classes, seed):
+    """Deterministic learnable synthetic data: labels from a fixed random
+    projection of the features."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, *feature_shape).astype(np.float32)
+    proj = np.random.RandomState(seed + 1).rand(
+        int(np.prod(feature_shape)), n_classes)
+    y = np.argmax(x.reshape(n, -1) @ proj, axis=1).astype(np.int64)
+    return x, y

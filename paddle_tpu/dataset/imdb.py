@@ -1,0 +1,41 @@
+"""IMDB sentiment reader (reference: python/paddle/dataset/imdb.py —
+word-id sequences + binary label; feeds the LSTM text-cls benchmark)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+VOCAB_SIZE = 5147  # reference vocab size order of magnitude
+
+
+def word_dict():
+    return {i: i for i in range(VOCAB_SIZE)}
+
+
+def _reader(split: str, n: int, seed: int, maxlen: int = 100):
+    def reader():
+        data = common.cached_npz(f"imdb_{split}")
+        if data is not None:
+            xs, ys = data["x"], data["y"]
+            for x, y in zip(xs, ys):
+                yield list(x), int(y)
+            return
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(10, maxlen))
+            label = int(rng.randint(0, 2))
+            # class-dependent token distribution → learnable
+            lo = 0 if label == 0 else VOCAB_SIZE // 2
+            ids = rng.randint(lo, lo + VOCAB_SIZE // 2, size=length)
+            yield ids.tolist(), label
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train", 1024, 90)
+
+
+def test(word_idx=None):
+    return _reader("test", 256, 91)

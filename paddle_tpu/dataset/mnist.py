@@ -1,0 +1,29 @@
+"""MNIST reader (reference: python/paddle/dataset/mnist.py — train()/test()
+yielding (784-float image, int label) samples)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+
+def _reader(split: str, n_synth: int, seed: int):
+    def reader():
+        data = common.cached_npz(f"mnist_{split}")
+        if data is not None:
+            xs, ys = data["x"], data["y"]
+        else:
+            xs, ys = common.synthetic_classification(
+                n_synth, (784,), 10, seed)
+        for x, y in zip(xs, ys):
+            yield x.reshape(784).astype(np.float32) / 1.0, int(y)
+    return reader
+
+
+def train():
+    return _reader("train", 2048, 60)
+
+
+def test():
+    return _reader("test", 512, 61)

@@ -1,0 +1,31 @@
+"""UCI housing reader (reference: python/paddle/dataset/uci_housing.py —
+13-feature regression; the fit_a_line book test's dataset)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+
+def _reader(split: str, n: int, seed: int):
+    def reader():
+        data = common.cached_npz(f"uci_housing_{split}")
+        if data is not None:
+            xs, ys = data["x"], data["y"]
+        else:
+            rng = np.random.RandomState(seed)
+            xs = rng.rand(n, 13).astype(np.float32)
+            w = np.random.RandomState(7).rand(13, 1)
+            ys = (xs @ w + 0.1 * rng.rand(n, 1)).astype(np.float32)
+        for x, y in zip(xs, ys):
+            yield x.astype(np.float32), y.reshape(1).astype(np.float32)
+    return reader
+
+
+def train():
+    return _reader("train", 404, 80)
+
+
+def test():
+    return _reader("test", 102, 81)

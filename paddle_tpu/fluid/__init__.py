@@ -8,10 +8,27 @@ from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor,
 from paddle_tpu.core.scope import Scope, global_scope
 from paddle_tpu.fluid import backward, clip, initializer, layers, nets
 from paddle_tpu.fluid import optimizer, param_attr, regularizer, unique_name
+from paddle_tpu.fluid import io, learning_rate_scheduler, metrics, profiler
+from paddle_tpu.fluid.data_feeder import DataFeeder
 from paddle_tpu.fluid.framework import (Program, default_main_program,
                                         default_startup_program,
                                         program_guard)
 from paddle_tpu.fluid.param_attr import ParamAttr
+from paddle_tpu.fluid.compiler import (BuildStrategy, CompiledProgram,
+                                       ExecutionStrategy)
+from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """reference: transpiler/memory_optimization_transpiler.py — liveness-
+    based var reuse. No-op on TPU: XLA's buffer assignment already performs
+    liveness analysis and in-place reuse on the whole fused program."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program
 
 __all__ = [
     "CPUPlace", "CUDAPlace", "Executor", "TPUPlace",
@@ -20,4 +37,7 @@ __all__ = [
     "param_attr", "regularizer", "unique_name",
     "Program", "default_main_program", "default_startup_program",
     "program_guard", "ParamAttr",
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "io", "learning_rate_scheduler", "metrics", "profiler", "DataFeeder",
+    "ParallelExecutor", "memory_optimize", "release_memory",
 ]

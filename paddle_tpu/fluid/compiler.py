@@ -1,0 +1,93 @@
+"""CompiledProgram: build/exec strategy front-end.
+
+Capability parity with the reference (python/paddle/fluid/compiler.py:33
+CompiledProgram, :72 with_data_parallel; BuildStrategy/ExecutionStrategy
+from framework/details/build_strategy.h:34). TPU-native semantics:
+`with_data_parallel` attaches a DistributeConfig (mesh + data axis) instead
+of constructing a C++ ParallelExecutor; the Executor lowers the same program
+with sharded feeds and XLA inserts the gradient reductions over ICI — the
+loss-scale (1/nranks, multi_devices_graph_pass.cc:422) falls out of `mean`
+over the global batch, so no explicit ScaleLossGrad op exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from paddle_tpu.parallel.mesh import DistributeConfig, get_default_mesh, make_mesh
+
+
+@dataclass
+class BuildStrategy:
+    """reference: build_strategy.h:34 — accepted knobs; TPU-meaningful ones
+    map onto DistributeConfig, the rest are no-ops under XLA (fusion and
+    memory-reuse passes are the compiler's job here)."""
+
+    reduce_strategy: str = "all_reduce"          # kAllReduce | kReduce
+    gradient_scale_strategy: str = "coeff_one"   # loss scaling is implicit
+    memory_optimize: bool = False
+    enable_inplace: bool = False
+    fuse_elewise_add_act_ops: bool = False
+    debug_graphviz_path: str = ""
+
+
+@dataclass
+class ExecutionStrategy:
+    """reference: execution_strategy.h — thread counts are meaningless under
+    XLA's single-executable dispatch; kept for API parity."""
+
+    num_threads: int = 0
+    num_iteration_per_drop_scope: int = 1
+    allow_op_delay: bool = False
+
+
+class CompiledProgram:
+    """reference: compiler.py:33."""
+
+    def __init__(self, program):
+        self._program = program
+        self._dist: Optional[DistributeConfig] = None
+        self.build_strategy: Optional[BuildStrategy] = None
+        self.exec_strategy: Optional[ExecutionStrategy] = None
+
+    @property
+    def program(self):
+        return self._program
+
+    @property
+    def desc(self):
+        return self._program.desc
+
+    @property
+    def _is_test(self):
+        return getattr(self._program, "_is_test", False)
+
+    @property
+    def dist_config(self) -> Optional[DistributeConfig]:
+        return self._dist
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           share_vars_from=None, places=None,
+                           mesh=None, data_axis: str = "dp"):
+        """reference: compiler.py:72 — returns self, configured to run the
+        program data-parallel over all devices (or the given mesh)."""
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = exec_strategy or ExecutionStrategy()
+        if mesh is None:
+            mesh = get_default_mesh()
+        if mesh is None:
+            mesh = make_mesh(devices=places)
+        reduce = (self.build_strategy.reduce_strategy
+                  if self.build_strategy else "all_reduce")
+        self._dist = DistributeConfig(mesh=mesh, data_axis=data_axis,
+                                      reduce_strategy=reduce)
+        return self
+
+    def with_sharding(self, dist: DistributeConfig):
+        """TPU-native extension: arbitrary mesh/param shardings (tp/pp/sp
+        axes) — the capability superset of the transpiler modes."""
+        self._dist = dist
+        return self

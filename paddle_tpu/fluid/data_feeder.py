@@ -1,0 +1,46 @@
+"""DataFeeder (reference: python/paddle/fluid/data_feeder.py — converts a
+minibatch of python samples into the feed dict of dense arrays; the LoD
+conversion becomes padding + optional sequence-length arrays, since XLA has
+no ragged tensors — SURVEY §5 long-context note)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_vars = list(feed_list)
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        batch = list(iterable)
+        out: Dict[str, np.ndarray] = {}
+        for i, var in enumerate(self.feed_vars):
+            name = var if isinstance(var, str) else var.name
+            dtype = "float32" if isinstance(var, str) else var.dtype
+            shape = None if isinstance(var, str) else var.shape
+            cols = [sample[i] for sample in batch]
+            arr = self._to_dense(cols, dtype, shape)
+            out[name] = arr
+        return out
+
+    @staticmethod
+    def _to_dense(cols: List, dtype: str, shape) -> np.ndarray:
+        first = np.asarray(cols[0])
+        if first.ndim >= 1 and any(np.asarray(c).shape != first.shape
+                                   for c in cols):
+            # variable-length sequences: pad to max length (LoD capability
+            # via padding + masking rather than offset tables)
+            maxlen = max(np.asarray(c).shape[0] for c in cols)
+            trailing = np.asarray(cols[0]).shape[1:]
+            out = np.zeros((len(cols), maxlen) + trailing, dtype=dtype)
+            for j, c in enumerate(cols):
+                c = np.asarray(c, dtype=dtype)
+                out[j, :c.shape[0]] = c
+            return out
+        arr = np.asarray(cols, dtype=dtype)
+        if shape is not None and len(shape) >= 2 and arr.ndim == 1:
+            arr = arr.reshape(len(cols), *[d for d in shape[1:]])
+        return arr

@@ -1,0 +1,182 @@
+"""Model/checkpoint I/O.
+
+Capability parity with the reference (python/paddle/fluid/io.py —
+save_vars/save_persistables :222,270, load_persistables :490,
+save_inference_model :570, load_inference_model :704). The reference builds
+save/load op programs executed by the C++ Executor (operators/save_op.cc);
+TPU-native design: persistables live as device arrays in the Scope, saved
+host-side as one .npy per var plus a JSON manifest (one-file-per-var matches
+the reference's default layout), and the inference export serializes the
+pruned ProgramDesc (ir.py JSON) — the analogue of the binary __model__ file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.core import ir
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.fluid import framework
+
+_MODEL_FILENAME = "__model__.json"
+_MANIFEST = "__manifest__.json"
+
+
+def _persistable_names(program) -> List[str]:
+    names = []
+    for vd in program.desc.global_block.vars.values():
+        if vd.persistable:
+            names.append(vd.name)
+    return sorted(set(names))
+
+
+def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = None,
+              predicate=None, filename=None):
+    """reference: io.py:222."""
+    main_program = main_program or framework.default_main_program()
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if vars is None:
+        vars = _persistable_names(main_program)
+        if predicate is not None:
+            vars = [v for v in vars
+                    if predicate(main_program.global_block().var(v))]
+    saved = []
+    for name in vars:
+        val = scope.find_var(name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
+                arr)
+        saved.append(name)
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump({"vars": saved}, f)
+    return saved
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:270."""
+    return save_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None,
+              vars: Optional[List[str]] = None, predicate=None,
+              filename=None):
+    """reference: io.py load_vars."""
+    scope = global_scope()
+    if vars is None:
+        with open(os.path.join(dirname, _MANIFEST)) as f:
+            vars = json.load(f)["vars"]
+    import jax
+    loaded = []
+    for name in vars:
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no saved tensor for var {name!r} at {path}")
+        scope.set_var(name, jax.device_put(np.load(path)))
+        loaded.append(name)
+    return loaded
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:490."""
+    return load_vars(executor, dirname, main_program)
+
+
+def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
+                         executor, main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """reference: io.py:570 — prune to feed/fetch targets + serialize."""
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    target_names = [v if isinstance(v, str) else v.name for v in target_vars]
+
+    pruned_block = ir.prune_block(main_program.desc.global_block,
+                                  target_names, feeded_var_names)
+    pruned = ir.ProgramDesc()
+    pruned.random_seed = main_program.desc.random_seed
+    pruned.blocks = [pruned_block]
+
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME), "w") as f:
+        json.dump({
+            "program": pruned.to_dict(),
+            "feed_names": list(feeded_var_names),
+            "fetch_names": target_names,
+        }, f)
+    # save only params the pruned program references
+    needed = [n for n, vd in pruned_block.vars.items() if vd.persistable]
+    save_vars(executor, dirname, main_program, vars=needed)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference: io.py:704 — returns (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME)) as f:
+        payload = json.load(f)
+    desc = ir.ProgramDesc()
+    restored = desc.parse_from_string(
+        json.dumps(payload["program"]).encode())
+    program = framework.Program()
+    program.desc = restored
+    program.blocks = [framework.Block(program, i)
+                      for i in range(len(restored.blocks))]
+    for b in program.blocks:
+        for name, vd in b.desc.vars.items():
+            b.vars[name] = framework.Variable(b, vd)
+        b.ops = [framework.Operator(b, od) for od in b.desc.ops]
+    program._is_test = True
+    load_vars(executor, dirname,
+              vars=[n for n, vd in restored.global_block.vars.items()
+                    if vd.persistable])
+    return program, payload["feed_names"], payload["fetch_names"]
+
+
+# -- checkpointing (reference: io.py save_checkpoint/load_checkpoint era API
+# + distributed checkpoint_notify capability, SURVEY §5) --------------------
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
+                    main_program=None, step=None, max_num_checkpoints=3):
+    main_program = main_program or framework.default_main_program()
+    step = step if step is not None else _latest_step(checkpoint_dir) + 1
+    d = os.path.join(checkpoint_dir, f"checkpoint_{step}")
+    save_persistables(executor, d, main_program)
+    # retention policy mirrors the reference's max_num_checkpoints
+    steps = sorted(_all_steps(checkpoint_dir))
+    for s in steps[:-max_num_checkpoints]:
+        import shutil
+        shutil.rmtree(os.path.join(checkpoint_dir, f"checkpoint_{s}"),
+                      ignore_errors=True)
+    return step
+
+
+def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
+    step = serial if serial is not None else _latest_step(checkpoint_dir)
+    if step < 0:
+        raise FileNotFoundError(f"no checkpoints under {checkpoint_dir}")
+    d = os.path.join(checkpoint_dir, f"checkpoint_{step}")
+    load_persistables(executor, d, main_program)
+    return step
+
+
+def _all_steps(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("checkpoint_"):
+            try:
+                out.append(int(name.split("_")[1]))
+            except ValueError:
+                pass
+    return out
+
+
+def _latest_step(checkpoint_dir):
+    steps = _all_steps(checkpoint_dir)
+    return max(steps) if steps else -1

@@ -1,0 +1,169 @@
+"""Python-side streaming metrics (reference: python/paddle/fluid/metrics.py
+— MetricBase, CompositeMetric, Precision, Recall, Accuracy, Auc,
+EditDistance; host accumulators updated from fetched numpy values)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    """reference: metrics.py Accuracy — weighted running mean of batch
+    accuracies (pairs with layers.accuracy fetches)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no updates yet")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """reference: metrics.py Precision (binary)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """reference: metrics.py Recall (binary)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(MetricBase):
+    """reference: metrics.py Auc — trapezoidal AUC over threshold buckets."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1)
+        self._stat_neg = np.zeros(self._num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_prob = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
+        bucket = np.clip((pos_prob * self._num_thresholds).astype(int), 0,
+                         self._num_thresholds)
+        for b, l in zip(bucket, labels):
+            if l > 0:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def eval(self):
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tp_prev = np.concatenate([[0.0], tp[:-1]])
+        fp_prev = np.concatenate([[0.0], fp[:-1]])
+        area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+        return float(area / (tot_pos * tot_neg))
+
+
+class EditDistance(MetricBase):
+    """reference: metrics.py EditDistance — running mean distance +
+    sequence-error rate."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        distances = np.asarray(distances).reshape(-1)
+        self.total_distance += float(distances.sum())
+        self.seq_num += len(distances)
+        self.instance_error += int(np.sum(distances > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no updates yet")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)

@@ -1,0 +1,34 @@
+"""ParallelExecutor front-end (reference: python/paddle/fluid/
+parallel_executor.py:41). Thin wrapper over CompiledProgram.with_data_parallel
++ Executor — on TPU there is no separate multi-device engine to construct;
+the same XLA path runs with sharded inputs over the mesh."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.core.executor import Executor, TPUPlace
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.compiler import (BuildStrategy, CompiledProgram,
+                                       ExecutionStrategy)
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda: bool = False, loss_name: Optional[str] = None,
+                 main_program=None, share_vars_from=None,
+                 exec_strategy: Optional[ExecutionStrategy] = None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 num_trainers: int = 1, trainer_id: int = 0, scope=None):
+        self._program = main_program or framework.default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy)
+        self._exe = Executor(TPUPlace())
+        self._scope = scope
+
+    def run(self, fetch_list: List, feed=None, feed_dict=None,
+            return_numpy: bool = True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)

@@ -1,0 +1,98 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py — profiler() context
+manager :221, start/stop_profiler :125,165, cuda_profiler :39, reset_profiler;
+C++ side platform/profiler.cc + CUPTI DeviceTracer + tools/timeline.py).
+
+TPU-native design: device-side tracing is jax.profiler (XPlane → TensorBoard
+/ Perfetto, replacing the CUPTI→chrome-trace path); host-side per-run event
+timing is kept as a lightweight table with the reference's sorted-summary
+report (EventSortingKey profiler.h:114)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+_events = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": float("inf"),
+                               "max": 0.0})
+_active = False
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """Host-side RAII event (reference: platform/profiler.h:27 RecordEvent)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        e = _events[name]
+        e["calls"] += 1
+        e["total"] += dt
+        e["min"] = min(e["min"], dt)
+        e["max"] = max(e["max"], dt)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def start_profiler(state: str = "All", tracer_option: Optional[str] = None,
+                   trace_dir: Optional[str] = None):
+    """reference: profiler.py:125. state/tracer_option accepted for parity;
+    device tracing delegates to jax.profiler when a trace_dir is given."""
+    global _active
+    _active = True
+    if trace_dir:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key: Optional[str] = "total",
+                  profile_path: Optional[str] = None, trace_dir=None):
+    """reference: profiler.py:165 — prints the per-event summary table."""
+    global _active
+    if trace_dir:
+        import jax
+        jax.profiler.stop_trace()
+    if not _active:
+        return
+    _active = False
+    rows = []
+    for name, e in _events.items():
+        ave = e["total"] / max(e["calls"], 1)
+        rows.append((name, e["calls"], e["total"], ave, e["min"], e["max"]))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key or "total", 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    if rows:
+        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Ave(s)':>12}"
+              f"{'Min(s)':>12}{'Max(s)':>12}")
+        for r in rows:
+            print(f"{r[0]:<40}{r[1]:>8}{r[2]:>12.6f}{r[3]:>12.6f}"
+                  f"{r[4]:>12.6f}{r[5]:>12.6f}")
+    if profile_path:
+        with open(profile_path, "w") as f:
+            for r in rows:
+                f.write(",".join(str(x) for x in r) + "\n")
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """reference: profiler.py:221 fluid.profiler.profiler()."""
+    reset_profiler()
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path, trace_dir=trace_dir)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """reference: profiler.py:39 — nvprof passthrough; no TPU analogue
+    (use trace_dir→TensorBoard instead). Accepted as a no-op for parity."""
+    yield

@@ -1,0 +1,14 @@
+"""Benchmark model zoo (reference: benchmark/fluid/models/{mnist,resnet,
+vgg,se_resnext,machine_translation,stacked_dynamic_lstm}.py + benchmark/
+README.md AlexNet; plus the DeepFM CTR config from BASELINE.json).
+
+Each model module exposes build(...) -> (loss, fetches, feed_specs) built on
+the fluid-compatible API, so the same graphs run single-chip or sharded over
+a mesh.
+"""
+
+from paddle_tpu.models import (alexnet, deepfm, mnist, resnet, se_resnext,
+                               transformer, vgg)
+
+__all__ = ["alexnet", "deepfm", "mnist", "resnet", "se_resnext",
+           "transformer", "vgg"]

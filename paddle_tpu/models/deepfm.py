@@ -1,0 +1,66 @@
+"""DeepFM CTR model (BASELINE.json config 5: "high-dim sparse embedding
+lookup + pserver → TPU SparseCore"; reference capability: the CTR path of
+AsyncExecutor/PSlib (framework/async_executor.cc) + distributed lookup
+tables (nn.py:300 embedding(is_sparse, is_distributed))).
+
+TPU-native form: field-wise dense id batches [B, F]; the embedding table is
+a single [vocab, dim] param whose rows shard over the mesh (param_axes
+{"deepfm_emb": ("mp", None)}), turning the pserver prefetch protocol into an
+XLA all-gather/all-to-all under jit.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def deepfm(field_ids, num_fields, vocab_size, embed_dim=16,
+           hidden_sizes=(400, 400, 400), name="deepfm"):
+    # first-order term: per-id scalar weight
+    w1 = layers.embedding(
+        field_ids, size=[vocab_size, 1],
+        param_attr=fluid.ParamAttr(
+            name=name + "_w1",
+            initializer=fluid.initializer.Uniform(-0.01, 0.01)))
+    first_order = layers.reduce_sum(w1, dim=1)          # [B, 1]
+
+    # second-order FM term over field embeddings [B, F, K]
+    emb = layers.embedding(
+        field_ids, size=[vocab_size, embed_dim],
+        param_attr=fluid.ParamAttr(
+            name=name + "_emb",
+            initializer=fluid.initializer.Uniform(-0.01, 0.01)))
+    sum_emb = layers.reduce_sum(emb, dim=1)             # [B, K]
+    sum_sq = layers.square(sum_emb)
+    sq_emb = layers.square(emb)
+    sq_sum = layers.reduce_sum(sq_emb, dim=1)
+    fm = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                          keep_dim=True),
+        scale=0.5)                                      # [B, 1]
+
+    # deep component
+    deep = layers.reshape(emb, shape=[-1, num_fields * embed_dim])
+    for h in hidden_sizes:
+        deep = layers.fc(deep, size=h, act="relu")
+    deep_out = layers.fc(deep, size=1)
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first_order, fm), deep_out)
+    return logit
+
+
+def build(is_train: bool = True, num_fields: int = 26,
+          vocab_size: int = 100000, embed_dim: int = 16, lr: float = 1e-3):
+    ids = layers.data(name="feat_ids", shape=[num_fields, 1], dtype="int64")
+    label = layers.data(name="label", shape=[1], dtype="float32")
+    logit = deepfm(ids, num_fields, vocab_size, embed_dim)
+    loss_vec = layers.sigmoid_cross_entropy_with_logits(logit, label)
+    loss = layers.mean(loss_vec)
+    prob = layers.sigmoid(logit)
+    if is_train:
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    feed_specs = {"feat_ids": ([-1, num_fields, 1], "int64"),
+                  "label": ([-1, 1], "float32")}
+    return loss, [prob], feed_specs

@@ -1,0 +1,176 @@
+"""Transformer-base (reference capability: benchmark/fluid Transformer-base
+WMT en-de config named in BASELINE.json; the reference preps it in
+benchmark/fluid/models/machine_translation.py-era configs).
+
+The flagship model: encoder-decoder, multi-head attention, pre-norm
+residuals. Built entirely from the fluid-style layers so the same program
+runs single-chip or sharded (dp × tp) over a mesh — attention/FFN matmuls
+are the MXU hot path; paddle_tpu.parallel shards d_model/heads over 'tp' and
+batch over 'dp'.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.initializer import NumpyArrayInitializer
+
+
+def _const_var(name, value):
+    """A non-trainable persistable table (positional encodings, masks)."""
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    value = np.asarray(value, dtype=np.float32)
+    v = main.global_block().create_var(
+        name=name, shape=list(value.shape), dtype="float32",
+        persistable=True, stop_gradient=True)
+    sv = startup.global_block().create_var(
+        name=name, shape=list(value.shape), dtype="float32", persistable=True)
+    NumpyArrayInitializer(value)(sv, startup.global_block())
+    return v
+
+
+def position_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    i = np.arange(d_model // 2)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, 2 * i / d_model)
+    enc = np.zeros((max_len, d_model))
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc.astype(np.float32)
+
+
+def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
+                         name=""):
+    d_k = d_model // n_head
+    q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False)
+    k = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
+
+    def split_heads(x):
+        # [B, L, D] -> [B, H, L, dk]
+        r = layers.reshape(x, shape=[0, 0, n_head, d_k])
+        return layers.transpose(r, perm=[0, 2, 1, 3])
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    q = layers.scale(q, scale=d_k ** -0.5)
+    logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]
+    if mask is not None:
+        logits = layers.elementwise_add(logits, mask)
+    weights = layers.softmax(logits)
+    if dropout:
+        weights = layers.dropout(weights, dropout_prob=dropout,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)                  # [B, H, Lq, dk]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def ffn(x, d_model, d_inner, dropout):
+    h = layers.fc(x, size=d_inner, num_flatten_dims=2, act="relu")
+    if dropout:
+        h = layers.dropout(h, dropout_prob=dropout,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, size=d_model, num_flatten_dims=2)
+
+
+def _residual(x, sub, dropout):
+    if dropout:
+        sub = layers.dropout(sub, dropout_prob=dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, sub)
+
+
+def encoder_layer(x, d_model, d_inner, n_head, dropout):
+    attn_in = layers.layer_norm(x, begin_norm_axis=2)
+    attn = multi_head_attention(attn_in, attn_in, d_model, n_head, dropout)
+    x = _residual(x, attn, dropout)
+    ffn_in = layers.layer_norm(x, begin_norm_axis=2)
+    return _residual(x, ffn(ffn_in, d_model, d_inner, dropout), dropout)
+
+
+def decoder_layer(x, enc_out, causal_mask, d_model, d_inner, n_head,
+                  dropout):
+    self_in = layers.layer_norm(x, begin_norm_axis=2)
+    self_attn = multi_head_attention(self_in, self_in, d_model, n_head,
+                                     dropout, mask=causal_mask)
+    x = _residual(x, self_attn, dropout)
+    cross_in = layers.layer_norm(x, begin_norm_axis=2)
+    cross = multi_head_attention(cross_in, enc_out, d_model, n_head, dropout)
+    x = _residual(x, cross, dropout)
+    ffn_in = layers.layer_norm(x, begin_norm_axis=2)
+    return _residual(x, ffn(ffn_in, d_model, d_inner, dropout), dropout)
+
+
+def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
+                d_model=512, d_inner=2048, n_head=8, n_layer=6,
+                dropout=0.1, name="transformer"):
+    pe = _const_var(name + "_pos_enc",
+                    position_encoding(max_len, d_model))
+    # causal mask [1, 1, L, L]: -1e9 above the diagonal
+    causal = np.triu(np.full((max_len, max_len), -1e9, np.float32), k=1)
+    causal_mask = _const_var(name + "_causal_mask",
+                             causal[None, None, :, :])
+
+    def embed(ids, vocab, scope):
+        emb = layers.embedding(
+            ids, size=[vocab, d_model],
+            param_attr=fluid.ParamAttr(
+                name=f"{name}_{scope}_emb",
+                initializer=fluid.initializer.Normal(0.0, d_model ** -0.5)))
+        emb = layers.scale(emb, scale=d_model ** 0.5)
+        return layers.elementwise_add(emb, pe, axis=1)
+
+    enc = embed(src_ids, src_vocab, "src")
+    if dropout:
+        enc = layers.dropout(enc, dropout_prob=dropout,
+                             dropout_implementation="upscale_in_train")
+    for _ in range(n_layer):
+        enc = encoder_layer(enc, d_model, d_inner, n_head, dropout)
+    enc = layers.layer_norm(enc, begin_norm_axis=2)
+
+    dec = embed(tgt_ids, tgt_vocab, "tgt")
+    if dropout:
+        dec = layers.dropout(dec, dropout_prob=dropout,
+                             dropout_implementation="upscale_in_train")
+    for _ in range(n_layer):
+        dec = decoder_layer(dec, enc, causal_mask, d_model, d_inner, n_head,
+                            dropout)
+    dec = layers.layer_norm(dec, begin_norm_axis=2)
+    return layers.fc(dec, size=tgt_vocab, num_flatten_dims=2,
+                     bias_attr=False)
+
+
+def build(is_train: bool = True, src_vocab: int = 32000,
+          tgt_vocab: int = 32000, max_len: int = 128, d_model: int = 512,
+          d_inner: int = 2048, n_head: int = 8, n_layer: int = 6,
+          dropout: float = 0.1, lr: float = 1e-4, warmup: int = 4000,
+          label_smooth_eps: float = 0.1):
+    """Transformer-base training graph (Vaswani config: 512/2048/8/6)."""
+    src = layers.data(name="src_ids", shape=[max_len, 1], dtype="int64")
+    tgt = layers.data(name="tgt_ids", shape=[max_len, 1], dtype="int64")
+    lbl = layers.data(name="lbl_ids", shape=[max_len, 1], dtype="int64")
+    logits = transformer(src, tgt, src_vocab, tgt_vocab, max_len, d_model,
+                         d_inner, n_head, n_layer,
+                         dropout if is_train else 0.0)
+    flat_logits = layers.reshape(logits, shape=[-1, tgt_vocab])
+    flat_label = layers.reshape(lbl, shape=[-1, 1])
+    if label_smooth_eps and is_train:
+        smooth = layers.label_smooth(
+            layers.one_hot(flat_label, tgt_vocab), epsilon=label_smooth_eps)
+        loss_vec = layers.softmax_with_cross_entropy(
+            flat_logits, smooth, soft_label=True)
+    else:
+        loss_vec = layers.softmax_with_cross_entropy(flat_logits, flat_label)
+    loss = layers.mean(loss_vec)
+    if is_train:
+        # Adam + fixed LR for round 1 (Noam warmup scheduler in a later round)
+        fluid.optimizer.Adam(learning_rate=lr, beta1=0.9,
+                             beta2=0.997, epsilon=1e-9).minimize(loss)
+    feed_specs = {"src_ids": ([-1, max_len, 1], "int64"),
+                  "tgt_ids": ([-1, max_len, 1], "int64"),
+                  "lbl_ids": ([-1, max_len, 1], "int64")}
+    return loss, [], feed_specs

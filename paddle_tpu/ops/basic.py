@@ -274,6 +274,15 @@ def _logical_xor(ctx, ins, attrs):
     return single(jnp.logical_xor(first(ins, "X"), first(ins, "Y")))
 
 
+@register_op("select", ref="lax.select; capability of fluid's cond/switch "
+             "(operators/controlflow) for elementwise choice")
+def _select(ctx, ins, attrs):
+    cond = first(ins, "Condition")
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    return single(jnp.where(cond, x, y))
+
+
 @register_op("isfinite", no_grad=True, ref="operators/isfinite_op.cc")
 def _isfinite(ctx, ins, attrs):
     x = first(ins, "X")

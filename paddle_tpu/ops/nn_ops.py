@@ -261,7 +261,7 @@ def _dropout(ctx, ins, attrs):
         if impl == "upscale_in_train":
             return {"Out": [x], "Mask": [jnp.ones_like(x)]}
         return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
-    keep = jax.random.bernoulli(ctx.key(), 1.0 - p, x.shape)
+    keep = jax.random.bernoulli(ctx.step_key(), 1.0 - p, x.shape)
     mask = keep.astype(x.dtype)
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / (1.0 - p), 0.0)

@@ -1,0 +1,16 @@
+"""paddle_tpu.parallel — device meshes, sharding strategies, collectives.
+
+The TPU-native replacement for the reference's entire multi-device stack:
+ParallelExecutor's SSA-graph collective engine (framework/details/, NCCL
+op-handles all_reduce_op_handle.cc:55), the NCCLContextMap bootstrap
+(platform/nccl_helper.h:86, gen_nccl_id_op.cc), and the DistributeTranspiler
+(transpiler/distribute_transpiler.py:157). Here: a jax.sharding.Mesh + named
+shardings; XLA inserts the ICI collectives (psum/all-gather/reduce-scatter)
+that the reference hand-scheduled over NCCL.
+"""
+
+from paddle_tpu.parallel.mesh import (DistributeConfig, get_default_mesh,
+                                      make_mesh, set_default_mesh)
+
+__all__ = ["DistributeConfig", "get_default_mesh", "make_mesh",
+           "set_default_mesh"]

@@ -1,0 +1,63 @@
+"""Device mesh construction and distribution config.
+
+Replaces the reference's device bookkeeping: `places` lists +
+NCCLContextMap (parallel_executor.cc:239-256) + trainer_id/num_trainers
+plumbing (nccl2 mode, distribute_transpiler.py:222). A Mesh names its axes
+(dp/tp/pp/sp/ep); programs annotate shardings and XLA emits ICI collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: Optional[Dict[str, int]] = None,
+              devices=None) -> Mesh:
+    """Build a Mesh. Default: all local devices on one 'dp' axis (the
+    reference's ParallelExecutor default: one replica per visible GPU,
+    parallel_executor.cc:213)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {"dp": len(devices)}
+    names = list(axis_sizes)
+    sizes = [axis_sizes[n] for n in names]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {axis_sizes} need {total} devices, have "
+            f"{len(devices)}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+@dataclass
+class DistributeConfig:
+    """How a program distributes over the mesh — the capability successor of
+    BuildStrategy/ExecutionStrategy/DistributeTranspilerConfig
+    (build_strategy.h:34, distribute_transpiler.py:126)."""
+
+    mesh: Optional[Mesh] = None
+    data_axis: Optional[str] = "dp"         # batch dim of feeds shards here
+    # param sharding rules: {param name regex: PartitionSpec-like tuple}
+    param_axes: Dict[str, tuple] = field(default_factory=dict)
+    # reduce strategy parity (BuildStrategy::ReduceStrategy, kAllReduce vs
+    # kReduce build_strategy.h:55): on TPU both are XLA collective choices;
+    # "reduce_scatter" shards optimizer state ZeRO-style (future rounds)
+    reduce_strategy: str = "all_reduce"

@@ -1,0 +1,10 @@
+"""Reader composition toolkit (reference: python/paddle/reader/decorator.py
+— map_readers, shuffle, batch, compose, chain, buffered, xmap_readers,
+cache, firstn)."""
+
+from paddle_tpu.reader.decorator import (batch, buffered, cache, chain,
+                                         compose, firstn, map_readers,
+                                         shuffle, xmap_readers)
+
+__all__ = ["batch", "buffered", "cache", "chain", "compose", "firstn",
+           "map_readers", "shuffle", "xmap_readers"]

@@ -1,0 +1,164 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py). A
+"reader" is a zero-arg callable returning an iterable of samples — the same
+contract the reference's whole data stack builds on."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable, Iterable, List
+
+
+def map_readers(func: Callable, *readers):
+    """reference: decorator.py map_readers."""
+    def reader():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """reference: decorator.py shuffle — buffered reservoir shuffle."""
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """reference: decorator.py batch (also exposed as paddle.batch)."""
+    def batched():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batched
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    def composed():
+        iters = [r() for r in readers]
+        for items in zip(*iters):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return composed
+
+
+def buffered(reader, size: int):
+    """reference: decorator.py buffered — producer thread + bounded queue
+    (the host-side analogue of operators/reader/buffered_reader.cc)."""
+    end = object()
+
+    def buffered_reader():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                break
+            yield sample
+    return buffered_reader
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int,
+                 buffer_size: int, order: bool = False):
+    """reference: decorator.py xmap_readers — parallel map with worker
+    threads."""
+    end = object()
+
+    def xreader():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return xreader
+
+
+def cache(reader):
+    all_data = []
+
+    def cached():
+        if not all_data:
+            all_data.extend(reader())
+        yield from all_data
+    return cached
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader

@@ -5,11 +5,16 @@ test_dist_base.py forks localhost processes; we use XLA virtual devices)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# env-var JAX_PLATFORMS is overridden by the axon plugin in this image;
+# the config API wins (see .claude/skills/verify/SKILL.md)
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -17,6 +17,20 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework
 
 
+def _declare_inputs(block, inputs):
+    """Create block vars for {slot: {var_name: array}}; returns the op's
+    input name map + the feed dict."""
+    in_map, feed = {}, {}
+    for slot, vars_ in inputs.items():
+        in_map[slot] = []
+        for name, arr in vars_.items():
+            block.create_var(name=name, shape=list(arr.shape),
+                             dtype=str(arr.dtype), stop_gradient=False)
+            in_map[slot].append(name)
+            feed[name] = arr
+    return in_map, feed
+
+
 def run_single_op(op_type: str, inputs: Dict[str, Dict[str, np.ndarray]],
                   attrs: Optional[dict] = None, out_slots=("Out",),
                   n_out: int = 1):
@@ -25,15 +39,7 @@ def run_single_op(op_type: str, inputs: Dict[str, Dict[str, np.ndarray]],
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         block = main.global_block()
-        in_map = {}
-        feed = {}
-        for slot, vars_ in inputs.items():
-            in_map[slot] = []
-            for name, arr in vars_.items():
-                block.create_var(name=name, shape=list(arr.shape),
-                                 dtype=str(arr.dtype), stop_gradient=False)
-                in_map[slot].append(name)
-                feed[name] = arr
+        in_map, feed = _declare_inputs(block, inputs)
         out_map = {}
         out_names = []
         for slot in out_slots:
@@ -64,14 +70,7 @@ def check_grad(op_type: str, inputs: Dict[str, Dict[str, np.ndarray]],
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         block = main.global_block()
-        in_map, feed = {}, {}
-        for slot, vars_ in inputs.items():
-            in_map[slot] = []
-            for name, arr in vars_.items():
-                block.create_var(name=name, shape=list(arr.shape),
-                                 dtype=str(arr.dtype), stop_gradient=False)
-                in_map[slot].append(name)
-                feed[name] = arr
+        in_map, feed = _declare_inputs(block, inputs)
         out_name = "__out"
         block.create_var(name=out_name, dtype="float32")
         out_map = {out_slot: [out_name]}

@@ -1,0 +1,145 @@
+"""io (save/load/checkpoint/inference-export) + data pipeline tests."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid import io as fio
+
+
+def _small_net():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, name="predfc")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, pred, loss, test_prog
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, pred, loss, test_prog = _small_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((4, 8), np.float32)
+    yv = np.ones((4, 1), np.float32)
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    params = {p.name: np.asarray(fluid.global_scope().find_var(p.name))
+              for p in main.all_parameters()}
+    fio.save_persistables(exe, str(tmp_path / "ckpt"), main)
+
+    # clobber + reload
+    import jax
+    for name in params:
+        fluid.global_scope().set_var(
+            name, jax.device_put(np.zeros_like(params[name])))
+    fio.load_persistables(exe, str(tmp_path / "ckpt"), main)
+    for name, want in params.items():
+        got = np.asarray(fluid.global_scope().find_var(name))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_checkpoint_retention(tmp_path):
+    main, startup, pred, loss, test_prog = _small_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for step in range(5):
+        fio.save_checkpoint(exe, str(tmp_path / "cp"), main_program=main,
+                            step=step, max_num_checkpoints=2)
+    steps = fio._all_steps(str(tmp_path / "cp"))
+    assert sorted(steps) == [3, 4]
+    loaded = fio.load_checkpoint(exe, str(tmp_path / "cp"),
+                                 main_program=main)
+    assert loaded == 4
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, pred, loss, test_prog = _small_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    (want,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred.name])
+
+    fio.save_inference_model(str(tmp_path / "model"), ["x"], [pred], exe,
+                             main)
+    prog, feeds, fetches = fio.load_inference_model(str(tmp_path / "model"),
+                                                    exe)
+    assert feeds == ["x"]
+    (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetches)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # pruned program must not contain optimizer ops
+    optypes = [op.type for op in prog.desc.global_block.ops]
+    assert "sgd" not in optypes and "__vjp__" not in optypes
+
+
+def test_reader_decorators():
+    import paddle_tpu.reader as reader_mod
+
+    def r():
+        yield from range(10)
+
+    batched = reader_mod.batch(lambda: r(), 3)
+    batches = list(batched())
+    assert batches[0] == [0, 1, 2] and len(batches) == 4
+    b2 = reader_mod.batch(lambda: r(), 3, drop_last=True)
+    assert len(list(b2())) == 3
+
+    shuffled = sorted(x for x in reader_mod.shuffle(lambda: r(), 5)())
+    assert shuffled == list(range(10))
+
+    mapped = list(reader_mod.map_readers(lambda a: a * 2, lambda: r())())
+    assert mapped[:3] == [0, 2, 4]
+
+    buf = list(reader_mod.buffered(lambda: r(), 2)())
+    assert buf == list(range(10))
+
+    xm = sorted(reader_mod.xmap_readers(lambda a: a + 1, lambda: r(), 2, 4)())
+    assert xm == list(range(1, 11))
+    xmo = list(reader_mod.xmap_readers(lambda a: a + 1, lambda: r(), 2, 4,
+                                       order=True)())
+    assert xmo == list(range(1, 11))
+
+
+def test_data_feeder_and_loader():
+    from paddle_tpu.fluid.data_feeder import DataFeeder
+    from paddle_tpu.data import DataLoader
+    import paddle_tpu
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        ylab = layers.data(name="ylab", shape=[1], dtype="int64")
+
+    feeder = DataFeeder(feed_list=[x, ylab])
+
+    def sample_reader():
+        rng = np.random.RandomState(0)
+        for i in range(8):
+            yield rng.rand(4).astype(np.float32), int(i % 2)
+
+    batched = paddle_tpu.batch(sample_reader, batch_size=4)
+    fd = feeder.feed(next(iter(batched())))
+    assert fd["x"].shape == (4, 4)
+    assert fd["ylab"].shape == (4,) or fd["ylab"].shape == (4, 1)
+
+    loader = DataLoader(["x", "ylab"], batched, capacity=2, feeder=feeder)
+    n = 0
+    for feeds in loader:
+        assert set(feeds) == {"x", "ylab"}
+        n += 1
+    assert n == 2
+
+
+def test_dataset_zoo_readers():
+    import paddle_tpu.dataset as ds
+    x, y = next(iter(ds.mnist.train()()))
+    assert len(x) == 784 and 0 <= y < 10
+    x, y = next(iter(ds.cifar.train10()()))
+    assert len(x) == 3072
+    x, y = next(iter(ds.uci_housing.train()()))
+    assert len(x) == 13
+    ids, lab = next(iter(ds.imdb.train()()))
+    assert len(ids) >= 10 and lab in (0, 1)

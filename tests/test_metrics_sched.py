@@ -1,0 +1,89 @@
+"""Metrics, LR schedulers, profiler tests."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_metrics_accuracy_auc():
+    m = fluid.metrics.Accuracy()
+    m.update(0.5, 10)
+    m.update(1.0, 10)
+    assert abs(m.eval() - 0.75) < 1e-9
+
+    auc = fluid.metrics.Auc(num_thresholds=255)
+    preds = np.array([[0.9, 0.1], [0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = np.array([0, 1, 0, 1])
+    auc.update(preds, labels)
+    assert auc.eval() == 1.0  # perfectly separable
+
+    p = fluid.metrics.Precision()
+    p.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    assert abs(p.eval() - 0.5) < 1e-9
+    r = fluid.metrics.Recall()
+    r.update(np.array([1, 1, 0, 0]), np.array([1, 0, 1, 0]))
+    assert abs(r.eval() - 0.5) < 1e-9
+
+
+def _train_with_lr(lr_fn, steps=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = lr_fn()
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    lrs = []
+    for _ in range(steps):
+        feed = {"x": rng.rand(8, 4).astype(np.float32),
+                "y": rng.rand(8, 1).astype(np.float32)}
+        lv, lrv = exe.run(main, feed=feed, fetch_list=[loss, lr.name])
+        lrs.append(float(np.asarray(lrv).reshape(())))
+    return lrs
+
+
+def test_exponential_decay():
+    lrs = _train_with_lr(lambda: fluid.learning_rate_scheduler.
+                         exponential_decay(0.1, decay_steps=2,
+                                           decay_rate=0.5))
+    assert lrs[0] > lrs[-1]
+    # step counts 1,2,3,4 → lr = 0.1 * 0.5^(step/2)
+    np.testing.assert_allclose(lrs[0], 0.1 * 0.5 ** 0.5, rtol=1e-5)
+    np.testing.assert_allclose(lrs[3], 0.1 * 0.5 ** 2.0, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    lrs = _train_with_lr(lambda: fluid.learning_rate_scheduler.
+                         piecewise_decay([2, 3], [0.1, 0.01, 0.001]),
+                         steps=4)
+    np.testing.assert_allclose(lrs, [0.1, 0.01, 0.001, 0.001], rtol=1e-6)
+
+
+def test_noam_decay():
+    lrs = _train_with_lr(lambda: fluid.learning_rate_scheduler.
+                         noam_decay(d_model=512, warmup_steps=2), steps=3)
+    # warmup: increasing for first steps
+    assert lrs[1] > lrs[0]
+
+
+def test_cosine_decay():
+    lrs = _train_with_lr(lambda: fluid.learning_rate_scheduler.
+                         cosine_decay(0.1, step_each_epoch=1, epochs=4),
+                         steps=4)
+    assert lrs[0] > lrs[-1] >= 0.0
+
+
+def test_profiler_table(capsys):
+    with fluid.profiler.profiler():
+        with fluid.profiler.record_event("stepA"):
+            pass
+        with fluid.profiler.record_event("stepA"):
+            pass
+    out = capsys.readouterr().out
+    assert "stepA" in out and "Calls" in out
