@@ -282,6 +282,21 @@ def _jsonable_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def find_var_recursive(program: "ProgramDesc", block: "BlockDesc",
+                       name: str) -> Optional[VarDesc]:
+    """Resolve `name` in `block` or its ancestor chain (reference:
+    framework.py Block._var_recursive — sub-block ops may reference
+    parent-scope vars, e.g. parameters in block 0). Returns None if absent
+    everywhere."""
+    b = block
+    while True:
+        if b.has_var(name):
+            return b.var(name)
+        if b.idx == 0 or b.parent_idx < 0 or b.parent_idx == b.idx:
+            return None
+        b = program.block(b.parent_idx)
+
+
 # ---------------------------------------------------------------------------
 # Pruning (reference: framework/prune.cc; used by save_inference_model,
 # io.py:570): keep only ops needed to compute `targets` from feeds.

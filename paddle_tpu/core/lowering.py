@@ -99,6 +99,55 @@ def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
     )
 
 
+def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
+                indices, env: Dict[str, Any], base_key, step_base,
+                is_test: bool) -> None:
+    """Emit the ops at `indices` of `block` into `env` (mutated in place).
+    This is the single trace-time interpreter loop; control-flow emitters
+    call back into it for their sub-blocks (replacing the reference's
+    per-iteration child-scope interpretation, while_op.cc:64-70)."""
+    for i in indices:
+        op = block.ops[i]
+        spec = get_op(op.type)
+        # salt rng per (block, op) so sub-block ops never collide with
+        # parent-block ops at the same index
+        ctx = EmitContext(base_key=base_key, step_base_key=step_base,
+                          op_index=block.idx * 100_000 + i, is_test=is_test,
+                          program=program)
+        ins = {}
+        for slot, names in op.inputs.items():
+            try:
+                ins[slot] = [env[n] for n in names]
+            except KeyError as e:
+                raise KeyError(
+                    f"op {op.type!r} input {slot} references undefined var "
+                    f"{e.args[0]!r}; did you run the startup program?") from e
+        outs = spec.emit(ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                env[n] = v
+
+
+def emit_subblock(ctx: EmitContext, block_idx: int, env: Dict[str, Any],
+                  key_salt=None) -> None:
+    """Recursively lower sub-block `block_idx` into `env` under the caller's
+    trace (used by while/cond/scan emitters). `key_salt` is a (possibly
+    traced) iteration counter folded into the rng keys so random ops draw
+    fresh randomness each loop iteration (the reference re-interprets the
+    sub-block per step with fresh seeds, while_op.cc:64-70)."""
+    base, step_base = ctx.base_key, ctx.step_base_key
+    if key_salt is not None:
+        base = jax.random.fold_in(base, key_salt)
+        if step_base is not None:
+            step_base = jax.random.fold_in(step_base, key_salt)
+    sub = ctx.program.block(block_idx)
+    emit_op_seq(ctx.program, sub, range(len(sub.ops)), env,
+                base, step_base, ctx.is_test)
+
+
 def build_block_fn(program: ir.ProgramDesc, block_idx: int,
                    sig: BlockSignature, is_test: bool = False):
     """Returns fn(state: dict, consts: dict, feeds: dict, step_seed) ->
@@ -122,26 +171,8 @@ def build_block_fn(program: ir.ProgramDesc, block_idx: int,
         else:
             base_key = jax.random.fold_in(jax.random.key(0), step_seed)
         step_base = base_key
-        for i in sig.live_ops:
-            op = block.ops[i]
-            spec = get_op(op.type)
-            ctx = EmitContext(base_key=base_key, step_base_key=step_base,
-                              op_index=i, is_test=is_test)
-            ins = {}
-            for slot, names in op.inputs.items():
-                try:
-                    ins[slot] = [env[n] for n in names]
-                except KeyError as e:
-                    raise KeyError(
-                        f"op {op.type!r} input {slot} references undefined var "
-                        f"{e.args[0]!r}; did you run the startup program?") from e
-            outs = spec.emit(ctx, ins, op.attrs)
-            for slot, names in op.outputs.items():
-                vals = outs.get(slot)
-                if vals is None:
-                    continue
-                for n, v in zip(names, vals):
-                    env[n] = v
+        emit_op_seq(program, block, sig.live_ops, env, base_key, step_base,
+                    is_test)
         fetches = [env[n] for n in sig.fetch_names]
         new_state = {n: env[n] for n in sig.state_names if n in env}
         for n in sig.created_persistable:

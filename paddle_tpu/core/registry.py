@@ -51,6 +51,10 @@ class EmitContext:
     # set during multi-device lowering: the mesh and the data-parallel axis
     mesh: Any = None
     data_axis: Optional[str] = None
+    # the enclosing ProgramDesc — control-flow emitters (while/cond/scan)
+    # recursively lower their sub-blocks through this handle
+    # (reference: sub-blocks interpreted with child scopes, while_op.cc:64)
+    program: Any = None
 
     def key(self, salt: int = 0):
         return jax.random.fold_in(

@@ -39,21 +39,26 @@ def _from_abstract(shape) -> Tuple[int, ...]:
     return tuple(out)
 
 
-def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc
+def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc, lookup=None
                      ) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
     """Returns {output var name: (shape with -1 batch dims, dtype)} or None
-    if inference is not possible (emitter needs concrete values)."""
+    if inference is not possible (emitter needs concrete values).
+    `lookup(name) -> VarDesc | None` resolves vars across ancestor blocks
+    (sub-block ops read parent-scope vars, e.g. parameters in block 0)."""
     if not has_op(op.type):
         return None
     spec = get_op(op.type)
+    if lookup is None:
+        lookup = lambda n: block.var(n) if block.has_var(n) else None  # noqa: E731
 
     ins_structs = {}
     for slot, names in op.inputs.items():
         vals = []
         for n in names:
-            if not block.has_var(n) or block.var(n).shape is None:
+            vd = lookup(n)
+            if vd is None or vd.shape is None:
                 return None
-            vals.append(_to_struct(block.var(n)))
+            vals.append(_to_struct(vd))
         ins_structs[slot] = vals
 
     ctx = EmitContext(base_key=None, op_index=0, is_test=False)

@@ -181,6 +181,20 @@ class Block:
     def has_var(self, name: str) -> bool:
         return name in self.vars or self.desc.has_var(name)
 
+    def var_recursive(self, name: str) -> Variable:
+        """Look up `name` here or in ancestor blocks (reference:
+        framework.py Block._var_recursive — sub-block ops may reference
+        parent-scope variables)."""
+        b = self
+        while True:
+            if b.has_var(name):
+                return b.var(name)
+            pidx = b.desc.parent_idx
+            if pidx < 0 or b.idx == pidx:
+                raise KeyError(f"variable {name!r} not found in block "
+                               f"{self.idx} or its ancestors")
+            b = self.program.blocks[pidx]
+
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
 
@@ -202,7 +216,10 @@ class Block:
         return op
 
     def _infer_shapes(self, op_desc: ir.OpDesc):
-        inferred = infer_op_outputs(self.desc, op_desc)
+        def lookup(name):
+            return ir.find_var_recursive(self.program.desc, self.desc, name)
+
+        inferred = infer_op_outputs(self.desc, op_desc, lookup=lookup)
         if not inferred:
             return
         for name, (shape, dtype) in inferred.items():

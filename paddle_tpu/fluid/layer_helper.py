@@ -46,7 +46,10 @@ class LayerHelper:
         if init is None:
             init = (init_mod._global_bias_initializer() if is_bias
                     else init_mod._global_weight_initializer())
-        param = self.block.create_parameter(
+        # parameters always live in the global block, even when created
+        # inside a control-flow sub-block (reference: framework.py Parameter
+        # is always created in program.global_block())
+        param = self.main_program.global_block().create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
             trainable=attr.trainable,
             optimize_attr={"learning_rate": attr.learning_rate},

@@ -12,6 +12,11 @@ from paddle_tpu.fluid.layers.nn import (  # noqa: F401
     scale, sigmoid_cross_entropy_with_logits, slice, softmax,
     softmax_with_cross_entropy, split, square_error_cost, squeeze, stack,
     topk, transpose, unsqueeze)
+from paddle_tpu.fluid.layers.rnn import (  # noqa: F401
+    dynamic_gru, dynamic_lstm, gru_unit, lstm_unit)
+from paddle_tpu.fluid.layers.control_flow import (  # noqa: F401
+    DynamicRNN, IfElse, StaticRNN, Switch, While, array_length, array_read,
+    array_write, create_array, increment)
 from paddle_tpu.fluid.layers.ops import (  # noqa: F401
     abs, ceil, cos, elementwise_add, elementwise_div, elementwise_max,
     elementwise_min, elementwise_mod, elementwise_mul, elementwise_pow,

@@ -1,0 +1,645 @@
+"""Control-flow DSL: While / Switch / IfElse / StaticRNN / DynamicRNN.
+
+Capability parity with the reference's control-flow layer DSL
+(reference: python/paddle/fluid/layers/control_flow.py — While, StaticRNN,
+DynamicRNN, IfElse, Switch; lowered there to while_op/conditional_block
+interpreted with per-iteration scopes, operators/controlflow/while_op.cc:50).
+
+TPU-native redesign:
+- While       -> `while` op -> lax.while_loop (non-differentiable loops)
+- StaticRNN / DynamicRNN -> `scan` op -> lax.scan (differentiable; grads via
+  lax.scan's VJP instead of while_grad's kept scopes, executor.cc:466)
+- IfElse      -> dense both-branch compute + elementwise `select` (XLA-
+  idiomatic replacement for the reference's batch gather/scatter split)
+- Switch      -> chain of `cond` ops (lax.cond), first matching case wins
+- DynamicRNN's variable-length handling uses padded [B, T, ...] + seq_lens
+  masking (the segment-ids LoD redesign) instead of LoD shrinking batches.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+from paddle_tpu.core import ir
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+__all__ = [
+    "While", "Switch", "IfElse", "StaticRNN", "DynamicRNN",
+    "increment", "less_than", "less_equal", "greater_than", "greater_equal",
+    "equal", "not_equal", "array_write", "array_read", "array_length",
+    "create_array",
+]
+
+
+# ---------------------------------------------------------------------------
+# small layer helpers used across the DSL
+# ---------------------------------------------------------------------------
+
+def increment(x, value=1.0, in_place=True):
+    """reference: layers/control_flow.py increment / increment_op.cc."""
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": float(value)})
+    return out
+
+
+# comparison layers are the shared implementations from layers.ops
+# (single source; reference keeps them in layers/control_flow.py)
+from paddle_tpu.fluid.layers.ops import (  # noqa: E402,F401
+    equal, greater_equal, greater_than, less_equal, less_than, not_equal)
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (fixed capacity — see ops/control_flow.py)
+# ---------------------------------------------------------------------------
+
+def create_array(dtype, capacity, elem_shape):
+    """Create a fixed-capacity tensor array as a [capacity, *elem_shape]
+    tensor (reference: layers/control_flow.py create_array; redesigned with
+    declared capacity for XLA static shapes)."""
+    from paddle_tpu.fluid.layers.tensor import fill_constant
+    return fill_constant(shape=[capacity] + list(elem_shape), dtype=dtype,
+                         value=0.0)
+
+
+def array_write(x, i, array):
+    """reference: layers/control_flow.py array_write."""
+    helper = LayerHelper("array_write")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("array_write",
+                     inputs={"X": [x], "I": [i], "Array": [array]},
+                     outputs={"Out": [out]})
+    if array.shape is not None:
+        out.desc.shape = list(array.shape)
+    return out
+
+
+def array_read(array, i):
+    """reference: layers/control_flow.py array_read."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("array_read", inputs={"Array": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    if array.shape is not None:
+        out.desc.shape = list(array.shape[1:])
+    return out
+
+
+def array_length(array):
+    """reference: layers/control_flow.py array_length (returns capacity in
+    the fixed-capacity design)."""
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("array_length", inputs={"Array": [array]},
+                     outputs={"Out": [out]})
+    out.desc.shape = [1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sub-block dataflow analysis
+# ---------------------------------------------------------------------------
+
+def _ancestor_has_var(program: framework.Program, sub: ir.BlockDesc,
+                      name: str) -> bool:
+    if sub.parent_idx < 0:
+        return False
+    parent = program.desc.block(sub.parent_idx)
+    return ir.find_var_recursive(program.desc, parent, name) is not None
+
+
+def _analyze_subblock(program: framework.Program, sub_idx: int,
+                      preset_defined=()):
+    """Returns (external_reads, writes_to_outer): names the sub-block reads
+    from ancestor blocks, and ancestor-block names it (re)assigns — the
+    closure and the loop-carried state of the structured-control-flow op."""
+    sub = program.desc.block(sub_idx)
+    defined = set(preset_defined)
+    external_reads: List[str] = []
+    writes_to_outer: List[str] = []
+    for op in sub.ops:
+        for n in op.input_names():
+            if n in defined or n in external_reads:
+                continue
+            if _ancestor_has_var(program, sub, n):
+                external_reads.append(n)
+        for n in op.output_names():
+            defined.add(n)
+            if _ancestor_has_var(program, sub, n) and n not in writes_to_outer:
+                writes_to_outer.append(n)
+    return external_reads, writes_to_outer
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+class While:
+    """reference: layers/control_flow.py While / while_op.cc:50.
+
+    Lowered to lax.while_loop: ancestor vars assigned inside the body are
+    the loop carry; the condition var must be reassigned in the body.
+    Non-differentiable (use StaticRNN/DynamicRNN for trainable recurrence).
+
+        i = fill_constant([1], "int64", 0)
+        n = fill_constant([1], "int64", 10)
+        cond = less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ... body ops assigning ancestor vars ...
+            increment(i)
+            less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper(name or "while")
+        self.program = framework.default_main_program()
+
+    @contextlib.contextmanager
+    def block(self):
+        parent_block = self.program.current_block()
+        sub = self.program.create_block()
+        try:
+            yield
+        finally:
+            self.program.rollback()
+        ext_reads, writes = _analyze_subblock(self.program, sub.idx)
+        cond_name = self.cond_var.name
+        if cond_name not in writes:
+            raise ValueError(
+                "While body never reassigns the condition variable "
+                f"{cond_name!r} — the loop would not terminate. Assign it, "
+                "e.g. less_than(i, n, cond=cond).")
+        carry_vars = list(writes)
+        x_vars = [n for n in ext_reads if n not in carry_vars]
+        carry_parent = [parent_block.var_recursive(n) for n in carry_vars]
+        parent_block.append_op(
+            "while",
+            inputs={"Condition": [self.cond_var],
+                    "Carry": carry_parent,
+                    "X": [parent_block.var_recursive(n) for n in x_vars]},
+            outputs={"Out": carry_parent},
+            attrs={"sub_block": sub.idx, "cond_var": cond_name,
+                   "carry_vars": carry_vars, "x_vars": x_vars})
+
+
+# ---------------------------------------------------------------------------
+# Switch (reference: layers/control_flow.py Switch — first matching case
+# wins; used by learning-rate decay subgraphs)
+# ---------------------------------------------------------------------------
+
+class Switch:
+    def __init__(self, name=None):
+        self.helper = LayerHelper(name or "switch")
+        self.program = framework.default_main_program()
+        self.cases = []            # (cond Variable | None, sub block idx)
+        self.inside = False
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        if self.inside:
+            raise RuntimeError("nested Switch.case not allowed")
+        self.inside = True
+        sub = self.program.create_block()
+        try:
+            yield
+        finally:
+            self.program.rollback()
+            self.inside = False
+        self.cases.append((condition, sub.idx))
+
+    @contextlib.contextmanager
+    def default(self):
+        with self.case(None):
+            yield
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        helper = self.helper
+        parent = self.program.current_block()
+        # Exact first-match-wins gating: each case fires on
+        # (its cond) AND NOT (any earlier cond); the default fires when no
+        # case matched. At most one predicate is true, so differing write
+        # sets across cases cannot interfere.
+        matched = None  # symbolic "some earlier case matched"
+        ordered = ([c for c in self.cases if c[0] is not None]
+                   + [c for c in self.cases if c[0] is None])
+        for cond_var, sub_idx in ordered:
+            ext_reads, writes = _analyze_subblock(self.program, sub_idx)
+            if cond_var is None:  # default case: fires when no case matched
+                if matched is None:  # switch with only a default
+                    from paddle_tpu.fluid.layers.tensor import fill_constant
+                    eff = fill_constant(shape=[1], dtype="bool", value=True)
+                else:
+                    eff = self._not(matched)
+            elif matched is None:
+                eff = cond_var
+                matched = cond_var
+            else:
+                eff = helper.create_variable_for_type_inference("bool")
+                helper.append_op("logical_and",
+                                 inputs={"X": [cond_var],
+                                         "Y": [self._not(matched)]},
+                                 outputs={"Out": [eff]})
+                new_matched = helper.create_variable_for_type_inference("bool")
+                helper.append_op("logical_or",
+                                 inputs={"X": [matched], "Y": [cond_var]},
+                                 outputs={"Out": [new_matched]})
+                matched = new_matched
+            if not writes:
+                continue
+            x_vars = list(dict.fromkeys(ext_reads + writes))
+            out_parent = [parent.var_recursive(n) for n in writes]
+            parent.append_op(
+                "cond",
+                inputs={"Cond": [eff],
+                        "X": [parent.var_recursive(n) for n in x_vars]},
+                outputs={"Out": out_parent},
+                attrs={"sub_block_true": sub_idx, "sub_block_false": -1,
+                       "out_vars": list(writes), "x_vars": x_vars})
+        return False
+
+    def _not(self, v):
+        out = self.helper.create_variable_for_type_inference("bool")
+        self.helper.append_op("logical_not", inputs={"X": [v]},
+                              outputs={"Out": [out]})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# IfElse (reference: layers/control_flow.py IfElse — splits the batch by a
+# [B,1] bool mask, runs each sub-net on its rows, merges. XLA redesign:
+# both branches compute densely on the full batch; outputs merge with
+# elementwise select. Identical results for row-wise branch nets (the
+# common case); DIVERGENCE: ops that mix rows (reduce_*, batch_norm)
+# see the full batch here but only their masked subset in the reference.
+# ---------------------------------------------------------------------------
+
+class IfElse:
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self.helper = LayerHelper(name or "ifelse")
+        self._phase: Optional[bool] = None
+        self._outputs: Dict[bool, List[framework.Variable]] = {True: [], False: []}
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._phase = True
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._phase = False
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    def input(self, x):
+        if self._phase is None:
+            raise RuntimeError("IfElse.input() must be called inside "
+                               "true_block()/false_block()")
+        return x
+
+    def output(self, *outs):
+        if self._phase is None:
+            raise RuntimeError("IfElse.output() must be called inside "
+                               "true_block()/false_block()")
+        self._outputs[self._phase].extend(outs)
+
+    def __call__(self):
+        t, f = self._outputs[True], self._outputs[False]
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse true_block produced {len(t)} outputs but "
+                f"false_block produced {len(f)}")
+        merged = []
+        for tv, fv in zip(t, f):
+            out = self.helper.create_variable_for_type_inference(tv.dtype)
+            self.helper.append_op(
+                "select", inputs={"Condition": [self.cond], "X": [tv],
+                                  "Y": [fv]},
+                outputs={"Out": [out]})
+            if tv.shape is not None:
+                out.desc.shape = list(tv.shape)
+            merged.append(out)
+        return merged
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (reference: layers/control_flow.py StaticRNN — fixed-length
+# recurrence over axis 0, [T, B, ...] inputs)
+# ---------------------------------------------------------------------------
+
+class StaticRNN:
+    BEFORE_RNN, IN_RNN, AFTER_RNN = range(3)
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper(name or "static_rnn")
+        self.program = framework.default_main_program()
+        self.status = self.BEFORE_RNN
+        self.seq_inputs = []     # (parent seq var [T, ...], body var name)
+        self.memories = []       # dict: body in name, parent init var, body out name
+        self.step_outputs = []   # (body var, parent stacked var)
+        self._sub = None
+        self._parent_block = None
+        self._seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        self._parent_block = self.program.current_block()
+        self._sub = self.program.create_block()
+        self.status = self.IN_RNN
+        try:
+            yield
+        finally:
+            self.program.rollback()
+            self.status = self.AFTER_RNN
+            self._complete()
+
+    def _in_rnn(self):
+        if self.status != self.IN_RNN:
+            raise RuntimeError("must be called inside StaticRNN.step()")
+
+    def step_input(self, x):
+        """x: [T, ...] ancestor var; returns the per-step [ ... ] slice."""
+        self._in_rnn()
+        if x.shape is None or len(x.shape) < 1 or x.shape[0] == -1:
+            raise ValueError(
+                f"StaticRNN.step_input needs a static leading time dim, got "
+                f"shape {x.shape} for {x.name!r} (XLA static-shape regime)")
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        elif self._seq_len != x.shape[0]:
+            raise ValueError("all step_inputs must share the time length")
+        body = self.program.current_block().create_var(
+            name=unique_name.generate(self.helper.name + ".step_in"),
+            shape=list(x.shape[1:]), dtype=x.dtype)
+        self.seq_inputs.append((x, body.name))
+        return body
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        """reference: StaticRNN.memory — carried state; init from a parent
+        var or zero-filled like batch_ref."""
+        self._in_rnn()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init= or (shape=, batch_ref=)")
+            # batch_ref may be a per-step body var (a step_input slice);
+            # the init op must live in the parent block, so map it back to
+            # its parent sequence var — whose batch dim sits after time
+            ref, ref_dim = batch_ref, ref_batch_dim_idx
+            for parent_x, body_name in self.seq_inputs:
+                if batch_ref.name == body_name:
+                    ref, ref_dim = parent_x, ref_batch_dim_idx + 1
+                    break
+            init = self._parent_block.create_var(
+                name=unique_name.generate(self.helper.name + ".mem_init"),
+                shape=[-1] + list(shape), dtype=ref.dtype)
+            self._parent_block.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [ref]}, outputs={"Out": [init]},
+                attrs={"shape": [-1] + list(shape), "value": init_value,
+                       "dtype": ref.dtype,
+                       "input_dim_idx": ref_dim,
+                       "output_dim_idx": init_batch_dim_idx})
+        body = self.program.current_block().create_var(
+            name=unique_name.generate(self.helper.name + ".mem"),
+            shape=list(init.shape) if init.shape else None, dtype=init.dtype)
+        self.memories.append({"in": body.name, "init": init, "out": None})
+        return body
+
+    def update_memory(self, mem, var):
+        self._in_rnn()
+        for m in self.memories:
+            if m["in"] == mem.name:
+                m["out"] = var.name
+                return
+        raise ValueError(f"{mem.name!r} is not a memory of this StaticRNN")
+
+    def step_output(self, o):
+        self._in_rnn()
+        stacked = self._parent_block.create_var(
+            name=unique_name.generate(self.helper.name + ".out"),
+            shape=[self._seq_len] + list(o.shape or []), dtype=o.dtype)
+        self.step_outputs.append((o, stacked))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        for m in self.memories:
+            if m["out"] is None:
+                raise ValueError("every memory needs update_memory()")
+        preset = [n for _, n in self.seq_inputs] + [m["in"] for m in self.memories]
+        ext_reads, writes = _analyze_subblock(self.program, self._sub.idx,
+                                              preset_defined=preset)
+        x_vars = [n for n in ext_reads]
+        final_carries = [
+            self._parent_block.create_var(
+                name=unique_name.generate(self.helper.name + ".final_mem"),
+                shape=list(m["init"].shape) if m["init"].shape else None,
+                dtype=m["init"].dtype)
+            for m in self.memories]
+        self._parent_block.append_op(
+            "scan",
+            inputs={"ScanIn": [x for x, _ in self.seq_inputs],
+                    "Carry": [m["init"] for m in self.memories],
+                    "X": [self._parent_block.var_recursive(n) for n in x_vars]},
+            outputs={"Out": [s for _, s in self.step_outputs],
+                     "FinalCarry": final_carries},
+            attrs={"sub_block": self._sub.idx,
+                   "scan_in_vars": [n for _, n in self.seq_inputs],
+                   "carry_in_vars": [m["in"] for m in self.memories],
+                   "carry_out_vars": [m["out"] for m in self.memories],
+                   "scan_out_vars": [o.name for o, _ in self.step_outputs],
+                   "x_vars": x_vars})
+        self._final_carries = final_carries
+
+    def __call__(self):
+        outs = [s for _, s in self.step_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN (reference: layers/control_flow.py DynamicRNN — variable-length
+# recurrence driven by LoD; here: padded [B, T, ...] + seq_lens [B] masking)
+# ---------------------------------------------------------------------------
+
+class DynamicRNN:
+    """Variable-length RNN over padded batches.
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, seq_lens)   # x: [B, T, D] -> [B, D]
+            h = drnn.memory(shape=[H], value=0.0)
+            h_new = some_layers(x_t, h)
+            drnn.update_memory(h, h_new)         # masked past each row's len
+            drnn.output(h_new)                   # zero-padded past len
+        out = drnn()                             # [B, T, H]
+
+    The reference shrinks the batch per timestep via LoDRankTable
+    (layers/control_flow.py DynamicRNN, lod_rank_table); the TPU design
+    keeps the batch dense and masks — constant shapes for XLA, grads flow
+    through lax.scan's VJP.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper(name or "dynamic_rnn")
+        self.program = framework.default_main_program()
+        self.inner = StaticRNN(name=(name or "dynamic_rnn") + ".scan")
+        self.seq_lens = None
+        self._mask = None        # [B, 1] float body var, 1.0 while t < len
+        self._t = None
+        self._max_len = None
+        self._outputs = []
+        self._batch_ref = None
+
+    @contextlib.contextmanager
+    def block(self):
+        with self.inner.step():
+            yield
+        self._stacked = [s for _, s in self.inner.step_outputs]
+
+    def step_input(self, x, seq_lens=None):
+        """x: [B, T, ...]; seq_lens: [B] int lengths (None = all full T)."""
+        if x.shape is None or len(x.shape) < 2 or x.shape[1] == -1:
+            raise ValueError(
+                f"DynamicRNN.step_input needs static T in [B, T, ...], got "
+                f"{x.shape} for {x.name!r}")
+        parent = self.inner._parent_block
+        if self._max_len is None:
+            self._max_len = x.shape[1]
+            self._batch_ref = x
+        # transpose to [T, B, ...] in the parent block for the scan
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        xt = parent.create_var(
+            name=unique_name.generate(self.helper.name + ".xt"),
+            shape=[x.shape[1], x.shape[0]] + list(x.shape[2:]), dtype=x.dtype)
+        xshape = parent.create_var(
+            name=unique_name.generate(self.helper.name + ".xt_shape"),
+            shape=[0] + list(x.shape), dtype=x.dtype, stop_gradient=True)
+        parent.append_op("transpose2", inputs={"X": [x]},
+                         outputs={"Out": [xt], "XShape": [xshape]},
+                         attrs={"axis": perm})
+        if seq_lens is not None and self.seq_lens is None:
+            self.seq_lens = seq_lens
+        return self.inner.step_input(xt)
+
+    def _ensure_mask(self):
+        """Body-side [B, 1] validity mask from the step counter (an implicit
+        int32 memory incremented each step) and seq_lens."""
+        if self._mask is not None:
+            return self._mask
+        from paddle_tpu.fluid.layers.tensor import fill_constant
+        sub_block = self.program.current_block()
+        if self._t is None:
+            # step counter: carried int32 scalar, init 0 (parent side)
+            with _block_guard(self.program, self.inner._parent_block.idx):
+                t0 = fill_constant(shape=[1], dtype="int32", value=0)
+            t = self.inner.memory(init=t0)
+            t_next = sub_block.create_var(
+                name=unique_name.generate(self.helper.name + ".t_next"),
+                shape=[1], dtype="int32")
+            sub_block.append_op("increment", inputs={"X": [t]},
+                                outputs={"Out": [t_next]}, attrs={"step": 1})
+            self.inner.update_memory(t, sub_block.var(t_next.name))
+            self._t = t
+        if self.seq_lens is None:
+            mask = fill_constant(shape=[1], dtype="bool", value=True)
+        else:
+            mask_flat = less_than(self._t, self.seq_lens)  # [B] bool
+            helper = LayerHelper(self.helper.name + ".mask")
+            mask = helper.create_variable_for_type_inference("bool")
+            helper.append_op("reshape2", inputs={"X": [mask_flat]},
+                             outputs={"Out": [mask]},
+                             attrs={"shape": [-1, 1]})
+            mask.desc.dtype = "bool"
+        self._mask = mask
+        return mask
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        if init is not None:
+            return self.inner.memory(init=init)
+        if self._batch_ref is None:
+            raise RuntimeError("call step_input() before memory(shape=...)")
+        return self.inner.memory(shape=shape, batch_ref=self._batch_ref,
+                                 init_value=value, ref_batch_dim_idx=0)
+
+    def update_memory(self, mem, var):
+        """Masked update: rows past their sequence length keep the old
+        state, so the final memory is the last *valid* state per row."""
+        mask = self._ensure_mask()
+        helper = LayerHelper(self.helper.name + ".sel")
+        sel = helper.create_variable_for_type_inference(var.dtype)
+        helper.append_op("select",
+                         inputs={"Condition": [mask], "X": [var], "Y": [mem]},
+                         outputs={"Out": [sel]})
+        if var.shape is not None:
+            sel.desc.shape = list(var.shape)
+        self.inner.update_memory(mem, sel)
+
+    def output(self, *outs):
+        """Outputs are zeroed past each row's length (padded positions)."""
+        mask = self._ensure_mask()
+        for o in outs:
+            helper = LayerHelper(self.helper.name + ".outsel")
+            zeros = helper.create_variable_for_type_inference(o.dtype)
+            helper.append_op("fill_zeros_like", inputs={"X": [o]},
+                             outputs={"Out": [zeros]})
+            if o.shape is not None:
+                zeros.desc.shape = list(o.shape)
+            masked = helper.create_variable_for_type_inference(o.dtype)
+            helper.append_op("select",
+                            inputs={"Condition": [mask], "X": [o],
+                                    "Y": [zeros]},
+                            outputs={"Out": [masked]})
+            if o.shape is not None:
+                masked.desc.shape = list(o.shape)
+            self.inner.step_output(masked)
+
+    def __call__(self):
+        """Stacked outputs transposed back to [B, T, ...]."""
+        outs = []
+        parent = self.inner._parent_block
+        for _, stacked in self.inner.step_outputs:
+            shp = list(stacked.shape)
+            perm = [1, 0] + list(range(2, len(shp)))
+            out = parent.create_var(
+                name=unique_name.generate(self.helper.name + ".out_bt"),
+                shape=[shp[1], shp[0]] + shp[2:], dtype=stacked.dtype)
+            xshape = parent.create_var(
+                name=unique_name.generate(self.helper.name + ".out_shape"),
+                shape=[0] + shp, dtype=stacked.dtype, stop_gradient=True)
+            parent.append_op("transpose2", inputs={"X": [stacked]},
+                             outputs={"Out": [out], "XShape": [xshape]},
+                             attrs={"axis": perm})
+            outs.append(out)
+        return outs[0] if len(outs) == 1 else outs
+
+
+@contextlib.contextmanager
+def _block_guard(program: framework.Program, block_idx: int):
+    """Temporarily redirect layer appends to `block_idx`."""
+    old = program._current_block_idx
+    program._current_block_idx = block_idx
+    try:
+        yield
+    finally:
+        program._current_block_idx = old

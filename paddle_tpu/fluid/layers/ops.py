@@ -51,13 +51,14 @@ def _make_binary(op):
 
 
 def _make_compare(op):
-    def layer(x, y, cond=None):
+    def layer(x, y, cond=None, force_cpu=None):
         helper = LayerHelper(op)
         if cond is None:
             cond = helper.create_variable_for_type_inference("bool")
         helper.append_op(op, inputs={"X": [x], "Y": [y]},
                          outputs={"Out": [cond]})
         cond.stop_gradient = True
+        cond.desc.dtype = "bool"
         return cond
     layer.__name__ = op
     return layer

@@ -8,3 +8,5 @@ from paddle_tpu.ops import nn_ops  # noqa: F401
 from paddle_tpu.ops import optimizer_ops  # noqa: F401
 from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import grad_ops  # noqa: F401
+from paddle_tpu.ops import control_flow  # noqa: F401
+from paddle_tpu.ops import rnn_ops  # noqa: F401

@@ -1,0 +1,171 @@
+"""Control-flow ops: sub-blocks lowered to XLA structured control flow.
+
+Capability parity with the reference's control-flow operators
+(reference: operators/controlflow/while_op.cc:50,
+conditional_block_op.cc, tensor_array_read_write_op.cc), re-designed for
+XLA's trace-once model: where the reference interprets a sub-block per
+iteration with a child scope per step (while_op.cc:64-70, and keeps all
+child scopes alive for while_grad — executor.cc:466 comment), we lower
+
+- `while`  -> lax.while_loop   (non-differentiable loops: counters,
+                                decode/beam-search loops)
+- `cond`   -> lax.cond         (differentiable branch select)
+- `scan`   -> lax.scan         (differentiable recurrence: the StaticRNN /
+                                DynamicRNN capability; reverse-mode grads
+                                come from lax.scan's native VJP instead of
+                                the reference's while_grad + kept scopes)
+
+Tensor arrays (LOD_TENSOR_ARRAY capability) are fixed-capacity stacked
+tensors [max_len, ...] with dynamic_update_slice writes — XLA needs static
+shapes, so capacity is declared up front (the reference grows arrays
+dynamically, tensor_array_read_write_op.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import EmitContext, first, register_op, single
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(jnp.bool_)
+
+
+@register_op("while", no_grad=True, ref="operators/controlflow/while_op.cc:50")
+def _while(ctx: EmitContext, ins, attrs):
+    """attrs: sub_block, cond_var, carry_vars (names bound+returned each
+    iteration, includes cond_var), x_vars (loop-invariant external reads).
+    inputs: Carry (init values, parent order = carry_vars), X.
+    outputs: Out (final carry values)."""
+    from paddle_tpu.core.lowering import emit_subblock
+
+    carry_vars = list(attrs["carry_vars"])
+    cond_var = attrs["cond_var"]
+    cond_idx = carry_vars.index(cond_var)
+    consts = dict(zip(attrs.get("x_vars", []), ins.get("X", [])))
+    init = tuple(ins.get("Carry", []))
+
+    def cond_fn(carry):
+        return _scalar_bool(carry[0][cond_idx])
+
+    def body_fn(carry):
+        vals, it = carry
+        env = dict(consts)
+        env.update(zip(carry_vars, vals))
+        emit_subblock(ctx, attrs["sub_block"], env, key_salt=it)
+        return (tuple(
+            jnp.asarray(env[n]).astype(c.dtype).reshape(c.shape)
+            for n, c in zip(carry_vars, vals)), it + 1)
+
+    final, _ = lax.while_loop(cond_fn, body_fn,
+                              (init, jnp.asarray(0, jnp.int32)))
+    return {"Out": list(final)}
+
+
+@register_op("cond", ref="operators/controlflow/conditional_block_op.cc "
+                         "(capability; both branches computed, XLA-style)")
+def _cond(ctx: EmitContext, ins, attrs):
+    """attrs: sub_block_true, sub_block_false (-1 = identity), out_vars,
+    x_vars. inputs: Cond (scalar-able bool), X. outputs: Out (out_vars order).
+    out_vars missing from a branch fall through to their pre-branch values
+    (which must then appear in x_vars)."""
+    from paddle_tpu.core.lowering import emit_subblock
+
+    pred = _scalar_bool(first(ins, "Cond"))
+    out_vars = list(attrs["out_vars"])
+    consts = dict(zip(attrs.get("x_vars", []), ins.get("X", [])))
+
+    def make_branch(block_idx):
+        def branch(operands):
+            env = dict(operands)
+            if block_idx is not None and block_idx >= 0:
+                emit_subblock(ctx, block_idx, env)
+            return tuple(env[n] for n in out_vars)
+        return branch
+
+    true_fn = make_branch(attrs.get("sub_block_true", -1))
+    false_fn = make_branch(attrs.get("sub_block_false", -1))
+    # shapes/dtypes of the two branches must agree; cast false to true's
+    t_shapes = jax.eval_shape(true_fn, consts)
+    raw_false = false_fn
+
+    def false_cast(operands):
+        outs = raw_false(operands)
+        return tuple(jnp.reshape(o, a.shape).astype(a.dtype)
+                     for o, a in zip(outs, t_shapes))
+
+    outs = lax.cond(pred, true_fn, false_cast, consts)
+    return {"Out": list(outs)}
+
+
+@register_op("scan", ref="capability of StaticRNN/DynamicRNN "
+                         "(layers/control_flow.py, while_op.cc:50) lowered "
+                         "to lax.scan — native reverse-mode VJP replaces "
+                         "while_grad's kept child scopes (executor.cc:466)")
+def _scan(ctx: EmitContext, ins, attrs):
+    """attrs: sub_block, scan_in_vars (in-body per-step names),
+    carry_in_vars, carry_out_vars (in-body names at step start/end),
+    scan_out_vars (in-body names stacked over time), x_vars, reverse.
+    inputs: ScanIn ([T, ...] arrays), Carry (init values), X.
+    outputs: Out (stacked [T, ...]), FinalCarry."""
+    from paddle_tpu.core.lowering import emit_subblock
+
+    scan_in_vars = list(attrs.get("scan_in_vars", []))
+    carry_in = list(attrs.get("carry_in_vars", []))
+    carry_out = list(attrs.get("carry_out_vars", []))
+    scan_out = list(attrs.get("scan_out_vars", []))
+    consts = dict(zip(attrs.get("x_vars", []), ins.get("X", [])))
+    xs = tuple(ins.get("ScanIn", []))
+    init = tuple(ins.get("Carry", []))
+
+    def body(carry, xs_t):
+        vals, it = carry
+        env = dict(consts)
+        env.update(zip(carry_in, vals))
+        env.update(zip(scan_in_vars, xs_t))
+        emit_subblock(ctx, attrs["sub_block"], env, key_salt=it)
+        new_vals = tuple(
+            jnp.asarray(env[n]).astype(c.dtype).reshape(c.shape)
+            for n, c in zip(carry_out, vals))
+        return (new_vals, it + 1), tuple(env[n] for n in scan_out)
+
+    (final, _), stacked = lax.scan(body, (init, jnp.asarray(0, jnp.int32)),
+                                   xs if xs else None,
+                                   length=attrs.get("length"),
+                                   reverse=bool(attrs.get("reverse", False)))
+    return {"Out": list(stacked), "FinalCarry": list(final)}
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference: operators/controlflow/tensor_array_read_write_op.cc,
+# lod_array_length_op.cc; VarType LOD_TENSOR_ARRAY framework.proto).
+# Fixed-capacity design: the array IS a [capacity, ...] tensor.
+# ---------------------------------------------------------------------------
+
+@register_op("array_write", ref="operators/controlflow/tensor_array_read_write_op.cc")
+def _array_write(ctx, ins, attrs):
+    arr = first(ins, "Array")
+    x = first(ins, "X")
+    i = jnp.reshape(first(ins, "I"), ()).astype(jnp.int32)
+    x = jnp.asarray(x).astype(arr.dtype)
+    upd = jnp.expand_dims(x, 0)
+    idx = (i,) + (0,) * (arr.ndim - 1)
+    return {"Out": [lax.dynamic_update_slice(arr, upd, idx)]}
+
+
+@register_op("array_read", ref="operators/controlflow/tensor_array_read_write_op.cc")
+def _array_read(ctx, ins, attrs):
+    arr = first(ins, "Array")
+    i = jnp.reshape(first(ins, "I"), ()).astype(jnp.int32)
+    return single(lax.dynamic_index_in_dim(arr, i, axis=0, keepdims=False))
+
+
+@register_op("array_length", no_grad=True,
+             ref="operators/controlflow/lod_array_length_op.cc (capacity, "
+                 "not a dynamic fill count — fixed-capacity design)")
+def _array_length(ctx, ins, attrs):
+    arr = first(ins, "Array")
+    return single(jnp.full((1,), arr.shape[0], dtype=jnp.int64))

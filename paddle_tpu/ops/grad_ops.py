@@ -55,7 +55,8 @@ def _vjp_emit(ctx: EmitContext, ins, attrs):
     fwd_ctx = EmitContext(base_key=ctx.base_key,
                           step_base_key=ctx.step_base_key,
                           op_index=attrs["fwd_op_index"],
-                          is_test=ctx.is_test)
+                          is_test=ctx.is_test,
+                          program=ctx.program)
 
     diff_idx = [i for i, m in enumerate(diff_mask) if m]
 

@@ -1,0 +1,186 @@
+"""Fused recurrent ops: dynamic_lstm / dynamic_gru and single-step units.
+
+Capability parity with the reference's LSTM/GRU operators
+(reference: operators/lstm_op.cc, operators/gru_op.cc,
+operators/lstm_unit_op.cc, operators/gru_unit_op.cc and the fused compute
+kernels in operators/math/lstm_compute.cc, math/gru_compute.cc; the
+reference also JIT-generates x86 microkernels for these cells,
+operators/jit/gen/lstm.cc). TPU-native redesign: one lax.scan over time
+with the whole cell fused by XLA; variable-length sequences are padded
+[B, T, ...] + seq_lens masks (the segment-ids LoD replacement) instead of
+LoD-sorted shrinking batches.
+
+Gate conventions follow the reference:
+- LSTM input projection is done *outside* (by fc) so Input is [B, T, 4H];
+  gate order [i, f, c~, o] with sigmoid gates, tanh candidate/cell act;
+  optional peephole weights in the 7H bias (lstm_op.cc OpMaker).
+- GRU input projection outside, Input [B, T, 3H]; gate order [u, r, c~];
+  h_t = (1 - u_t) * h_{t-1} + u_t * c_t (gru_op.cc:147, gru_unit_op.cc:121,
+  math/detail/gru_kernel.h:62).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACTS[name or "tanh"]
+
+
+def _mask_for(t, seq_lens, like):
+    """[B, 1] float mask: 1.0 while t < seq_len."""
+    if seq_lens is None:
+        return jnp.ones((like.shape[0], 1), dtype=like.dtype)
+    return (t < seq_lens.reshape(-1, 1)).astype(like.dtype)
+
+
+@register_op("dynamic_lstm", ref="operators/lstm_op.cc; math/lstm_compute.cc")
+def _dynamic_lstm(ctx, ins, attrs):
+    """inputs: Input [B,T,4H] (pre-projected x), Weight [H,4H] (recurrent),
+    Bias [1,4H] or [1,7H] (+peepholes W_ic/W_fc/W_oc), optional H0/C0 [B,H],
+    optional SeqLens [B]. outputs: Hidden [B,T,H], Cell [B,T,H],
+    LastHidden/LastCell [B,H] (last *valid* step per row)."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    seq_lens = first(ins, "SeqLens")
+    B, T, H4 = x.shape
+    H = H4 // 4
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    use_peepholes = bool(attrs.get("use_peepholes", False)) and \
+        bias is not None and bias.shape[-1] == 7 * H
+    is_reverse = bool(attrs.get("is_reverse", False))
+
+    if bias is not None:
+        b_gates = bias.reshape(-1)[:4 * H]
+        x = x + b_gates
+        if use_peepholes:
+            peep = bias.reshape(-1)[4 * H:]
+            w_ic, w_fc, w_oc = peep[:H], peep[H:2 * H], peep[2 * H:3 * H]
+    h0 = first(ins, "H0")
+    c0 = first(ins, "C0")
+    h = h0 if h0 is not None else jnp.zeros((B, H), dtype=x.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, H), dtype=x.dtype)
+
+    xt_seq = jnp.swapaxes(x, 0, 1)  # [T, B, 4H]
+
+    def step(carry, xt_t):
+        h_prev, c_prev, t = carry
+        gates = xt_t + h_prev @ w  # [B, 4H] — one MXU matmul per step
+        gi = gates[:, 0 * H:1 * H]
+        gf = gates[:, 1 * H:2 * H]
+        gc = gates[:, 2 * H:3 * H]
+        go = gates[:, 3 * H:4 * H]
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c_prev + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        m = _mask_for(t, seq_lens, h_new)
+        h_new = m * h_new + (1 - m) * h_prev
+        c_new = m * c_new + (1 - m) * c_prev
+        t_next = t + (-1 if is_reverse else 1)
+        return (h_new, c_new, t_next), (h_new * m, c_new * m)
+
+    t0 = jnp.asarray(T - 1 if is_reverse else 0, dtype=jnp.int32)
+    (h_last, c_last, _), (hs, cs) = lax.scan(
+        step, (h, c, t0), xt_seq, reverse=is_reverse)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "LastHidden": [h_last], "LastCell": [c_last]}
+
+
+@register_op("dynamic_gru", ref="operators/gru_op.cc; math/gru_compute.cc")
+def _dynamic_gru(ctx, ins, attrs):
+    """inputs: Input [B,T,3H] (pre-projected), Weight [H,3H] (recurrent:
+    [:, :2H] update/reset, [:, 2H:] candidate), optional Bias [1,3H],
+    optional H0 [B,H], optional SeqLens [B]. outputs: Hidden [B,T,H],
+    LastHidden [B,H]."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    seq_lens = first(ins, "SeqLens")
+    B, T, H3 = x.shape
+    H = H3 // 3
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    if bias is not None:
+        x = x + bias.reshape(-1)[:3 * H]
+    w_ur = w[:, :2 * H]   # [H, 2H]
+    w_c = w[:, 2 * H:]    # [H, H]
+    h0 = first(ins, "H0")
+    h = h0 if h0 is not None else jnp.zeros((B, H), dtype=x.dtype)
+    xt_seq = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, xt_t):
+        h_prev, t = carry
+        ur = gate_act(xt_t[:, :2 * H] + h_prev @ w_ur)
+        u, r = ur[:, :H], ur[:, H:]
+        c = cand_act(xt_t[:, 2 * H:] + (r * h_prev) @ w_c)
+        h_new = (1.0 - u) * h_prev + u * c
+        m = _mask_for(t, seq_lens, h_new)
+        h_new = m * h_new + (1 - m) * h_prev
+        t_next = t + (-1 if is_reverse else 1)
+        return (h_new, t_next), h_new * m
+
+    t0 = jnp.asarray(T - 1 if is_reverse else 0, dtype=jnp.int32)
+    (h_last, _), hs = lax.scan(step, (h, t0), xt_seq, reverse=is_reverse)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastHidden": [h_last]}
+
+
+@register_op("lstm_unit", ref="operators/lstm_unit_op.cc")
+def _lstm_unit(ctx, ins, attrs):
+    """Single fused LSTM step: inputs X [B,4H] (pre-projected gates incl.
+    recurrent term), C_prev [B,H]; outputs C, H."""
+    x = first(ins, "X")
+    c_prev = first(ins, "C_prev")
+    H = c_prev.shape[-1]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i = jax.nn.sigmoid(x[:, :H])
+    f = jax.nn.sigmoid(x[:, H:2 * H] + forget_bias)
+    z = jnp.tanh(x[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(x[:, 3 * H:])
+    c = f * c_prev + i * z
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit", ref="operators/gru_unit_op.cc")
+def _gru_unit(ctx, ins, attrs):
+    """Single fused GRU step: inputs Input [B,3H] (pre-projected), HiddenPrev
+    [B,H], Weight [H,3H], optional Bias [1,3H]; outputs Hidden [B,H]."""
+    x = first(ins, "Input")
+    h_prev = first(ins, "HiddenPrev")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    H = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    ur = gate_act(x[:, :2 * H] + h_prev @ w[:, :2 * H])
+    u, r = ur[:, :H], ur[:, H:]
+    c = cand_act(x[:, 2 * H:] + (r * h_prev) @ w[:, 2 * H:])
+    h = (1.0 - u) * h_prev + u * c
+    return {"Hidden": [h]}

@@ -1,0 +1,398 @@
+"""Control flow + recurrence tests.
+
+Mirrors the reference's control-flow unit tests
+(reference: python/paddle/fluid/tests/unittests/test_while_op.py,
+test_dynrnn_static_input.py, test_dynamic_rnn_*, test_lstm_op.py,
+test_gru_op.py) on the TPU-native lowering (lax.while_loop/cond/scan).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+from paddle_tpu.fluid.layers import control_flow as cf
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_while_loop_sum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=10)
+        acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = cf.less_than(i, n)
+        w = cf.While(cond)
+        with w.block():
+            layers.assign(acc + layers.cast(i, "float32"), output=acc)
+            cf.increment(i, 1)
+            cf.less_than(i, n, cond=cond)
+    exe = _exe()
+    exe.run(startup)
+    out, iv = exe.run(main, feed={}, fetch_list=[acc.name, i.name])
+    assert float(np.asarray(out).reshape(())) == 45.0
+    assert int(np.asarray(iv).reshape(())) == 10
+
+
+def test_while_requires_cond_update():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=10)
+        cond = cf.less_than(i, n)
+        w = cf.While(cond)
+        with pytest.raises(ValueError, match="never reassigns"):
+            with w.block():
+                cf.increment(i, 1)
+
+
+def test_tensor_array_in_while():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        n = layers.fill_constant(shape=[1], dtype="int32", value=5)
+        arr = cf.create_array("float32", capacity=5, elem_shape=[2])
+        cond = cf.less_than(i, n)
+        w = cf.While(cond)
+        with w.block():
+            val = layers.expand(
+                layers.reshape(layers.cast(i, "float32"), [1, 1]),
+                expand_times=[1, 2])
+            val = layers.reshape(val, [2])
+            written = cf.array_write(val, i, arr)
+            layers.assign(written, output=arr)
+            cf.increment(i, 1)
+            cf.less_than(i, n, cond=cond)
+    exe = _exe()
+    exe.run(startup)
+    (av,) = exe.run(main, feed={}, fetch_list=[arr.name])
+    expect = np.repeat(np.arange(5, dtype="float32")[:, None], 2, axis=1)
+    np.testing.assert_allclose(np.asarray(av), expect)
+
+
+def test_static_rnn_cumsum():
+    T, B, D = 5, 3, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, B, D], dtype="float32",
+                        append_batch_size=False)
+        rnn = cf.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(shape=[D], batch_ref=x_t, init_value=0.0)
+            nh = layers.elementwise_add(h, x_t)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(T, B, D).astype("float32")
+    (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(ov), np.cumsum(xv, axis=0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Gradients flow through lax.scan's VJP (replaces while_grad)."""
+    T, B, D = 5, 3, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, B, D], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data(name="y", shape=[B, 1], dtype="float32",
+                        append_batch_size=False)
+        rnn = cf.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(shape=[4], batch_ref=x_t, init_value=0.0)
+            nh = layers.fc(layers.concat([x_t, h], axis=1), 4, act="tanh")
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        seq = rnn()
+        last = layers.reshape(
+            layers.slice(seq, axes=[0], starts=[T - 1], ends=[T]), [-1, 4])
+        loss = layers.reduce_mean(
+            layers.square_error_cost(layers.fc(last, 1), y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xv = rng.rand(T, B, D).astype("float32")
+    yv = xv.sum(axis=(0, 2)).reshape(B, 1).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"x": xv, "y": yv},
+                                       fetch_list=[loss.name])[0]).reshape(()))
+              for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_dynamic_rnn_masked_cumsum():
+    B, T, D = 4, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[B, T, D], dtype="float32",
+                        append_batch_size=False)
+        lens = layers.data(name="lens", shape=[B], dtype="int32",
+                           append_batch_size=False)
+        drnn = cf.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, seq_lens=lens)
+            h = drnn.memory(shape=[D], value=0.0)
+            nh = layers.elementwise_add(h, x_t)
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.RandomState(2).rand(B, T, D).astype("float32")
+    lv = np.array([6, 3, 1, 4], dtype="int32")
+    (ov,) = exe.run(main, feed={"x": xv, "lens": lv}, fetch_list=[out.name])
+    ref = np.cumsum(xv, axis=1)
+    for b in range(B):
+        ref[b, lv[b]:] = 0.0
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ifelse_select_semantics():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data(name="a", shape=[4, 1], dtype="float32",
+                        append_batch_size=False)
+        zero = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = layers.less_than(a, zero)
+        ie = cf.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(a), scale=-1.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(a), scale=2.0))
+        out = ie()[0]
+    exe = _exe()
+    exe.run(startup)
+    av = np.array([[-1.0], [2.0], [-3.0], [4.0]], dtype="float32")
+    (ov,) = exe.run(main, feed={"a": av}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(ov), np.where(av < 0, -av, 2 * av))
+
+
+def test_switch_first_match_wins():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        step = layers.fill_constant(shape=[1], dtype="float32", value=7.0)
+        five = layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+        ten = layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+        with cf.Switch() as sw:
+            with sw.case(cf.less_than(step, five)):
+                layers.assign(layers.fill_constant([1], "float32", 0.1),
+                              output=lr)
+            with sw.case(cf.less_than(step, ten)):
+                layers.assign(layers.fill_constant([1], "float32", 0.01),
+                              output=lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.001),
+                              output=lr)
+    exe = _exe()
+    exe.run(startup)
+    (lv,) = exe.run(main, feed={}, fetch_list=[lr.name])
+    assert float(np.asarray(lv).reshape(())) == np.float32(0.01)
+
+
+# ---------------------------------------------------------------------------
+# fused RNN ops vs numpy references
+# ---------------------------------------------------------------------------
+
+def _np_lstm(x, w, b, lens=None, peephole=False):
+    B, T, H4 = x.shape
+    H = H4 // 4
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    h = np.zeros((B, H), "float64")
+    c = np.zeros((B, H), "float64")
+    hs = np.zeros((B, T, H), "float64")
+    cs = np.zeros((B, T, H), "float64")
+    bg = b.reshape(-1)[:4 * H]
+    if peephole:
+        w_ic, w_fc, w_oc = (b.reshape(-1)[4 * H:5 * H],
+                            b.reshape(-1)[5 * H:6 * H],
+                            b.reshape(-1)[6 * H:7 * H])
+    for t in range(T):
+        g = x[:, t] + bg + h @ w
+        gi, gf, gc, go = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                          g[:, 3 * H:])
+        if peephole:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i, f = sig(gi), sig(gf)
+        c_new = f * c + i * np.tanh(gc)
+        if peephole:
+            go = go + c_new * w_oc
+        o = sig(go)
+        h_new = o * np.tanh(c_new)
+        if lens is not None:
+            m = (t < lens).astype("float64")[:, None]
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+            hs[:, t] = h_new * m
+            cs[:, t] = c_new * m
+        else:
+            hs[:, t] = h_new
+            cs[:, t] = c_new
+        h, c = h_new, c_new
+    return hs, cs, h, c
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+def test_dynamic_lstm_matches_numpy(peephole):
+    B, T, H = 3, 4, 5
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, T, 4 * H).astype("float32") * 0.3
+    w = rng.randn(H, 4 * H).astype("float32") * 0.3
+    b = rng.randn(1, 7 * H if peephole else 4 * H).astype("float32") * 0.1
+    lens = np.array([4, 2, 3], dtype="int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [B, T, 4 * H], append_batch_size=False)
+        lv = layers.data("lens", [B], dtype="int32", append_batch_size=False)
+        hidden, cell = layers.dynamic_lstm(
+            xv, 4 * H, seq_lens=lv, use_peepholes=peephole,
+            param_attr=fluid.ParamAttr(name="lstm_w"),
+            bias_attr=fluid.ParamAttr(name="lstm_b"))
+    exe = _exe()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    scope.set_var("lstm_w", w)
+    scope.set_var("lstm_b", b)
+    hv, cv = exe.run(main, feed={"x": x, "lens": lens},
+                     fetch_list=[hidden.name, cell.name])
+    hs, cs, _, _ = _np_lstm(x.astype("float64"), w.astype("float64"),
+                            b.astype("float64"), lens, peephole)
+    np.testing.assert_allclose(np.asarray(hv), hs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(cv), cs, rtol=1e-4, atol=1e-4)
+
+
+def test_dynamic_gru_matches_numpy():
+    B, T, H = 3, 4, 5
+    rng = np.random.RandomState(4)
+    x = rng.randn(B, T, 3 * H).astype("float32") * 0.3
+    w = rng.randn(H, 3 * H).astype("float32") * 0.3
+    b = rng.randn(1, 3 * H).astype("float32") * 0.1
+    lens = np.array([4, 1, 3], dtype="int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [B, T, 3 * H], append_batch_size=False)
+        lv = layers.data("lens", [B], dtype="int32", append_batch_size=False)
+        hidden = layers.dynamic_gru(
+            xv, H, seq_lens=lv, param_attr=fluid.ParamAttr(name="gru_w"),
+            bias_attr=fluid.ParamAttr(name="gru_b"))
+    exe = _exe()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    scope.set_var("gru_w", w)
+    scope.set_var("gru_b", b)
+    (hv,) = exe.run(main, feed={"x": x, "lens": lens},
+                    fetch_list=[hidden.name])
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    h = np.zeros((B, H))
+    hs = np.zeros((B, T, H))
+    xb = x.astype("float64") + b.reshape(-1)
+    for t in range(T):
+        ur = sig(xb[:, t, :2 * H] + h @ w[:, :2 * H].astype("float64"))
+        u, r = ur[:, :H], ur[:, H:]
+        cand = np.tanh(xb[:, t, 2 * H:] + (r * h) @ w[:, 2 * H:].astype("float64"))
+        h_new = (1 - u) * h + u * cand
+        m = (t < lens).astype("float64")[:, None]
+        h_new = m * h_new + (1 - m) * h
+        hs[:, t] = h_new * m
+        h = h_new
+    np.testing.assert_allclose(np.asarray(hv), hs, rtol=1e-4, atol=1e-4)
+
+
+def test_lstm_trains_on_sequence_classification():
+    """End-to-end: embedding -> fc -> dynamic_lstm -> last state -> fc."""
+    B, T, V, E, H = 8, 6, 30, 8, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B, T], dtype="int32",
+                          append_batch_size=False)
+        label = layers.data("label", [B, 1], dtype="int32",
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=[V, E])
+        proj = layers.fc(layers.reshape(emb, [-1, E]), 4 * H)
+        proj = layers.reshape(proj, [B, T, 4 * H])
+        hidden, _ = layers.dynamic_lstm(proj, 4 * H, use_peepholes=False)
+        last = layers.reshape(
+            layers.slice(hidden, axes=[1], starts=[T - 1], ends=[T]),
+            [-1, H])
+        logits = layers.fc(last, 2)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    ids_v = rng.randint(0, V, size=(B, T)).astype("int32")
+    label_v = (ids_v[:, 0] % 2).astype("int32").reshape(B, 1)
+    losses = [float(np.asarray(
+        exe.run(main, feed={"ids": ids_v, "label": label_v},
+                fetch_list=[loss.name])[0]).reshape(()))
+        for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_switch_disjoint_write_sets():
+    """A later matching case must not leak writes when an earlier case
+    already matched, even for vars the earlier case does not write."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = layers.fill_constant(shape=[1], dtype="float32", value=9.0)
+        wd = layers.fill_constant(shape=[1], dtype="float32", value=9.0)
+        step = layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+        five = layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+        ten = layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+        with cf.Switch() as sw:
+            with sw.case(cf.less_than(step, five)):      # matches
+                layers.assign(layers.fill_constant([1], "float32", 0.1),
+                              output=lr)
+            with sw.case(cf.less_than(step, ten)):       # also true, skipped
+                layers.assign(layers.fill_constant([1], "float32", 0.5),
+                              output=lr)
+                layers.assign(layers.fill_constant([1], "float32", 0.7),
+                              output=wd)
+    exe = _exe()
+    exe.run(startup)
+    lv, wv = exe.run(main, feed={}, fetch_list=[lr.name, wd.name])
+    assert float(np.asarray(lv).reshape(())) == np.float32(0.1)
+    # wd untouched: the second case must not fire at all
+    assert float(np.asarray(wv).reshape(())) == np.float32(9.0)
+
+
+def test_dropout_varies_across_scan_steps():
+    """Random ops inside a scan body must draw fresh randomness per step
+    (the reference re-interprets the body per iteration with fresh seeds)."""
+    T, B, D = 4, 2, 64
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, B, D], dtype="float32",
+                        append_batch_size=False)
+        rnn = cf.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h = rnn.memory(shape=[D], batch_ref=x_t, init_value=0.0)
+            d = layers.dropout(x_t, dropout_prob=0.5)
+            nh = layers.elementwise_add(h, d)
+            rnn.update_memory(h, nh)
+            rnn.step_output(d)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.ones((T, B, D), dtype="float32")
+    (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    ov = np.asarray(ov)
+    masks = (ov != 0.0)
+    # all-steps-identical masks means the rng key never varies per step
+    assert any(not np.array_equal(masks[0], masks[t]) for t in range(1, T))
