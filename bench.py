@@ -27,14 +27,15 @@ ALEXNET_K40M_IMG_S = 425.0      # benchmark/README.md:33-38, bs256
 RESNET50_XEON_IMG_S = 81.69     # IntelOptimizedPaddle.md:39-46, bs64
 
 
-def _device_batch(exe, feed_specs, batch_size, seed=0):
+def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None):
     import jax
     rng = np.random.RandomState(seed)
     feeds = {}
     for name, (shape, dtype) in feed_specs.items():
         shape = [batch_size if d == -1 else d for d in shape]
         if dtype.startswith("int"):
-            arr = rng.randint(0, 10, size=shape).astype(dtype)
+            lo, hi = (int_ranges or {}).get(name, (0, 10))
+            arr = rng.randint(lo, hi, size=shape).astype(dtype)
         else:
             arr = rng.rand(*shape).astype(dtype)
         feeds[name] = jax.device_put(arr, exe.device)
@@ -54,7 +55,14 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
         "transformer": (models.transformer.build,
                         {"max_len": 64, "src_vocab": 32000,
                          "tgt_vocab": 32000}, "tokens/sec", None),
+        "stacked_dynamic_lstm": (models.stacked_dynamic_lstm.build,
+                                 {"max_len": 100}, "words/sec", None),
     }
+    # valid ranges for integer feeds (labels in-class, seq_lens >= 1)
+    int_ranges = {
+        "stacked_dynamic_lstm": {"words": (0, 5000), "label": (0, 2),
+                                 "seq_lens": (1, 101)},
+    }.get(model_name)
     build_fn, kw, unit, baseline = builders[model_name]
 
     main, startup = fluid.Program(), fluid.Program()
@@ -64,7 +72,7 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
-    feeds = _device_batch(exe, feed_specs, batch_size)
+    feeds = _device_batch(exe, feed_specs, batch_size, int_ranges=int_ranges)
 
     # fetch nothing during the timed loop (tunnel D2H is ~100ms/fetch).
     # NOTE: block_until_ready is a no-op on the axon platform, so the fence
@@ -88,7 +96,7 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
     dt = max(time.time() - t0 - fence_cost, 1e-6)
 
     per_step = batch_size
-    if unit == "tokens/sec":
+    if unit in ("tokens/sec", "words/sec"):
         per_step = batch_size * kw.get("max_len", 64)
     value = per_step * steps / dt
 
@@ -105,12 +113,14 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="alexnet",
-                    choices=["alexnet", "resnet50", "transformer", "mnist"])
+                    choices=["alexnet", "resnet50", "transformer", "mnist",
+                             "stacked_dynamic_lstm"])
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20)
     args = ap.parse_args()
     bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
-                             "transformer": 32, "mnist": 512}[args.model]
+                             "transformer": 32, "mnist": 512,
+                             "stacked_dynamic_lstm": 64}[args.model]
     result = run_bench(args.model, bs, args.steps)
     print(json.dumps(result))
 

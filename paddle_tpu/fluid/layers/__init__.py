@@ -17,6 +17,12 @@ from paddle_tpu.fluid.layers.rnn import (  # noqa: F401
 from paddle_tpu.fluid.layers.control_flow import (  # noqa: F401
     DynamicRNN, IfElse, StaticRNN, Switch, While, array_length, array_read,
     array_write, create_array, increment)
+from paddle_tpu.fluid.layers.sequence import (  # noqa: F401
+    edit_distance, sequence_concat, sequence_conv, sequence_enumerate,
+    sequence_erase, sequence_expand, sequence_expand_as, sequence_first_step,
+    sequence_last_step, sequence_mask, sequence_pad, sequence_pool,
+    sequence_reshape, sequence_reverse, sequence_slice, sequence_softmax,
+    sequence_unpad)
 from paddle_tpu.fluid.layers.ops import (  # noqa: F401
     abs, ceil, cos, elementwise_add, elementwise_div, elementwise_max,
     elementwise_min, elementwise_mod, elementwise_mul, elementwise_pow,

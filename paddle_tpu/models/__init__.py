@@ -8,7 +8,7 @@ a mesh.
 """
 
 from paddle_tpu.models import (alexnet, deepfm, mnist, resnet, se_resnext,
-                               transformer, vgg)
+                               stacked_dynamic_lstm, transformer, vgg)
 
 __all__ = ["alexnet", "deepfm", "mnist", "resnet", "se_resnext",
-           "transformer", "vgg"]
+           "stacked_dynamic_lstm", "transformer", "vgg"]

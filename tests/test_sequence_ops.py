@@ -1,0 +1,233 @@
+"""Sequence-op tests: padded [B,T,...]+seq_lens semantics checked against
+ragged numpy references (reference test pattern: the OpTest subclasses in
+python/paddle/fluid/tests/unittests/test_sequence_*.py, which build ragged
+LoD inputs; here the ragged reference is computed per row in numpy)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _x(B=3, T=5, D=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(B, T, D).astype(np.float32) - 0.5)
+
+
+LENS = np.array([5, 3, 1], dtype=np.int32)
+
+
+def _seq_ins(x, lens=LENS, slot="X"):
+    return {slot: {"x": x}, "SeqLens": {"lens": lens}}
+
+
+@pytest.mark.parametrize("pooltype,ref", [
+    ("SUM", lambda r: r.sum(0)),
+    ("AVERAGE", lambda r: r.mean(0)),
+    ("SQRT", lambda r: r.sum(0) / np.sqrt(len(r))),
+    ("MAX", lambda r: r.max(0)),
+    ("LAST", lambda r: r[-1]),
+    ("FIRST", lambda r: r[0]),
+])
+def test_sequence_pool_forward(pooltype, ref):
+    x = _x()
+    out = run_single_op("sequence_pool", _seq_ins(x),
+                        attrs={"pooltype": pooltype})["__out_Out_0"]
+    want = np.stack([ref(x[b, :LENS[b]]) for b in range(3)])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("pooltype", ["SUM", "AVERAGE", "SQRT", "LAST"])
+def test_sequence_pool_grad(pooltype):
+    check_grad("sequence_pool", _seq_ins(_x()),
+               attrs={"pooltype": pooltype})
+
+
+def test_sequence_pool_max_grad():
+    # keep max positions unique so the subgradient is stable
+    x = _x() + np.arange(5).reshape(1, 5, 1).astype(np.float32)
+    check_grad("sequence_pool", _seq_ins(x), attrs={"pooltype": "MAX"})
+
+
+def test_sequence_softmax():
+    x = _x(D=1).squeeze(-1)  # [B, T]
+    out = run_single_op("sequence_softmax", _seq_ins(x))["__out_Out_0"]
+    for b in range(3):
+        L = LENS[b]
+        e = np.exp(x[b, :L] - x[b, :L].max())
+        np.testing.assert_allclose(out[b, :L], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[b, L:], 0.0)
+    check_grad("sequence_softmax", _seq_ins(x), atol=5e-4)
+
+
+def test_sequence_conv():
+    x = _x()
+    f = (np.random.RandomState(1).rand(3 * 4, 6).astype(np.float32) - 0.5)
+    ins = _seq_ins(x)
+    ins["Filter"] = {"f": f}
+    out = run_single_op("sequence_conv", ins,
+                        attrs={"contextLength": 3, "contextStart": -1}
+                        )["__out_Out_0"]
+    # ragged reference: pad each row's valid prefix with one zero row each side
+    for b in range(3):
+        L = int(LENS[b])
+        seq = x[b, :L]
+        padded = np.concatenate([np.zeros((1, 4), np.float32), seq,
+                                 np.zeros((1, 4), np.float32)])
+        for t in range(L):
+            col = padded[t:t + 3].reshape(-1)
+            np.testing.assert_allclose(out[b, t], col @ f, rtol=1e-4,
+                                       atol=1e-5)
+        np.testing.assert_allclose(out[b, L:], 0.0)
+    check_grad("sequence_conv", ins,
+               attrs={"contextLength": 3, "contextStart": -1})
+
+
+def test_sequence_expand():
+    xb = _x()[:, 0, :]  # [B, D]
+    y = _x(seed=2)
+    ins = {"X": {"x": xb}, "Y": {"y": y}, "SeqLens": {"lens": LENS}}
+    out = run_single_op("sequence_expand", ins)["__out_Out_0"]
+    for b in range(3):
+        L = int(LENS[b])
+        np.testing.assert_allclose(out[b, :L], np.tile(xb[b], (L, 1)),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out[b, L:], 0.0)
+    check_grad("sequence_expand", ins, grad_vars=["x"])
+
+
+def test_sequence_reverse():
+    x = _x()
+    out = run_single_op("sequence_reverse", _seq_ins(x),
+                        out_slots=("Y",))["__out_Y_0"]
+    for b in range(3):
+        L = int(LENS[b])
+        np.testing.assert_allclose(out[b, :L], x[b, :L][::-1], rtol=1e-6)
+        np.testing.assert_allclose(out[b, L:], x[b, L:], rtol=1e-6)
+
+
+def test_sequence_concat():
+    x1, x2 = _x(T=4), _x(T=3, seed=3)
+    l1 = np.array([4, 2, 1], np.int32)
+    l2 = np.array([2, 3, 0], np.int32)
+    ins = {"X": {"a": x1, "b": x2}, "SeqLens": {"la": l1, "lb": l2}}
+    res = run_single_op("sequence_concat", ins,
+                        out_slots=("Out", "NewLens"))
+    out, lens = res["__out_Out_0"], res["__out_NewLens_0"]
+    np.testing.assert_array_equal(lens, l1 + l2)
+    for b in range(3):
+        want = np.concatenate([x1[b, :l1[b]], x2[b, :l2[b]]])
+        np.testing.assert_allclose(out[b, :len(want)], want, rtol=1e-6)
+        np.testing.assert_allclose(out[b, len(want):], 0.0)
+
+
+def test_sequence_slice():
+    x = _x()
+    off = np.array([1, 0, 0], np.int32)
+    length = np.array([3, 2, 1], np.int32)
+    ins = {"X": {"x": x}, "Offset": {"o": off}, "Length": {"l": length}}
+    out = run_single_op("sequence_slice", ins,
+                        out_slots=("Out",))["__out_Out_0"]
+    for b in range(3):
+        np.testing.assert_allclose(out[b, :length[b]],
+                                   x[b, off[b]:off[b] + length[b]], rtol=1e-6)
+        np.testing.assert_allclose(out[b, length[b]:], 0.0)
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 2, 3, 5], [1, 2, 0, 0, 0]], np.int64)
+    lens = np.array([5, 2], np.int32)
+    res = run_single_op("sequence_erase",
+                        {"X": {"x": x}, "SeqLens": {"l": lens}},
+                        attrs={"tokens": [2, 5]},
+                        out_slots=("Out", "NewLens"))
+    np.testing.assert_array_equal(res["__out_NewLens_0"], [2, 1])
+    np.testing.assert_array_equal(res["__out_Out_0"][0, :2], [1, 3])
+    np.testing.assert_array_equal(res["__out_Out_0"][1, :1], [1])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4, 0]], np.int64)
+    lens = np.array([4], np.int32)
+    out = run_single_op("sequence_enumerate",
+                        {"X": {"x": x}, "SeqLens": {"l": lens}},
+                        attrs={"win_size": 2, "pad_value": 0}
+                        )["__out_Out_0"]
+    np.testing.assert_array_equal(
+        out[0, :4], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+
+def test_sequence_pad_unpad():
+    x = _x()
+    res = run_single_op("sequence_pad", _seq_ins(x),
+                        attrs={"pad_value": -1.0},
+                        out_slots=("Out", "Length"))
+    out = res["__out_Out_0"]
+    np.testing.assert_array_equal(res["__out_Length_0"], LENS)
+    for b in range(3):
+        np.testing.assert_allclose(out[b, LENS[b]:], -1.0)
+        np.testing.assert_allclose(out[b, :LENS[b]], x[b, :LENS[b]])
+    res2 = run_single_op("sequence_unpad",
+                         {"X": {"x": out}, "Length": {"l": LENS}},
+                         out_slots=("Out",))
+    for b in range(3):
+        np.testing.assert_allclose(res2["__out_Out_0"][b, LENS[b]:], 0.0)
+
+
+def test_sequence_reshape():
+    x = _x(B=2, T=4, D=6)
+    lens = np.array([4, 2], np.int32)
+    res = run_single_op("sequence_reshape",
+                        {"X": {"x": x}, "SeqLens": {"l": lens}},
+                        attrs={"new_dim": 3}, out_slots=("Out", "NewLens"))
+    assert res["__out_Out_0"].shape == (2, 8, 3)
+    np.testing.assert_array_equal(res["__out_NewLens_0"], [8, 4])
+
+
+def test_sequence_mask():
+    lens = np.array([3, 1, 0], np.int64)
+    out = run_single_op("sequence_mask", {"X": {"x": lens}},
+                        attrs={"maxlen": 4, "out_dtype": "float32"},
+                        out_slots=("Y",))["__out_Y_0"]
+    np.testing.assert_array_equal(
+        out, [[1, 1, 1, 0], [1, 0, 0, 0], [0, 0, 0, 0]])
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0], [1, 5, 0, 0]], np.int64)
+    ref = np.array([[1, 2, 4], [1, 5, 6]], np.int64)
+    hl = np.array([3, 2], np.int32)
+    rl = np.array([3, 3], np.int32)
+    res = run_single_op(
+        "edit_distance",
+        {"Hyps": {"h": hyp}, "Refs": {"r": ref},
+         "HypsLens": {"hl": hl}, "RefsLens": {"rl": rl}},
+        attrs={"normalized": False}, out_slots=("Out", "SequenceNum"))
+    np.testing.assert_allclose(res["__out_Out_0"].reshape(-1), [1.0, 1.0])
+    np.testing.assert_array_equal(res["__out_SequenceNum_0"], [2])
+
+
+def test_stacked_dynamic_lstm_model():
+    """Benchmark-model smoke test (reference:
+    benchmark/fluid/models/stacked_dynamic_lstm.py)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import stacked_dynamic_lstm
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss, fetches, feed_specs = stacked_dynamic_lstm.build(
+            is_train=True, dict_dim=50, max_len=8, emb_dim=16, hid_dim=16,
+            stacked_num=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    B = 4
+    feed = {"words": rng.randint(0, 50, size=(B, 8)).astype(np.int64),
+            "seq_lens": rng.randint(1, 9, size=(B,)).astype(np.int32),
+            "label": rng.randint(0, 2, size=(B, 1)).astype(np.int64)}
+    losses = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[loss.name])[0]))
+              for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
