@@ -97,7 +97,12 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
 
     per_step = batch_size
     if unit in ("tokens/sec", "words/sec"):
-        per_step = batch_size * kw.get("max_len", 64)
+        if "seq_lens" in feeds:
+            # count actual words, not padded positions (the reference's
+            # LoD word count, fluid_benchmark.py train_parallel)
+            per_step = int(np.asarray(feeds["seq_lens"]).sum())
+        else:
+            per_step = batch_size * kw.get("max_len", 64)
     value = per_step * steps / dt
 
     assert np.isfinite(lv), "loss went non-finite"

@@ -207,22 +207,87 @@ class CompiledBlock:
         if donate:
             jit_kwargs["donate_argnums"] = (0,)
         if dist is not None and dist.mesh is not None:
-            jit_kwargs["in_shardings"] = self._input_shardings()
+            shardings = self._input_shardings()
+            jit_kwargs["in_shardings"] = shardings
+            # pin state *outputs* to the same layout as the state inputs —
+            # otherwise XLA propagates e.g. a ZeRO-sharded moment's layout
+            # into the updated param, and the next step's in_shardings
+            # reject the scope array
+            state_sh = shardings[0]
+            out_sh = dict(state_sh)
+            for n in self.sig.created_persistable:
+                out_sh[n] = self._param_sharding_fn(n)
+            base_fn = fn
+
+            def fn(state, consts, feeds, step_seed):
+                fetches, new_state = base_fn(state, consts, feeds, step_seed)
+                new_state = {
+                    n: (jax.lax.with_sharding_constraint(v, out_sh[n])
+                        if n in out_sh else v)
+                    for n, v in new_state.items()}
+                return fetches, new_state
         # donate the mutated-state dict: optimizer updates reuse the same HBM
         # buffers (reference keeps params in-place in the Scope; we get the
         # same via XLA input_output_aliasing)
         self.fn = jax.jit(fn, **jit_kwargs)
 
     def _input_shardings(self):
-        import re
         from jax.sharding import NamedSharding, PartitionSpec as P
         mesh = self.dist.mesh
         repl = NamedSharding(mesh, P())
+        block = self.block
+
+        # params (and embedding tables) declared sharded, by regex or by the
+        # dist hint the embedding(is_distributed=True) layer recorded
+        param_specs = {}
+        all_params = set()
+        for n in tuple(self.sig.state_names) + tuple(self.sig.const_names):
+            axes = self.dist._axes_for(n, block)
+            if axes is not None:
+                param_specs[n] = axes
+            if block.has_var(n) and block.var(n).is_parameter:
+                all_params.add(n)
+
+        def acc_base_param(name):
+            """Optimizer accumulators are named '<param>_<kind>_N'
+            (optimizer.py _add_accumulator) — find the owning param so
+            moments shard exactly like their parameter."""
+            best = None
+            for p in all_params:
+                if name != p and name.startswith(p + "_"):
+                    if best is None or len(p) > len(best):
+                        best = p
+            return best
+
+        zero_style = (self.dist.reduce_strategy == "reduce_scatter"
+                      and self.dist.data_axis in mesh.axis_names)
 
         def param_sharding(name):
-            for pattern, axes in (self.dist.param_axes or {}).items():
-                if re.fullmatch(pattern, name):
-                    return NamedSharding(mesh, P(*axes))
+            axes = param_specs.get(name)
+            if axes is None:
+                base = acc_base_param(name)
+                if base is not None and base in param_specs:
+                    v = block.var(name) if block.has_var(name) else None
+                    pv = block.var(base) if block.has_var(base) else None
+                    if (v is not None and pv is not None
+                            and v.shape == pv.shape):
+                        axes = param_specs[base]
+            if axes is not None:
+                return NamedSharding(mesh, P(*axes))
+            if zero_style and block.has_var(name):
+                # kReduce/ZeRO parity: shard optimizer state over the data
+                # axis (each dp shard owns a slice of the moments, like each
+                # pserver owned a param block — distribute_transpiler.py:368
+                # slice_var_up)
+                v = block.var(name)
+                is_acc = acc_base_param(name) is not None or \
+                    (v.attrs or {}).get("optimizer_state", False)
+                if (is_acc and v.shape and len(v.shape) >= 1 and v.shape[0]
+                        and v.shape[0] > 0
+                        and v.shape[0] % mesh.shape[self.dist.data_axis] == 0):
+                    return NamedSharding(
+                        mesh, P(self.dist.data_axis,
+                                *([None] * (len(v.shape) - 1))))
             return repl
 
         def feed_sharding(name):
@@ -248,6 +313,7 @@ class CompiledBlock:
         state_sh = {n: param_sharding(n) for n in self.sig.state_names}
         const_sh = {n: param_sharding(n) for n in self.sig.const_names}
         feed_sh = {n: feed_sharding(n) for n in self.sig.feed_names}
+        self._param_sharding_fn = param_sharding
         return (state_sh, const_sh, feed_sh, repl)
 
     def feed_dtype(self, name: str) -> Optional[str]:

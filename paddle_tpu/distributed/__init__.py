@@ -1,0 +1,105 @@
+"""Multi-host distributed runtime (reference: the bootstrap+collective layer
+— gen_nccl_id_op.cc:31 ncclUniqueId broadcast over a mini RPC server,
+NCCLContextMap nccl_helper.h:86 with num_trainers/trainer_id, and the fleet
+role plumbing of distribute_transpiler "nccl2" mode).
+
+TPU-native: the JAX coordination service replaces the gen_nccl_id RPC dance —
+one `init_parallel_env` call per host wires every process into a single
+global device mesh, and DCN/ICI collectives come from XLA. Environment
+variables mirror the reference's cluster conventions
+(PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_CURRENT_ENDPOINT →
+coordinator address + process id).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> None:
+    """Bootstrap multi-host execution (reference: gen_nccl_id_op.cc — rank0
+    listens and broadcasts the communicator id; here
+    jax.distributed.initialize contacts the coordinator and registers this
+    host's chips into the global device set).
+
+    Single-host (no coordinator configured) is a no-op: jax.devices()
+    already holds every local chip.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_COORDINATOR") or os.environ.get("COORDINATOR_ADDRESS")
+    eps = [e for e in
+           os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+    if coordinator_address is None and eps:
+        coordinator_address = eps[0]
+    if coordinator_address is None:
+        _initialized = True        # single-host
+        return
+    if num_processes is None:
+        env_n = os.environ.get("PADDLE_TRAINERS_NUM")
+        num_processes = int(env_n) if env_n else (len(eps) or 1)
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+class fleet:
+    """Minimal fleet-style role facade (reference: the
+    paddle.fluid.incubate.fleet direction the transpiler-era role plumbing
+    evolved into; roles map 1:1 onto JAX process indices — there is no
+    separate pserver role on TPU, every process is a worker that owns a
+    shard of params via the mesh)."""
+
+    @staticmethod
+    def init(role=None):
+        init_parallel_env()
+
+    @staticmethod
+    def is_worker() -> bool:
+        return True
+
+    @staticmethod
+    def is_server() -> bool:
+        return False               # pserver role dissolved into sharding
+
+    @staticmethod
+    def worker_num() -> int:
+        return jax.process_count()
+
+    @staticmethod
+    def worker_index() -> int:
+        return jax.process_index()
+
+    @staticmethod
+    def barrier_worker():
+        """Cross-host barrier (reference: send_barrier_op/fetch_barrier_op)
+        — a tiny psum over all devices forces synchronization."""
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = jax.devices()
+        mesh = Mesh(devs, ("all",))
+        x = jax.jit(
+            lambda: jax.lax.with_sharding_constraint(
+                jnp.zeros((len(devs),)), NamedSharding(mesh, P("all"))).sum()
+        )()
+        jax.block_until_ready(x)

@@ -17,18 +17,10 @@ from paddle_tpu.fluid.param_attr import ParamAttr
 from paddle_tpu.fluid.compiler import (BuildStrategy, CompiledProgram,
                                        ExecutionStrategy)
 from paddle_tpu.fluid.parallel_executor import ParallelExecutor
-
-
-def memory_optimize(input_program, skip_opt_set=None, print_log=False,
-                    level=0):
-    """reference: transpiler/memory_optimization_transpiler.py — liveness-
-    based var reuse. No-op on TPU: XLA's buffer assignment already performs
-    liveness analysis and in-place reuse on the whole fused program."""
-    return input_program
-
-
-def release_memory(input_program, skip_opt_set=None):
-    return input_program
+from paddle_tpu.fluid import transpiler
+from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                         DistributeTranspilerConfig,
+                                         memory_optimize, release_memory)
 
 __all__ = [
     "CPUPlace", "CUDAPlace", "Executor", "TPUPlace",
@@ -40,4 +32,5 @@ __all__ = [
     "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
     "io", "learning_rate_scheduler", "metrics", "profiler", "DataFeeder",
     "ParallelExecutor", "memory_optimize", "release_memory",
+    "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
 ]

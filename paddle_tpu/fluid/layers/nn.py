@@ -53,6 +53,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     the flags are accepted and recorded as sharding hints."""
     helper = LayerHelper("embedding")
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    if is_distributed or is_sparse:
+        # record the sharding hint: table rows split over the mesh model
+        # axis (resolved by DistributeConfig._axes_for; the TPU form of the
+        # pserver-sharded table, distribute_transpiler.py:1051
+        # _init_splited_vars + parameter_prefetch.h:26)
+        w.desc.attrs["dist_hint"] = ["__model__"] + \
+            [None] * (len(size) - 1)
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(
         "lookup_table", inputs={"W": [w], "Ids": [input]},

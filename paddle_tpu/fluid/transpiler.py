@@ -1,0 +1,259 @@
+"""Distribution transpilers (reference: python/paddle/fluid/transpiler/ —
+DistributeTranspiler distribute_transpiler.py:157, config :126,
+ps_dispatcher.py RoundRobin/HashName, memory_optimization_transpiler.py).
+
+The reference rewrites one program into trainer and pserver halves that talk
+over gRPC (transpile :276, get_trainer_program :535, get_pserver_program
+:654). On TPU the dense-parameter pserver disappears into mesh sharding +
+ICI collectives, but the *program-splitting capability* survives and the
+split is still runnable: the trainer half computes gradients (the reference's
+send targets), the pserver half holds params + optimizer state and applies
+updates from fed gradients (the reference's recv/optimize blocks). "nccl2"
+(collective) mode maps to a DistributeConfig over a mesh — XLA emits the ICI
+all-reduces that gen_nccl_id+NCCL provided (gen_nccl_id_op.cc:31).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from paddle_tpu.core import ir
+from paddle_tpu.fluid import framework
+
+# op types that update parameters/optimizer state in place
+# (reference: operators/optimizers/*; these live in the pserver's
+# listen_and_serv optimize sub-blocks, listen_and_serv_op.cc:107)
+OPTIMIZE_OP_TYPES = {
+    "sgd", "momentum", "lars_momentum", "adam", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "proximal_gd",
+    "proximal_adagrad", "ema_accumulate",
+}
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class PSDispatcher:
+    """reference: transpiler/ps_dispatcher.py PSDispatcher."""
+
+    def __init__(self, pserver_endpoints: List[str]):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist: List[str]) -> List[str]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class RoundRobin(PSDispatcher):
+    """reference: ps_dispatcher.py RoundRobin."""
+
+    def __init__(self, pserver_endpoints):
+        super().__init__(pserver_endpoints)
+        self._step = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+    def reset(self):
+        self._step = 0
+
+
+class HashName(PSDispatcher):
+    """reference: ps_dispatcher.py HashName — stable name-hash placement."""
+
+    def dispatch(self, varlist):
+        import zlib
+        return [self._eps[zlib.crc32(v.encode()) % len(self._eps)]
+                for v in varlist]
+
+
+@dataclass
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:126."""
+
+    slice_var_up: bool = True
+    split_method: type = RoundRobin
+    min_block_size: int = 8192
+    mode: str = "pserver"          # "pserver" | "nccl2" | "collective"
+
+
+class DistributeTranspiler:
+    """reference: distribute_transpiler.py:157.
+
+    transpile() analyzes the program: the ops are partitioned into a
+    forward/backward (trainer) section and an optimize section (the ops the
+    reference moved into pserver optimize blocks), and params are placed
+    onto pserver endpoints by the split_method dispatcher."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._done = False
+
+    # -- analysis ---------------------------------------------------------
+
+    def transpile(self, trainer_id: int, program=None, pservers: str = "",
+                  trainers: int = 1, sync_mode: bool = True,
+                  startup_program=None, current_endpoint: str = ""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or framework.default_main_program()
+        self.startup_program = (startup_program
+                                or framework.default_startup_program())
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        block = self.origin_program.desc.global_block
+
+        # seed: parameter-update ops; closure: pure grad-transform chains
+        # (clip/regularization) whose outputs feed only the optimize side
+        ops = list(block.ops)
+        opt_idx = {i for i, op in enumerate(ops)
+                   if op.type in OPTIMIZE_OP_TYPES}
+        consumers: Dict[str, set] = {}
+        for i, op in enumerate(ops):
+            for n in op.input_names():
+                consumers.setdefault(n, set()).add(i)
+        changed = True
+        while changed:
+            changed = False
+            for i, op in enumerate(ops):
+                # __vjp__ is the backward computation — it stays on the
+                # trainer (the reference's append_backward ops run trainer-
+                # side; only grad *post-processing* moves to the pserver)
+                if i in opt_idx or op.type in ("feed", "fetch", "__vjp__"):
+                    continue
+                outs = op.output_names()
+                if not outs:
+                    continue
+                users = set()
+                for n in outs:
+                    users |= consumers.get(n, set())
+                users -= {i}
+                if users and users <= opt_idx:
+                    opt_idx.add(i)
+                    changed = True
+        self._opt_idx = sorted(opt_idx)
+        self._trainer_idx = [i for i in range(len(ops)) if i not in opt_idx]
+
+        # grads crossing the boundary = the reference's send targets
+        trainer_outs = set()
+        for i in self._trainer_idx:
+            trainer_outs.update(ops[i].output_names())
+        self.send_vars: List[str] = sorted(
+            n for i in self._opt_idx for n in ops[i].input_names()
+            if n in trainer_outs and GRAD_SUFFIX in n)
+
+        # param placement (reference: _init_splited_vars :1051 + dispatcher)
+        self.params: List[str] = sorted(
+            n for i in self._opt_idx
+            for slot, names in ops[i].inputs.items() if slot == "Param"
+            for n in names)
+        dispatcher = self.config.split_method(self.pserver_endpoints or
+                                              ["127.0.0.1:0"])
+        placed = dispatcher.dispatch(self.params)
+        self.param_placement: Dict[str, str] = dict(zip(self.params, placed))
+        self._done = True
+
+    # -- program construction ---------------------------------------------
+
+    def get_trainer_program(self):
+        """Forward + backward only; grads (the send targets) are left as
+        fetchable outputs (reference: :535 — grads→send_op)."""
+        assert self._done
+        p = self.origin_program.clone()
+        blk = p.desc.global_block
+        keep = [blk.ops[i] for i in self._trainer_idx]
+        blk.ops.clear()
+        blk.ops.extend(keep)
+        p.desc.bump_version()
+        return p
+
+    def get_pserver_program(self, endpoint: str):
+        """Params + optimizer state + optimize ops for the params placed on
+        `endpoint`; gradients arrive as feeds (reference: :654 — optimize
+        sub-blocks of listen_and_serv)."""
+        assert self._done
+        src = self.origin_program.desc.global_block
+        my_params = {p for p, ep in self.param_placement.items()
+                     if ep == endpoint or not self.pserver_endpoints}
+        prog = framework.Program()
+        blk = prog.desc.global_block
+        ops = [src.ops[i] for i in self._opt_idx]
+        my_ops = [op for op in ops
+                  if not op.inputs.get("Param")
+                  or set(op.inputs["Param"]) & my_params]
+        needed = set()
+        for op in my_ops:
+            needed.update(op.input_names())
+            needed.update(op.output_names())
+        for n in sorted(needed):
+            if src.has_var(n):
+                blk.add_var(ir.VarDesc.from_dict(src.var(n).to_dict()))
+        for op in my_ops:
+            blk.append_op(ir.OpDesc.from_dict(op.to_dict()))
+        prog.desc.bump_version()
+        return prog
+
+    def get_startup_program(self, endpoint: str, pserver_program=None):
+        """Startup pruned to the persistables this endpoint owns
+        (reference: :909)."""
+        assert self._done
+        my_params = {p for p, ep in self.param_placement.items()
+                     if ep == endpoint or not self.pserver_endpoints}
+        if pserver_program is not None:
+            my_persist = {
+                n for n, v in
+                pserver_program.desc.global_block.vars.items()
+                if v.persistable}
+        else:
+            my_persist = my_params
+        src = self.startup_program.desc.global_block
+        prog = framework.Program()
+        blk = prog.desc.global_block
+        for n, v in src.vars.items():
+            if n in my_persist or any(n.startswith(p + "_")
+                                      for p in my_params):
+                blk.add_var(ir.VarDesc.from_dict(v.to_dict()))
+        for op in src.ops:
+            outs = set(op.output_names())
+            if outs and all(blk.has_var(n) for n in outs):
+                blk.append_op(ir.OpDesc.from_dict(op.to_dict()))
+        prog.desc.bump_version()
+        return prog
+
+    # -- collective (nccl2) mode ------------------------------------------
+
+    def to_dist_config(self, mesh=None, model_axis="tp"):
+        """The "nccl2"/collective mode product: a DistributeConfig for
+        CompiledProgram.with_sharding. trainers ⇒ the data axis extent;
+        mode "pserver" additionally shards optimizer state over dp (the
+        sharded-optimizer capability of the pserver, ZeRO-style)."""
+        from paddle_tpu.parallel import DistributeConfig, make_mesh
+        if mesh is None:
+            mesh = make_mesh()
+        return DistributeConfig(
+            mesh=mesh, data_axis="dp", model_axis=model_axis,
+            reduce_strategy=("reduce_scatter"
+                             if self.config.mode == "pserver"
+                             else "all_reduce"))
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """reference: memory_optimization_transpiler.py — liveness-based var
+    reuse. Under XLA, buffer liveness analysis and reuse happen inside the
+    compiler (and optimizer updates already alias via buffer donation,
+    lowering.py CompiledBlock), so this is a compatibility no-op that
+    returns the program unchanged."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """reference: memory_optimization_transpiler.py release_memory — no-op
+    under XLA (see memory_optimize)."""
+    return input_program

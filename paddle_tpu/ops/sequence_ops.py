@@ -264,7 +264,9 @@ def _sequence_enumerate(ctx, ins, attrs):
     pad_value = attrs.get("pad_value", 0)
     B, T = x.shape
     lens = _lens_or_full(seq_lens, B, T)
-    t = jnp.arange(T)[None, :, None] + jnp.arange(win)[None, None, :]
+    t = jnp.broadcast_to(
+        jnp.arange(T)[None, :, None] + jnp.arange(win)[None, None, :],
+        (B, T, win))
     in_seq = t < lens[:, None, None]
     g = jnp.take_along_axis(
         x, t.reshape(B, -1).clip(0, T - 1), axis=1).reshape(B, T, win)

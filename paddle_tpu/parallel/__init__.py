@@ -11,6 +11,7 @@ that the reference hand-scheduled over NCCL.
 
 from paddle_tpu.parallel.mesh import (DistributeConfig, get_default_mesh,
                                       make_mesh, set_default_mesh)
+from paddle_tpu.parallel import collective  # noqa: F401
 
-__all__ = ["DistributeConfig", "get_default_mesh", "make_mesh",
+__all__ = ["DistributeConfig", "collective", "get_default_mesh", "make_mesh",
            "set_default_mesh"]

@@ -1,0 +1,70 @@
+"""Named-axis collectives for use inside shard_map-partitioned code
+(reference: the collective substrate the reference spreads over
+platform/nccl_helper.h NCCLContextMap group calls,
+details/all_reduce_op_handle.cc:103 ncclAllReduce,
+details/reduce_op_handle.cc, details/broadcast_op_handle.cc and
+operators/distributed/collective_client.h partial-allgather).
+
+On TPU every one of these is a single XLA ICI collective over a named mesh
+axis; these wrappers exist so framework code (ring attention, all-to-all
+expert/sequence exchange, fleet barriers) reads like the scaling-book
+recipes rather than raw lax calls.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    """reference: all_reduce_op_handle.cc:55 (ncclAllReduce ring)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """reference: collective_client.h partial allgather; NCCL allGather."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    """reference: the kReduce strategy (ReduceOpHandle) — each rank keeps
+    one shard of the reduced value."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                            tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    """The id/row exchange of the distributed lookup table
+    (reference: split_ids_op + prefetch + merge_ids_op,
+    parameter_prefetch.h:26) and the Ulysses-style sequence↔head exchange."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    """Neighbor exchange (ring attention's building block)."""
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Shift shards around the ring: rank i -> rank (i+shift) % n."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    """reference: broadcast_op_handle.cc / BCastParamsToDevices
+    (parallel_executor.cc:348)."""
+    idx = lax.axis_index(axis_name)
+    masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return lax.psum(masked, axis_name)

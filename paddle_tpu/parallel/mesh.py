@@ -55,9 +55,34 @@ class DistributeConfig:
 
     mesh: Optional[Mesh] = None
     data_axis: Optional[str] = "dp"         # batch dim of feeds shards here
-    # param sharding rules: {param name regex: PartitionSpec-like tuple}
+    # axis that model-sharded tables/weights split over (the pserver-shard
+    # axis: embedding(is_distributed=True) rows land here — the TPU form of
+    # the reference's param→pserver placement, transpiler/ps_dispatcher.py)
+    model_axis: Optional[str] = "tp"
+    # param sharding rules: {param name regex: PartitionSpec-like tuple};
+    # overrides per-var dist hints recorded by layers
     param_axes: Dict[str, tuple] = field(default_factory=dict)
     # reduce strategy parity (BuildStrategy::ReduceStrategy, kAllReduce vs
-    # kReduce build_strategy.h:55): on TPU both are XLA collective choices;
-    # "reduce_scatter" shards optimizer state ZeRO-style (future rounds)
+    # kReduce build_strategy.h:55): "all_reduce" replicates optimizer state;
+    # "reduce_scatter" shards optimizer accumulators over the data axis
+    # (ZeRO-style — the TPU delivery of the pserver's sharded-optimizer
+    # capability, listen_and_serv_op.cc optimizer blocks)
     reduce_strategy: str = "all_reduce"
+
+    def _axes_for(self, name: str, block=None):
+        """Resolve the PartitionSpec-like axes tuple for a scope var, or
+        None for replicated. Priority: explicit param_axes regex > the
+        var's recorded dist hint ("__model__" resolves to model_axis)."""
+        import re
+        for pattern, axes in (self.param_axes or {}).items():
+            if re.fullmatch(pattern, name):
+                return axes
+        if block is not None and block.has_var(name):
+            hint = (block.var(name).attrs or {}).get("dist_hint")
+            if hint:
+                axes = tuple(self.model_axis if a == "__model__" else a
+                             for a in hint)
+                if all(a is None or a in self.mesh.axis_names
+                       for a in axes):
+                    return axes
+        return None

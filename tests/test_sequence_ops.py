@@ -155,6 +155,15 @@ def test_sequence_enumerate():
                         )["__out_Out_0"]
     np.testing.assert_array_equal(
         out[0, :4], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    # batched (B > 1) windows
+    xb = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    lb = np.array([3, 2], np.int32)
+    outb = run_single_op("sequence_enumerate",
+                         {"X": {"x": xb}, "SeqLens": {"l": lb}},
+                         attrs={"win_size": 2, "pad_value": 9}
+                         )["__out_Out_0"]
+    np.testing.assert_array_equal(outb[0], [[1, 2], [2, 3], [3, 9]])
+    np.testing.assert_array_equal(outb[1, :2], [[4, 5], [5, 9]])
 
 
 def test_sequence_pad_unpad():
