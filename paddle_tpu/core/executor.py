@@ -76,7 +76,7 @@ class Executor:
         # DistributeConfig between runs must get a fresh compile
         if dist is None:
             return None
-        return (dist.mesh, dist.data_axis,
+        return (dist.mesh, dist.data_axis, dist.model_axis, dist.sp_axis,
                 tuple(sorted((k, tuple(v))
                              for k, v in (dist.param_axes or {}).items())),
                 dist.reduce_strategy)

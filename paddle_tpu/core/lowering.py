@@ -101,7 +101,7 @@ def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
 
 def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
                 indices, env: Dict[str, Any], base_key, step_base,
-                is_test: bool) -> None:
+                is_test: bool, dist=None) -> None:
     """Emit the ops at `indices` of `block` into `env` (mutated in place).
     This is the single trace-time interpreter loop; control-flow emitters
     call back into it for their sub-blocks (replacing the reference's
@@ -113,7 +113,7 @@ def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
         # parent-block ops at the same index
         ctx = EmitContext(base_key=base_key, step_base_key=step_base,
                           op_index=block.idx * 100_000 + i, is_test=is_test,
-                          program=program)
+                          program=program, dist=dist)
         ins = {}
         for slot, names in op.inputs.items():
             try:
@@ -145,11 +145,11 @@ def emit_subblock(ctx: EmitContext, block_idx: int, env: Dict[str, Any],
             step_base = jax.random.fold_in(step_base, key_salt)
     sub = ctx.program.block(block_idx)
     emit_op_seq(ctx.program, sub, range(len(sub.ops)), env,
-                base, step_base, ctx.is_test)
+                base, step_base, ctx.is_test, dist=ctx.dist)
 
 
 def build_block_fn(program: ir.ProgramDesc, block_idx: int,
-                   sig: BlockSignature, is_test: bool = False):
+                   sig: BlockSignature, is_test: bool = False, dist=None):
     """Returns fn(state: dict, consts: dict, feeds: dict, step_seed) ->
     (fetches: list, new_state: dict). Pure — safe to jit/pjit/shard_map."""
 
@@ -172,7 +172,7 @@ def build_block_fn(program: ir.ProgramDesc, block_idx: int,
             base_key = jax.random.fold_in(jax.random.key(0), step_seed)
         step_base = base_key
         emit_op_seq(program, block, sig.live_ops, env, base_key, step_base,
-                    is_test)
+                    is_test, dist=dist)
         fetches = [env[n] for n in sig.fetch_names]
         new_state = {n: env[n] for n in sig.state_names if n in env}
         for n in sig.created_persistable:
@@ -202,7 +202,8 @@ class CompiledBlock:
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block
         self.dist = dist
-        fn = build_block_fn(program, block_idx, self.sig, is_test=is_test)
+        fn = build_block_fn(program, block_idx, self.sig, is_test=is_test,
+                            dist=dist)
         jit_kwargs = {}
         if donate:
             jit_kwargs["donate_argnums"] = (0,)

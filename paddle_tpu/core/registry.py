@@ -48,9 +48,20 @@ class EmitContext:
     step_base_key: Any = None  # fold_in(base_key, step_seed)
     op_index: int = 0
     is_test: bool = False
-    # set during multi-device lowering: the mesh and the data-parallel axis
-    mesh: Any = None
-    data_axis: Optional[str] = None
+    # set during multi-device lowering: the DistributeConfig (mesh + dp/tp/
+    # sp axes) for ops that partition themselves, e.g. ring attention over
+    # the sp axis. mesh/data_axis are views into it — single source of
+    # truth, so every context constructor (lowering, grad re-trace, shape
+    # inference) only has to thread one field.
+    dist: Any = None
+
+    @property
+    def mesh(self):
+        return getattr(self.dist, "mesh", None)
+
+    @property
+    def data_axis(self) -> Optional[str]:
+        return getattr(self.dist, "data_axis", None)
     # the enclosing ProgramDesc — control-flow emitters (while/cond/scan)
     # recursively lower their sub-blocks through this handle
     # (reference: sub-blocks interpreted with child scopes, while_op.cc:64)

@@ -9,7 +9,7 @@ from paddle_tpu.fluid.layers.nn import (  # noqa: F401
     dropout, embedding, expand, fc, gather, huber_loss, l2_normalize,
     label_smooth, layer_norm, log, matmul, mean, mul, one_hot, pool2d,
     reduce_max, reduce_mean, reduce_min, reduce_prod, reduce_sum, reshape,
-    scale, sigmoid_cross_entropy_with_logits, slice, softmax,
+    scale, scaled_dot_product_attention, sigmoid_cross_entropy_with_logits, slice, softmax,
     softmax_with_cross_entropy, split, square_error_cost, squeeze, stack,
     topk, transpose, unsqueeze)
 from paddle_tpu.fluid.layers.rnn import (  # noqa: F401

@@ -538,3 +538,21 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
                               "StatNegOut": [stat_neg]},
                      attrs={"curve": curve, "num_thresholds": num_thresholds})
     return auc_out, [stat_pos, stat_neg]
+
+
+def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
+                                 scale=None, sp="auto", sp_impl="ring",
+                                 name=None):
+    """Fused attention over [B, H, T, D] tensors (TPU-native extension —
+    the reference composes matmul+softmax+matmul; see ops.attention). With
+    a mesh sp axis configured, computes ring attention / Ulysses over the
+    sequence shards (parallel/ring_attention.py)."""
+    helper = LayerHelper("attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op("attention", inputs=ins, outputs={"Out": [out]},
+                     attrs={"causal": causal, "scale": scale, "sp": sp,
+                            "sp_impl": sp_impl})
+    return out

@@ -43,7 +43,7 @@ def position_encoding(max_len, d_model):
 
 
 def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
-                         name=""):
+                         fused=False, causal=False, name=""):
     d_k = d_model // n_head
     q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False)
     k = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
@@ -55,15 +55,28 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
         return layers.transpose(r, perm=[0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    q = layers.scale(q, scale=d_k ** -0.5)
-    logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]
-    if mask is not None:
-        logits = layers.elementwise_add(logits, mask)
-    weights = layers.softmax(logits)
-    if dropout:
-        weights = layers.dropout(weights, dropout_prob=dropout,
-                                 dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(weights, v)                  # [B, H, Lq, dk]
+    if fused:
+        # fused (and, with an sp mesh axis, ring/Ulysses sequence-parallel)
+        # attention. NOTE semantics change: attention-WEIGHT dropout does
+        # not exist in this path (the [Tq, Tk] probability matrix is never
+        # materialized); regularization differs from the unfused graph.
+        if dropout:
+            import warnings
+            warnings.warn(
+                "fused attention drops attention-weight dropout "
+                f"(dropout={dropout}); residual/ffn dropout still applies",
+                stacklevel=2)
+        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal)
+    else:
+        q = layers.scale(q, scale=d_k ** -0.5)
+        logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]
+        if mask is not None:
+            logits = layers.elementwise_add(logits, mask)
+        weights = layers.softmax(logits)
+        if dropout:
+            weights = layers.dropout(weights, dropout_prob=dropout,
+                                     dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)                  # [B, H, Lq, dk]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
@@ -84,22 +97,25 @@ def _residual(x, sub, dropout):
     return layers.elementwise_add(x, sub)
 
 
-def encoder_layer(x, d_model, d_inner, n_head, dropout):
+def encoder_layer(x, d_model, d_inner, n_head, dropout, fused=False):
     attn_in = layers.layer_norm(x, begin_norm_axis=2)
-    attn = multi_head_attention(attn_in, attn_in, d_model, n_head, dropout)
+    attn = multi_head_attention(attn_in, attn_in, d_model, n_head, dropout,
+                                fused=fused)
     x = _residual(x, attn, dropout)
     ffn_in = layers.layer_norm(x, begin_norm_axis=2)
     return _residual(x, ffn(ffn_in, d_model, d_inner, dropout), dropout)
 
 
 def decoder_layer(x, enc_out, causal_mask, d_model, d_inner, n_head,
-                  dropout):
+                  dropout, fused=False):
     self_in = layers.layer_norm(x, begin_norm_axis=2)
-    self_attn = multi_head_attention(self_in, self_in, d_model, n_head,
-                                     dropout, mask=causal_mask)
+    self_attn = multi_head_attention(
+        self_in, self_in, d_model, n_head, dropout,
+        mask=None if fused else causal_mask, fused=fused, causal=fused)
     x = _residual(x, self_attn, dropout)
     cross_in = layers.layer_norm(x, begin_norm_axis=2)
-    cross = multi_head_attention(cross_in, enc_out, d_model, n_head, dropout)
+    cross = multi_head_attention(cross_in, enc_out, d_model, n_head, dropout,
+                                 fused=fused)
     x = _residual(x, cross, dropout)
     ffn_in = layers.layer_norm(x, begin_norm_axis=2)
     return _residual(x, ffn(ffn_in, d_model, d_inner, dropout), dropout)
@@ -107,7 +123,7 @@ def decoder_layer(x, enc_out, causal_mask, d_model, d_inner, n_head,
 
 def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
                 d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                dropout=0.1, name="transformer"):
+                dropout=0.1, fused_attention=False, name="transformer"):
     pe = _const_var(name + "_pos_enc",
                     position_encoding(max_len, d_model))
     # causal mask [1, 1, L, L]: -1e9 above the diagonal
@@ -129,7 +145,8 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
         enc = layers.dropout(enc, dropout_prob=dropout,
                              dropout_implementation="upscale_in_train")
     for _ in range(n_layer):
-        enc = encoder_layer(enc, d_model, d_inner, n_head, dropout)
+        enc = encoder_layer(enc, d_model, d_inner, n_head, dropout,
+                            fused=fused_attention)
     enc = layers.layer_norm(enc, begin_norm_axis=2)
 
     dec = embed(tgt_ids, tgt_vocab, "tgt")
@@ -138,7 +155,7 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
                              dropout_implementation="upscale_in_train")
     for _ in range(n_layer):
         dec = decoder_layer(dec, enc, causal_mask, d_model, d_inner, n_head,
-                            dropout)
+                            dropout, fused=fused_attention)
     dec = layers.layer_norm(dec, begin_norm_axis=2)
     return layers.fc(dec, size=tgt_vocab, num_flatten_dims=2,
                      bias_attr=False)
@@ -148,14 +165,15 @@ def build(is_train: bool = True, src_vocab: int = 32000,
           tgt_vocab: int = 32000, max_len: int = 128, d_model: int = 512,
           d_inner: int = 2048, n_head: int = 8, n_layer: int = 6,
           dropout: float = 0.1, lr: float = 1e-4, warmup: int = 4000,
-          label_smooth_eps: float = 0.1):
+          label_smooth_eps: float = 0.1, fused_attention: bool = False):
     """Transformer-base training graph (Vaswani config: 512/2048/8/6)."""
     src = layers.data(name="src_ids", shape=[max_len, 1], dtype="int64")
     tgt = layers.data(name="tgt_ids", shape=[max_len, 1], dtype="int64")
     lbl = layers.data(name="lbl_ids", shape=[max_len, 1], dtype="int64")
     logits = transformer(src, tgt, src_vocab, tgt_vocab, max_len, d_model,
                          d_inner, n_head, n_layer,
-                         dropout if is_train else 0.0)
+                         dropout if is_train else 0.0,
+                         fused_attention=fused_attention)
     flat_logits = layers.reshape(logits, shape=[-1, tgt_vocab])
     flat_label = layers.reshape(lbl, shape=[-1, 1])
     if label_smooth_eps and is_train:

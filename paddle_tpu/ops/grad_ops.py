@@ -52,11 +52,13 @@ def _vjp_emit(ctx: EmitContext, ins, attrs):
     flat_in = ins.get("FwdIn", [])
     diff_mask = attrs["in_grad_mask"]      # per flat fwd input
     og_mask = attrs["out_grad_mask"]       # per flat fwd output: grad provided?
+    # propagate dist: the backward re-trace must partition exactly like the
+    # forward (e.g. ring attention stays sequence-parallel in its vjp)
     fwd_ctx = EmitContext(base_key=ctx.base_key,
                           step_base_key=ctx.step_base_key,
                           op_index=attrs["fwd_op_index"],
                           is_test=ctx.is_test,
-                          program=ctx.program)
+                          program=ctx.program, dist=ctx.dist)
 
     diff_idx = [i for i, m in enumerate(diff_mask) if m]
 

@@ -412,3 +412,49 @@ def _pad(ctx, ins, attrs):
     paddings = attrs.get("paddings", [0] * (2 * x.ndim))
     pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
     return single(jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0)))
+
+
+# ---------------------------------------------------------------------------
+# fused attention (TPU-native extension; the reference composes this from
+# matmul+softmax+matmul — benchmark/fluid transformer prep. With an sp axis
+# configured, the op partitions its time dim over the mesh: ring attention /
+# Ulysses, parallel/ring_attention.py — the long-context capability)
+# ---------------------------------------------------------------------------
+
+@register_op("attention", ref="composed: matmul+softmax ops; TPU-native "
+                              "fused/sequence-parallel redesign")
+def _attention(ctx, ins, attrs):
+    """inputs: Q, K, V [B, H, T, D]; optional Bias [*, Tq, Tk] additive
+    mask. attrs: causal, scale (default D^-0.5), sp ("auto" to use the
+    mesh's sp axis when present), sp_impl ("ring"|"ulysses")."""
+    from paddle_tpu.parallel import ring_attention as ra
+
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    bias = first(ins, "Bias")
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale") or float(q.shape[-1]) ** -0.5
+
+    sp = attrs.get("sp", "auto")
+    mesh = ctx.mesh
+    sp_axis = getattr(ctx.dist, "sp_axis", None) if sp == "auto" else sp
+    use_sp = (mesh is not None and sp_axis and sp_axis in mesh.axis_names
+              and mesh.shape[sp_axis] > 1
+              and q.shape[2] % mesh.shape[sp_axis] == 0
+              and k.shape[2] % mesh.shape[sp_axis] == 0
+              and q.shape[2] == k.shape[2])
+    if use_sp:
+        if bias is not None:
+            raise ValueError(
+                "attention: additive Bias is not supported with sequence "
+                "parallelism — use causal=True for the causal mask")
+        out = ra.sp_attention(q, k, v, mesh, sp_axis, causal=causal,
+                              scale=scale,
+                              impl=attrs.get("sp_impl", "ring"),
+                              batch_axis=getattr(ctx.dist, "data_axis",
+                                                 None),
+                              head_axis=getattr(ctx.dist, "model_axis",
+                                                None))
+    else:
+        out = ra.full_attention(q, k, v, causal=causal, scale=scale,
+                                bias=bias)
+    return single(out)

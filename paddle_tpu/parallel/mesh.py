@@ -59,6 +59,10 @@ class DistributeConfig:
     # axis: embedding(is_distributed=True) rows land here — the TPU form of
     # the reference's param→pserver placement, transpiler/ps_dispatcher.py)
     model_axis: Optional[str] = "tp"
+    # sequence/context-parallel axis: attention ops partition their time
+    # dim here (ring attention / Ulysses — parallel/ring_attention.py);
+    # long-context capability beyond the reference's LoD story
+    sp_axis: Optional[str] = "sp"
     # param sharding rules: {param name regex: PartitionSpec-like tuple};
     # overrides per-var dist hints recorded by layers
     param_axes: Dict[str, tuple] = field(default_factory=dict)

@@ -1,0 +1,163 @@
+"""Sequence/context parallelism: ring attention and Ulysses-style
+all-to-all head parallelism.
+
+The reference predates attention partitioning entirely (SURVEY §5: its
+long-sequence story is LoD tensors + while-op RNNs), but long-context is
+first-class here: attention over a sequence sharded across the mesh `sp`
+axis, with the KV shards rotating around the ICI ring (ppermute) and a
+flash-attention-style online-softmax accumulator so no device ever holds
+the full [T, T] score matrix — memory per chip is O(T_local * T_block).
+
+Two interchangeable schedules:
+
+- ``ring``   — KV blocks circulate; Tq_local × Tk_local partial scores per
+  step; comm = (n-1) ppermute hops of the local KV (overlappable with the
+  MXU work of the current block by XLA's latency-hiding scheduler).
+- ``ulysses`` — two all-to-alls re-shard [T/n, H] → [T, H/n]; full local
+  attention in head-parallel form; best when H ≥ n and T_local is small.
+
+Both are pure jax and differentiable (grads flow through ppermute /
+all_to_all); both run inside shard_map over the program's mesh, nested
+under the CompiledBlock jit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_NEG = -1e30
+
+
+def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-shard ring attention. q/k/v: [B, H, T_local, D] (this rank's
+    sequence shard); returns [B, H, T_local, D]."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    q_pos = rank * Tq + jnp.arange(Tq)                    # global positions
+    dtype = q.dtype
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # derive the accumulators from qf so they carry the same manual-axis
+    # "varying" annotation as the rotating kv (shard_map VMA typing)
+    m0 = qf[..., 0] * 0 + _NEG        # [B, H, Tq]
+    l0 = qf[..., 0] * 0
+    o0 = qf * 0
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, j):
+        m, l, o, kj, vj = carry
+        kv_rank = (rank - j) % n
+        k_pos = kv_rank * Tk + jnp.arange(Tk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj)
+        if causal:
+            valid = (q_pos[:, None] >= k_pos[None, :])    # [Tq, Tk]
+            s = jnp.where(valid[None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = p * valid[None, None]
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vj)
+        # rotate KV to the next rank (ring hop over ICI)
+        kj = lax.ppermute(kj, axis_name, perm=perm)
+        vj = lax.ppermute(vj, axis_name, perm=perm)
+        return (m_new, l, o, kj, vj), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, kf, vf),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(dtype)
+
+
+def _ulysses_attention_shard(q, k, v, axis_name: str, causal: bool,
+                             scale: float):
+    """All-to-all head-parallel attention (Ulysses). q/k/v:
+    [B, H, T_local, D]; H must divide by the axis size."""
+    n = lax.axis_size(axis_name)
+    H = q.shape[1]
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by sp={n}")
+
+    def exchange(x):       # [B, H, T/n, D] -> [B, H/n, T, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def unexchange(x):     # [B, H/n, T, D] -> [B, H, T/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = exchange(q), exchange(k), exchange(v)
+    T = qg.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   qg.astype(jnp.float32) * scale, kg.astype(jnp.float32))
+    if causal:
+        pos = jnp.arange(T)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vg.astype(jnp.float32))
+    return unexchange(out.astype(q.dtype))
+
+
+def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
+                 scale=None, impl: str = "ring", batch_axis=None,
+                 head_axis=None):
+    """Sequence-parallel attention over global [B, H, T, D] arrays whose T
+    dim is (or will be) sharded over `sp_axis`. Runs inside jit; shard_map
+    drops to per-device code and XLA rides the ICI ring.
+
+    batch_axis/head_axis: optionally keep the surrounding dp (batch) / tp
+    (head) sharding inside the manual region, so entering the shard_map
+    does not force a reshard of activations that are already dp×tp
+    partitioned (both dims are embarrassingly parallel here)."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map            # jax >= 0.8
+    except ImportError:                      # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    fn = {"ring": _ring_attention_shard,
+          "ulysses": _ulysses_attention_shard}[impl]
+
+    def ok(axis, dim):
+        return (axis and axis != sp_axis and axis in mesh.axis_names
+                and dim % mesh.shape[axis] == 0) or None
+
+    b_ax = batch_axis if ok(batch_axis, q.shape[0]) else None
+    h_ax = head_axis if ok(head_axis, q.shape[1]) else None
+    spec = P(b_ax, h_ax, sp_axis, None)
+    mapped = shard_map(
+        partial(fn, axis_name=sp_axis, causal=causal, scale=float(scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return mapped(q, k, v)
+
+
+def full_attention(q, k, v, causal: bool = False, scale=None, bias=None):
+    """Single-device reference path ([B, H, Tq, D] x [B, H, Tk, D]); also
+    the emitter fallback when no sp axis is configured."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        qp = jnp.arange(Tq) + (Tk - Tq)
+        s = jnp.where((qp[:, None] >= jnp.arange(Tk)[None, :])[None, None],
+                      s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

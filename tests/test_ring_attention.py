@@ -1,0 +1,119 @@
+"""Sequence-parallelism tests: ring attention and Ulysses all-to-all over
+the 8-device virtual CPU mesh (conftest). The correctness contract is
+equality with single-device full attention — the analogue of the
+reference's ParallelExecutor convergence-equivalence tests
+(parallel_executor_test_base.py), applied to the sequence axis."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.parallel import DistributeConfig, make_mesh
+from paddle_tpu.parallel import ring_attention as ra
+
+
+def _qkv(B=2, H=8, T=16, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda s: jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+    return mk(0), mk(1), mk(2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_attention_matches_full(causal, impl):
+    q, k, v = _qkv()
+    mesh = make_mesh({"sp": 8})
+    want = ra.full_attention(q, k, v, causal=causal)
+    got = jax.jit(lambda a, b, c: ra.sp_attention(
+        a, b, c, mesh, "sp", causal=causal, impl=impl))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_full():
+    """Backward through the ring (ppermute/scan) must equal the dense
+    attention gradient."""
+    q, k, v = _qkv(T=8)
+    mesh = make_mesh({"sp": 4, "dp": 2})
+
+    def loss_full(q, k, v):
+        return (ra.full_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        return (ra.sp_attention(q, k, v, mesh, "sp", causal=True) ** 2).sum()
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-5)
+
+
+def test_attention_op_sp_auto():
+    """Program-level: the attention op partitions over the configured sp
+    axis and matches the unsharded run."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        with fluid.program_guard(main, startup):
+            q = layers.data(name="q", shape=[4, 16, 8], dtype="float32")
+            k = layers.data(name="k", shape=[4, 16, 8], dtype="float32")
+            v = layers.data(name="v", shape=[4, 16, 8], dtype="float32")
+            out = layers.scaled_dot_product_attention(q, k, v, causal=True)
+            s = layers.reduce_sum(out)
+        return main, startup, s
+
+    rng = np.random.RandomState(1)
+    feed = {n: rng.randn(2, 4, 16, 8).astype(np.float32)
+            for n in ("q", "k", "v")}
+
+    main, startup, s = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (ref,) = exe.run(main, feed=feed, fetch_list=[s.name])
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    dist = DistributeConfig(mesh=mesh, data_axis="dp", sp_axis="sp")
+    main2, startup2, s2 = build()
+    exe.run(startup2)
+    prog = fluid.CompiledProgram(main2).with_sharding(dist)
+    (got,) = exe.run(prog, feed=feed, fetch_list=[s2.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_transformer_fused_attention_trains_sharded():
+    """Flagship model with fused attention under dp×sp sharding: loss is
+    finite and decreases (long-context capability end to end)."""
+    from paddle_tpu.models import transformer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        loss, _, feed_specs = transformer.build(
+            is_train=True, src_vocab=64, tgt_vocab=64, max_len=16,
+            d_model=32, d_inner=64, n_head=4, n_layer=2, dropout=0.0,
+            lr=1e-3, label_smooth_eps=0.0, fused_attention=True)
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    dist = DistributeConfig(mesh=mesh, data_axis="dp", sp_axis="sp")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    prog = fluid.CompiledProgram(main).with_sharding(dist)
+
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randint(0, 64, [4 if d == -1 else d for d in shape]
+                           ).astype(dt)
+            for n, (shape, dt) in feed_specs.items()}
+    losses = []
+    for _ in range(6):
+        (lv,) = exe.run(prog, feed=feed, fetch_list=[loss.name],
+                        scope=scope)
+        losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
