@@ -1,0 +1,258 @@
+// Chunk-lease task master: the EDL-era fault-tolerant data dispatcher
+// (reference: go/master/service.go — partition :106, GetTask :366 with
+// lease timeout via checkTimeoutFunc :313, TaskFinished :410, TaskFailed
+// :455 with failureMax drop :341, snapshot :207 / recover :166 to etcd).
+//
+// TPU-native redesign: same lease/timeout/retry state machine in C++,
+// in-process behind the ctypes ABI; persistence goes to a local snapshot
+// file instead of etcd (the coordination plane on TPU pods is the JAX
+// coordination service; the snapshot keeps the crash-recovery capability).
+// Tasks are chunk ranges of RecordIO files — the same granularity the Go
+// master leased.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  int64_t id;
+  std::string path;
+  int64_t chunk_begin;
+  int64_t chunk_end;
+  int failures = 0;
+};
+
+struct Lease {
+  Task task;
+  Clock::time_point deadline;
+  int64_t epoch;  // lease epoch: stale finishes/fails are ignored
+};
+
+class Master {
+ public:
+  Master(double timeout_s, int failure_max)
+      : timeout_s_(timeout_s), failure_max_(failure_max) {}
+
+  void AddTask(const char* path, int64_t begin, int64_t end) {
+    std::lock_guard<std::mutex> lk(mu_);
+    todo_.push_back(Task{next_id_++, path, begin, end});
+    total_++;
+  }
+
+  // serialized "id|epoch|path|begin|end"; returns 1 leased, 0 retry-later
+  // (pending leases may time out), -1 all done. The task is only moved to
+  // pending after serialization succeeds — no lease can be created that
+  // was never delivered.
+  int GetTask(std::string* out, uint64_t out_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    Expire();
+    if (todo_.empty()) {
+      if (pending_.empty()) return done_ >= total_ ? -1 : 0;
+      return 0;
+    }
+    const Task& t = todo_.front();
+    int64_t epoch = epoch_;
+    std::ostringstream os;
+    os << t.id << "|" << epoch << "|" << t.path << "|" << t.chunk_begin
+       << "|" << t.chunk_end;
+    if (os.str().size() + 1 > out_cap) return -2;
+    *out = os.str();
+    epoch_++;
+    Lease lease{t, Clock::now() + std::chrono::microseconds(
+                       static_cast<int64_t>(timeout_s_ * 1e6)),
+                epoch};
+    pending_[t.id] = lease;
+    todo_.pop_front();
+    return 1;
+  }
+
+  // epoch guards against a timed-out worker reporting onto a re-issued
+  // lease of the same task (reference: the Go master matches epochs,
+  // service.go TaskFinished/TaskFailed)
+  int TaskFinished(int64_t id, int64_t epoch) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(id);
+    if (it == pending_.end() || it->second.epoch != epoch)
+      return -1;  // stale (lease expired and possibly reissued)
+    pending_.erase(it);
+    done_++;
+    return 0;
+  }
+
+  int TaskFailed(int64_t id, int64_t epoch) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = pending_.find(id);
+    if (it == pending_.end() || it->second.epoch != epoch) return -1;
+    Task t = it->second.task;
+    pending_.erase(it);
+    Requeue(t);
+    return 0;
+  }
+
+  int64_t NumDone() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return done_;
+  }
+
+  int64_t NumTodo() {
+    std::lock_guard<std::mutex> lk(mu_);
+    Expire();
+    return static_cast<int64_t>(todo_.size());
+  }
+
+  int64_t NumPending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    Expire();
+    return static_cast<int64_t>(pending_.size());
+  }
+
+  int64_t NumDropped() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return dropped_;
+  }
+
+  // snapshot format: one line per task "state id path begin end failures"
+  int Snapshot(const char* file) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ofstream out(file, std::ios::trunc);
+    if (!out.good()) return -1;
+    out << "ptpu_master_v1 " << next_id_ << " " << done_ << " " << total_
+        << " " << dropped_ << "\n";
+    for (const auto& t : todo_) Dump(out, "todo", t);
+    // pending leases snapshot as todo: after recovery they re-lease
+    // (reference: recovered tasks go back to the queue, service.go:166)
+    for (const auto& kv : pending_) Dump(out, "todo", kv.second.task);
+    return 0;
+  }
+
+  int Recover(const char* file) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::ifstream in(file);
+    if (!in.good()) return -1;
+    std::string tag;
+    in >> tag;
+    if (tag != "ptpu_master_v1") return -1;
+    in >> next_id_ >> done_ >> total_ >> dropped_;
+    todo_.clear();
+    pending_.clear();
+    std::string state, path;
+    Task t;
+    while (in >> state >> t.id >> path >> t.chunk_begin >> t.chunk_end >>
+           t.failures) {
+      t.path = path;
+      todo_.push_back(t);
+    }
+    return 0;
+  }
+
+ private:
+  void Dump(std::ofstream& out, const char* state, const Task& t) {
+    out << state << " " << t.id << " " << t.path << " " << t.chunk_begin
+        << " " << t.chunk_end << " " << t.failures << "\n";
+  }
+
+  void Requeue(Task t) {
+    t.failures++;
+    if (t.failures >= failure_max_) {
+      // drop permanently (reference: service.go:341 failureMax)
+      dropped_++;
+      done_++;  // counts toward completion so the epoch can finish
+    } else {
+      todo_.push_back(t);
+    }
+  }
+
+  void Expire() {
+    auto now = Clock::now();
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (it->second.deadline <= now) {
+        Task t = it->second.task;
+        it = pending_.erase(it);
+        Requeue(t);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  std::mutex mu_;
+  double timeout_s_;
+  int failure_max_;
+  std::deque<Task> todo_;
+  std::map<int64_t, Lease> pending_;
+  int64_t next_id_ = 0;
+  int64_t epoch_ = 0;
+  int64_t done_ = 0;
+  int64_t total_ = 0;
+  int64_t dropped_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_master_new(double timeout_s, int failure_max) {
+  return new Master(timeout_s, failure_max);
+}
+
+void ptpu_master_add_task(void* m, const char* path, int64_t begin,
+                          int64_t end) {
+  static_cast<Master*>(m)->AddTask(path, begin, end);
+}
+
+// out buffer provided by caller; returns 1 leased, 0 retry, -1 done,
+// -2 buffer too small (task NOT leased — caller retries with more room)
+int ptpu_master_get_task(void* m, char* out, uint64_t out_cap) {
+  std::string s;
+  int r = static_cast<Master*>(m)->GetTask(&s, out_cap);
+  if (r == 1) std::memcpy(out, s.c_str(), s.size() + 1);
+  return r;
+}
+
+int ptpu_master_task_finished(void* m, int64_t id, int64_t epoch) {
+  return static_cast<Master*>(m)->TaskFinished(id, epoch);
+}
+
+int ptpu_master_task_failed(void* m, int64_t id, int64_t epoch) {
+  return static_cast<Master*>(m)->TaskFailed(id, epoch);
+}
+
+int64_t ptpu_master_num_done(void* m) {
+  return static_cast<Master*>(m)->NumDone();
+}
+
+int64_t ptpu_master_num_todo(void* m) {
+  return static_cast<Master*>(m)->NumTodo();
+}
+
+int64_t ptpu_master_num_pending(void* m) {
+  return static_cast<Master*>(m)->NumPending();
+}
+
+int64_t ptpu_master_num_dropped(void* m) {
+  return static_cast<Master*>(m)->NumDropped();
+}
+
+int ptpu_master_snapshot(void* m, const char* file) {
+  return static_cast<Master*>(m)->Snapshot(file);
+}
+
+int ptpu_master_recover(void* m, const char* file) {
+  return static_cast<Master*>(m)->Recover(file);
+}
+
+void ptpu_master_free(void* m) { delete static_cast<Master*>(m); }
+
+}  // extern "C"

@@ -1,0 +1,533 @@
+// paddle_tpu native IO runtime: RecordIO + blocking queue + MultiSlot
+// DataFeed.
+//
+// Reference components re-implemented TPU-native (SURVEY §2):
+//  #21 RecordIO  — paddle/fluid/recordio/{chunk.h:27,scanner.h:40,writer.h}:
+//      chunked, CRC'd, compressed record file format with chunk-granular
+//      seeking (the unit the EDL master leases, go/master/service.go:106).
+//  #20 Reader pipeline — operators/reader/blocking_queue.h: bounded
+//      thread-safe queue powering py_reader/double-buffer prefetch.
+//  #15 DataFeed  — framework/data_feed.h:49,224 MultiSlotDataFeed: worker
+//      threads parse slotted text files into batches for CTR training
+//      (the AsyncExecutor input path, framework/async_executor.cc).
+//
+// Design notes vs the reference: records here are written with zlib
+// (snappy is not in the image); the chunk layout keeps the reference's
+// magic/num-records/checksum framing so the capability (corruption
+// detection + chunk seek) is identical. The C ABI below is consumed by
+// ctypes (paddle_tpu/core/native.py) — no pybind in this build.
+
+#include <zlib.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545055;  // "PTPU"
+
+// ---------------------------------------------------------------------------
+// RecordIO
+// ---------------------------------------------------------------------------
+
+struct ChunkHeader {
+  uint32_t magic;
+  uint32_t num_records;
+  uint32_t compress;       // 0 none, 1 zlib
+  uint32_t checksum;       // crc32 of payload as stored
+  uint64_t payload_len;    // stored payload bytes
+  uint64_t raw_len;        // uncompressed payload bytes
+};
+
+class RecordIOWriter {
+ public:
+  RecordIOWriter(const char* path, int max_chunk_records, int compress)
+      : out_(path, std::ios::binary | std::ios::trunc),
+        max_records_(max_chunk_records > 0 ? max_chunk_records : 1000),
+        compress_(compress), chunks_(0) {}
+
+  bool ok() const { return out_.good(); }
+
+  void Write(const char* data, uint64_t len) {
+    uint32_t l = static_cast<uint32_t>(len);
+    buf_.append(reinterpret_cast<const char*>(&l), sizeof(l));
+    buf_.append(data, len);
+    num_records_++;
+    if (num_records_ >= max_records_) Flush();
+  }
+
+  void Flush() {
+    if (num_records_ == 0) return;
+    std::string payload;
+    uint64_t raw_len = buf_.size();
+    if (compress_) {
+      uLongf dest_len = compressBound(buf_.size());
+      payload.resize(dest_len);
+      compress2(reinterpret_cast<Bytef*>(&payload[0]), &dest_len,
+                reinterpret_cast<const Bytef*>(buf_.data()), buf_.size(), 6);
+      payload.resize(dest_len);
+    } else {
+      payload = buf_;
+    }
+    ChunkHeader h{kMagic, num_records_, static_cast<uint32_t>(compress_),
+                  static_cast<uint32_t>(
+                      crc32(0, reinterpret_cast<const Bytef*>(payload.data()),
+                            payload.size())),
+                  payload.size(), raw_len};
+    out_.write(reinterpret_cast<const char*>(&h), sizeof(h));
+    out_.write(payload.data(), payload.size());
+    buf_.clear();
+    num_records_ = 0;
+    chunks_++;
+  }
+
+  int Close() {
+    Flush();
+    out_.close();
+    return chunks_;
+  }
+
+ private:
+  std::ofstream out_;
+  std::string buf_;
+  uint32_t num_records_ = 0;
+  uint32_t max_records_;
+  int compress_;
+  int chunks_;
+};
+
+class RecordIOScanner {
+ public:
+  // chunk_begin/chunk_end: half-open chunk range; end < 0 means "to EOF"
+  // (the chunk-lease granularity of the EDL master, service.go:106).
+  RecordIOScanner(const char* path, int64_t chunk_begin, int64_t chunk_end)
+      : in_(path, std::ios::binary), chunk_end_(chunk_end) {
+    if (!in_.good()) { failed_ = true; return; }
+    for (int64_t i = 0; i < chunk_begin && SkipChunk(); ++i) {}
+    chunk_idx_ = chunk_begin;
+  }
+
+  bool ok() const { return !failed_; }
+
+  // returns pointer valid until next call; len -1 at EOF, -2 on corruption
+  int64_t Next(const char** out) {
+    while (rec_idx_ >= records_.size()) {
+      if (chunk_end_ >= 0 && chunk_idx_ >= chunk_end_) return -1;
+      int r = LoadChunk();
+      if (r == 0) return -1;
+      if (r < 0) return -2;
+      chunk_idx_++;
+    }
+    cur_ = std::move(records_[rec_idx_++]);
+    *out = cur_.data();
+    return static_cast<int64_t>(cur_.size());
+  }
+
+ private:
+  bool SkipChunk() {
+    ChunkHeader h;
+    if (!in_.read(reinterpret_cast<char*>(&h), sizeof(h))) return false;
+    if (h.magic != kMagic) return false;
+    in_.seekg(h.payload_len, std::ios::cur);
+    return in_.good();
+  }
+
+  // 1 loaded, 0 eof, -1 corrupt
+  int LoadChunk() {
+    ChunkHeader h;
+    if (!in_.read(reinterpret_cast<char*>(&h), sizeof(h))) return 0;
+    if (h.magic != kMagic) return -1;
+    std::string payload(h.payload_len, '\0');
+    if (!in_.read(&payload[0], h.payload_len)) return -1;
+    uint32_t crc = crc32(0, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    if (crc != h.checksum) return -1;
+    std::string raw;
+    if (h.compress) {
+      raw.resize(h.raw_len);
+      uLongf dest_len = h.raw_len;
+      if (uncompress(reinterpret_cast<Bytef*>(&raw[0]), &dest_len,
+                     reinterpret_cast<const Bytef*>(payload.data()),
+                     payload.size()) != Z_OK || dest_len != h.raw_len)
+        return -1;
+    } else {
+      raw = std::move(payload);
+    }
+    records_.clear();
+    rec_idx_ = 0;
+    size_t off = 0;
+    for (uint32_t i = 0; i < h.num_records; ++i) {
+      if (off + sizeof(uint32_t) > raw.size()) return -1;
+      uint32_t l;
+      std::memcpy(&l, raw.data() + off, sizeof(l));
+      off += sizeof(l);
+      if (off + l > raw.size()) return -1;
+      records_.emplace_back(raw.data() + off, l);
+      off += l;
+    }
+    return 1;
+  }
+
+  std::ifstream in_;
+  bool failed_ = false;
+  int64_t chunk_idx_ = 0;
+  int64_t chunk_end_;
+  std::vector<std::string> records_;
+  size_t rec_idx_ = 0;
+  std::string cur_;
+};
+
+int64_t CountChunks(const char* path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in.good()) return -1;
+  int64_t n = 0;
+  ChunkHeader h;
+  while (in.read(reinterpret_cast<char*>(&h), sizeof(h))) {
+    if (h.magic != kMagic) return -1;
+    in.seekg(h.payload_len, std::ios::cur);
+    if (!in.good()) return -1;
+    n++;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Blocking queue (operators/reader/blocking_queue.h capability)
+// ---------------------------------------------------------------------------
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(uint64_t cap) : cap_(cap ? cap : 1) {}
+
+  // 1 pushed, 0 closed, -1 would block
+  int Push(std::string item, bool block) {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (q_.size() >= cap_ && !closed_) {
+      if (!block) return -1;
+      cv_push_.wait(lk);
+    }
+    if (closed_) return 0;
+    q_.push_back(std::move(item));
+    cv_pop_.notify_one();
+    return 1;
+  }
+
+  // 1 popped, 0 closed+empty, -1 would block
+  int Pop(std::string* out, bool block) {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (q_.empty() && !closed_) {
+      if (!block) return -1;
+      cv_pop_.wait(lk);
+    }
+    if (q_.empty()) return 0;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    cv_push_.notify_one();
+    return 1;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+  }
+
+  uint64_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<std::string> q_;
+  uint64_t cap_;
+  bool closed_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// MultiSlot DataFeed (framework/data_feed.h:224 capability)
+// ---------------------------------------------------------------------------
+//
+// Input: text lines, per line for each slot: "<n> v1 ... vn". Slot spec is
+// a compact string "name:type:dense;name2:..." with type in {u64, f32}.
+// Output batch wire format (parsed by python into padded arrays):
+//   u32 n_slots; per slot:
+//     u32 name_len; name bytes; u8 dtype (0=i64, 1=f32); u32 batch;
+//     u32 lens[batch]; u64 total; payload (total * elemsize)
+
+struct SlotSpec {
+  std::string name;
+  int dtype;  // 0 int64, 1 float32
+  bool dense;
+};
+
+std::vector<SlotSpec> ParseSlots(const char* desc) {
+  std::vector<SlotSpec> out;
+  std::string s(desc);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    std::string item = s.substr(pos, end - pos);
+    size_t c1 = item.find(':'), c2 = item.find(':', c1 + 1);
+    SlotSpec spec;
+    spec.name = item.substr(0, c1);
+    std::string ty = item.substr(c1 + 1, c2 - c1 - 1);
+    spec.dtype = (ty == "f32") ? 1 : 0;
+    spec.dense = item.substr(c2 + 1) == "1";
+    out.push_back(spec);
+    pos = end + 1;
+  }
+  return out;
+}
+
+class MultiSlotFeed {
+ public:
+  MultiSlotFeed(const char* slots_desc, int batch_size, uint64_t queue_cap)
+      : slots_(ParseSlots(slots_desc)), batch_size_(batch_size),
+        queue_(queue_cap) {}
+
+  void AddFile(const char* path) { files_.push_back(path); }
+
+  void Start(int nthreads) {
+    next_file_.store(0);
+    active_.store(nthreads);
+    for (int t = 0; t < nthreads; ++t)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  int64_t Next(std::string* out) {
+    int r = queue_.Pop(out, /*block=*/true);
+    return r == 1 ? static_cast<int64_t>(out->size()) : -1;
+  }
+
+  void Stop() {
+    queue_.Close();
+    for (auto& w : workers_) if (w.joinable()) w.join();
+    workers_.clear();
+  }
+
+  ~MultiSlotFeed() { Stop(); }
+
+ private:
+  struct Batch {
+    std::vector<std::vector<uint32_t>> lens;   // per slot per row
+    std::vector<std::vector<int64_t>> ivals;   // per slot
+    std::vector<std::vector<float>> fvals;
+    int rows = 0;
+  };
+
+  void WorkerLoop() {
+    // each worker leases whole files (the reference shards the filelist
+    // across ExecutorThreadWorkers, async_executor.cc RunFromFile)
+    Batch b;
+    InitBatch(&b);
+    for (;;) {
+      size_t fi = next_file_.fetch_add(1);
+      if (fi >= files_.size()) break;
+      std::ifstream in(files_[fi]);
+      std::string line;
+      while (std::getline(in, line)) {
+        if (ParseLine(line, &b) && b.rows >= batch_size_) {
+          EmitBatch(&b);
+          InitBatch(&b);
+        }
+      }
+    }
+    if (b.rows > 0) EmitBatch(&b);
+    if (active_.fetch_sub(1) == 1) queue_.Close();  // last worker out
+  }
+
+  void InitBatch(Batch* b) {
+    b->rows = 0;
+    b->lens.assign(slots_.size(), {});
+    b->ivals.assign(slots_.size(), {});
+    b->fvals.assign(slots_.size(), {});
+  }
+
+  bool ParseLine(const std::string& line, Batch* b) {
+    // parse into row-local buffers first: a malformed line must not leave
+    // partial slot data behind (it would desynchronize every later batch
+    // this worker emits)
+    const char* p = line.c_str();
+    char* end;
+    std::vector<uint32_t> row_lens(slots_.size());
+    std::vector<std::vector<int64_t>> row_i(slots_.size());
+    std::vector<std::vector<float>> row_f(slots_.size());
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      long n = std::strtol(p, &end, 10);
+      if (end == p || n < 0) return false;
+      p = end;
+      row_lens[s] = static_cast<uint32_t>(n);
+      for (long i = 0; i < n; ++i) {
+        if (slots_[s].dtype == 0) {
+          long long v = std::strtoll(p, &end, 10);
+          if (end == p) return false;
+          row_i[s].push_back(v);
+        } else {
+          float v = std::strtof(p, &end);
+          if (end == p) return false;
+          row_f[s].push_back(v);
+        }
+        p = end;
+      }
+    }
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      b->lens[s].push_back(row_lens[s]);
+      b->ivals[s].insert(b->ivals[s].end(), row_i[s].begin(),
+                         row_i[s].end());
+      b->fvals[s].insert(b->fvals[s].end(), row_f[s].begin(),
+                         row_f[s].end());
+    }
+    b->rows++;
+    return true;
+  }
+
+  void EmitBatch(Batch* b) {
+    std::string w;
+    uint32_t n_slots = slots_.size();
+    Append(&w, n_slots);
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      Append(&w, static_cast<uint32_t>(slots_[s].name.size()));
+      w.append(slots_[s].name);
+      w.push_back(static_cast<char>(slots_[s].dtype));
+      Append(&w, static_cast<uint32_t>(b->rows));
+      w.append(reinterpret_cast<const char*>(b->lens[s].data()),
+               b->lens[s].size() * sizeof(uint32_t));
+      if (slots_[s].dtype == 0) {
+        Append(&w, static_cast<uint64_t>(b->ivals[s].size()));
+        w.append(reinterpret_cast<const char*>(b->ivals[s].data()),
+                 b->ivals[s].size() * sizeof(int64_t));
+      } else {
+        Append(&w, static_cast<uint64_t>(b->fvals[s].size()));
+        w.append(reinterpret_cast<const char*>(b->fvals[s].data()),
+                 b->fvals[s].size() * sizeof(float));
+      }
+    }
+    queue_.Push(std::move(w), /*block=*/true);
+  }
+
+  template <typename T>
+  static void Append(std::string* w, T v) {
+    w->append(reinterpret_cast<const char*>(&v), sizeof(v));
+  }
+
+  std::vector<SlotSpec> slots_;
+  int batch_size_;
+  BlockingQueue queue_;
+  std::vector<std::string> files_;
+  std::vector<std::thread> workers_;
+  std::atomic<size_t> next_file_{0};
+  std::atomic<int> active_{0};
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI (consumed via ctypes, paddle_tpu/core/native.py)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* ptpu_rio_writer_open(const char* path, int max_chunk_records,
+                           int compress) {
+  auto* w = new RecordIOWriter(path, max_chunk_records, compress);
+  if (!w->ok()) { delete w; return nullptr; }
+  return w;
+}
+
+int ptpu_rio_writer_write(void* w, const char* data, uint64_t len) {
+  static_cast<RecordIOWriter*>(w)->Write(data, len);
+  return 0;
+}
+
+int ptpu_rio_writer_close(void* w) {
+  auto* writer = static_cast<RecordIOWriter*>(w);
+  int chunks = writer->Close();
+  delete writer;
+  return chunks;
+}
+
+void* ptpu_rio_scanner_open(const char* path, int64_t chunk_begin,
+                            int64_t chunk_end) {
+  auto* s = new RecordIOScanner(path, chunk_begin, chunk_end);
+  if (!s->ok()) { delete s; return nullptr; }
+  return s;
+}
+
+int64_t ptpu_rio_scanner_next(void* s, const char** out) {
+  return static_cast<RecordIOScanner*>(s)->Next(out);
+}
+
+void ptpu_rio_scanner_close(void* s) {
+  delete static_cast<RecordIOScanner*>(s);
+}
+
+int64_t ptpu_rio_num_chunks(const char* path) { return CountChunks(path); }
+
+void* ptpu_queue_new(uint64_t cap) { return new BlockingQueue(cap); }
+
+int ptpu_queue_push(void* q, const char* data, uint64_t len, int block) {
+  return static_cast<BlockingQueue*>(q)->Push(std::string(data, len),
+                                              block != 0);
+}
+
+// caller frees *out with ptpu_buf_free
+int64_t ptpu_queue_pop(void* q, char** out, int block) {
+  std::string item;
+  int r = static_cast<BlockingQueue*>(q)->Pop(&item, block != 0);
+  if (r != 1) return r == 0 ? -1 : -2;
+  char* buf = static_cast<char*>(std::malloc(item.size()));
+  std::memcpy(buf, item.data(), item.size());
+  *out = buf;
+  return static_cast<int64_t>(item.size());
+}
+
+uint64_t ptpu_queue_size(void* q) {
+  return static_cast<BlockingQueue*>(q)->Size();
+}
+
+void ptpu_queue_close(void* q) { static_cast<BlockingQueue*>(q)->Close(); }
+
+void ptpu_queue_free(void* q) { delete static_cast<BlockingQueue*>(q); }
+
+void ptpu_buf_free(char* p) { std::free(p); }
+
+void* ptpu_feed_new(const char* slots_desc, int batch_size,
+                    uint64_t queue_cap) {
+  return new MultiSlotFeed(slots_desc, batch_size, queue_cap);
+}
+
+void ptpu_feed_add_file(void* f, const char* path) {
+  static_cast<MultiSlotFeed*>(f)->AddFile(path);
+}
+
+void ptpu_feed_start(void* f, int nthreads) {
+  static_cast<MultiSlotFeed*>(f)->Start(nthreads);
+}
+
+// caller frees with ptpu_buf_free; -1 = finished
+int64_t ptpu_feed_next(void* f, char** out) {
+  std::string item;
+  int64_t r = static_cast<MultiSlotFeed*>(f)->Next(&item);
+  if (r < 0) return -1;
+  char* buf = static_cast<char*>(std::malloc(item.size()));
+  std::memcpy(buf, item.data(), item.size());
+  *out = buf;
+  return static_cast<int64_t>(item.size());
+}
+
+void ptpu_feed_free(void* f) { delete static_cast<MultiSlotFeed*>(f); }
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+"""Native-library loader: builds and binds csrc/paddle_tpu_native.cc.
+
+The reference ships these components as C++ inside the monolithic
+libpaddle build (recordio/, operators/reader/blocking_queue.h,
+framework/data_feed.cc); here the native runtime is a small standalone
+shared object compiled on first use (g++ is baked into the image) and
+bound via ctypes — no pybind dependency.
+
+`lib()` raises NativeUnavailable when no compiler is present; callers
+(recordio, datafeed) degrade to pure-python fallbacks so the framework
+stays importable everywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = None
+_ERR = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _build(srcs, out: str) -> None:
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = f"{out}.tmp.{os.getpid()}.so"   # per-process: concurrent cold
+                                          # builds must not clobber each
+                                          # other mid-write
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           *srcs, "-o", tmp, "-lz"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, out)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    sigs = {
+        "ptpu_rio_writer_open": ([c.c_char_p, c.c_int, c.c_int], c.c_void_p),
+        "ptpu_rio_writer_write": ([c.c_void_p, c.c_char_p, c.c_uint64],
+                                  c.c_int),
+        "ptpu_rio_writer_close": ([c.c_void_p], c.c_int),
+        "ptpu_rio_scanner_open": ([c.c_char_p, c.c_int64, c.c_int64],
+                                  c.c_void_p),
+        "ptpu_rio_scanner_next": ([c.c_void_p, c.POINTER(c.c_char_p)],
+                                  c.c_int64),
+        "ptpu_rio_scanner_close": ([c.c_void_p], None),
+        "ptpu_rio_num_chunks": ([c.c_char_p], c.c_int64),
+        "ptpu_queue_new": ([c.c_uint64], c.c_void_p),
+        "ptpu_queue_push": ([c.c_void_p, c.c_char_p, c.c_uint64, c.c_int],
+                            c.c_int),
+        "ptpu_queue_pop": ([c.c_void_p, c.POINTER(c.POINTER(c.c_char)),
+                            c.c_int], c.c_int64),
+        "ptpu_queue_size": ([c.c_void_p], c.c_uint64),
+        "ptpu_queue_close": ([c.c_void_p], None),
+        "ptpu_queue_free": ([c.c_void_p], None),
+        "ptpu_buf_free": ([c.POINTER(c.c_char)], None),
+        "ptpu_feed_new": ([c.c_char_p, c.c_int, c.c_uint64], c.c_void_p),
+        "ptpu_feed_add_file": ([c.c_void_p, c.c_char_p], None),
+        "ptpu_feed_start": ([c.c_void_p, c.c_int], None),
+        "ptpu_feed_next": ([c.c_void_p, c.POINTER(c.POINTER(c.c_char))],
+                           c.c_int64),
+        "ptpu_feed_free": ([c.c_void_p], None),
+        "ptpu_master_new": ([c.c_double, c.c_int], c.c_void_p),
+        "ptpu_master_add_task": ([c.c_void_p, c.c_char_p, c.c_int64,
+                                  c.c_int64], None),
+        "ptpu_master_get_task": ([c.c_void_p, c.c_char_p, c.c_uint64],
+                                 c.c_int),
+        "ptpu_master_task_finished": ([c.c_void_p, c.c_int64, c.c_int64], c.c_int),
+        "ptpu_master_task_failed": ([c.c_void_p, c.c_int64, c.c_int64], c.c_int),
+        "ptpu_master_num_done": ([c.c_void_p], c.c_int64),
+        "ptpu_master_num_todo": ([c.c_void_p], c.c_int64),
+        "ptpu_master_num_pending": ([c.c_void_p], c.c_int64),
+        "ptpu_master_num_dropped": ([c.c_void_p], c.c_int64),
+        "ptpu_master_snapshot": ([c.c_void_p, c.c_char_p], c.c_int),
+        "ptpu_master_recover": ([c.c_void_p, c.c_char_p], c.c_int),
+        "ptpu_master_free": ([c.c_void_p], None),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library."""
+    global _LIB, _ERR
+    if _LIB is not None:
+        return _LIB
+    if _ERR is not None:
+        raise NativeUnavailable(_ERR)
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        root = _repo_root()
+        srcs = [os.path.join(root, "csrc", f)
+                for f in sorted(os.listdir(os.path.join(root, "csrc")))
+                if f.endswith(".cc")]
+        out = os.path.join(root, "paddle_tpu", "_native",
+                           "libpaddle_tpu_native.so")
+        try:
+            if (not os.path.exists(out)
+                    or any(os.path.getmtime(out) < os.path.getmtime(s)
+                           for s in srcs)):
+                _build(srcs, out)
+            _LIB = _bind(ctypes.CDLL(out))
+        except Exception as e:  # compiler missing / load failure
+            _ERR = f"native library unavailable: {e}"
+            raise NativeUnavailable(_ERR) from e
+        return _LIB
+
+
+def available() -> bool:
+    try:
+        lib()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def take_buffer(ptr, size: int) -> bytes:
+    """Copy a malloc'd buffer returned by the C ABI and free it."""
+    data = ctypes.string_at(ptr, size)
+    lib().ptpu_buf_free(ptr)
+    return data

@@ -8,5 +8,8 @@ the same capability.
 """
 
 from paddle_tpu.data.pipeline import DataLoader, PyReader
+from paddle_tpu.data.datafeed import (AsyncExecutor, DataFeedDesc,
+                                      MultiSlotDataFeed)
 
-__all__ = ["DataLoader", "PyReader"]
+__all__ = ["AsyncExecutor", "DataFeedDesc", "DataLoader",
+           "MultiSlotDataFeed", "PyReader"]

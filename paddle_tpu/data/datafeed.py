@@ -1,0 +1,159 @@
+"""MultiSlot DataFeed + AsyncExecutor-style file-fed training
+(reference: framework/data_feed.h:49,224 MultiSlotDataFeed + data_feed.proto
+slot schema; framework/async_executor.cc RunFromFile with
+ExecutorThreadWorker file sharding, executor_thread_worker.h:136).
+
+Native worker threads (csrc/paddle_tpu_native.cc MultiSlotFeed) parse
+slotted text files into batches behind a blocking queue; Python converts
+each wire batch to the padded-[B,T]+seq_lens LoD form and feeds the
+compiled step. The reference ran one interpreter per thread; on TPU the
+chip is the serial resource, so N parse threads + 1 device loop is the
+idiomatic shape (parsing overlaps device execution)."""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core import native
+
+
+class DataFeedDesc:
+    """Slot schema (reference: data_feed.proto / python DataFeedDesc).
+    slots: list of dicts {name, type: "uint64"|"float32", dense: bool,
+    max_len: padding target for sparse slots (default: batch max)}."""
+
+    def __init__(self, slots: List[dict], batch_size: int = 32):
+        self.slots = slots
+        self.batch_size = batch_size
+
+    def _wire_desc(self) -> str:
+        parts = []
+        for s in self.slots:
+            ty = "f32" if s.get("type", "uint64").startswith("float") \
+                else "u64"
+            parts.append(f"{s['name']}:{ty}:{int(bool(s.get('dense')))}")
+        return ";".join(parts)
+
+
+class MultiSlotDataFeed:
+    """Iterate batches parsed from slotted text files by native threads."""
+
+    def __init__(self, desc: DataFeedDesc, filelist: List[str],
+                 nthreads: int = 2, queue_capacity: int = 8):
+        if not native.available():
+            raise native.NativeUnavailable(
+                "MultiSlotDataFeed requires the native runtime")
+        self._desc = desc
+        self._h = native.lib().ptpu_feed_new(
+            desc._wire_desc().encode(), desc.batch_size, queue_capacity)
+        for f in filelist:
+            native.lib().ptpu_feed_add_file(self._h, f.encode())
+        self._nthreads = nthreads
+        self._started = False
+
+    def __iter__(self):
+        if self._h is None:
+            raise RuntimeError(
+                "MultiSlotDataFeed is single-pass: the native feed was "
+                "already consumed/closed — construct a new one per epoch "
+                "(the reference DataFeed is likewise re-created per pass)")
+        if self._started:
+            raise RuntimeError("MultiSlotDataFeed already iterating")
+        native.lib().ptpu_feed_start(self._h, self._nthreads)
+        self._started = True
+        out = ctypes.POINTER(ctypes.c_char)()
+        try:
+            while True:
+                n = native.lib().ptpu_feed_next(self._h, ctypes.byref(out))
+                if n < 0:
+                    break
+                yield self._parse(native.take_buffer(out, n))
+        finally:
+            # runs on exhaustion AND on generator close (early break/GC):
+            # joins worker threads and frees the native handle
+            h, self._h = self._h, None
+            native.lib().ptpu_feed_free(h)
+
+    def _parse(self, wire: bytes) -> Dict[str, np.ndarray]:
+        """Wire batch -> {slot: padded array (+ slot__lens for sparse)}."""
+        off = 0
+        (n_slots,) = struct.unpack_from("<I", wire, off)
+        off += 4
+        batch = {}
+        max_lens = {s["name"]: s.get("max_len") for s in self._desc.slots}
+        dense = {s["name"]: bool(s.get("dense")) for s in self._desc.slots}
+        for _ in range(n_slots):
+            (name_len,) = struct.unpack_from("<I", wire, off)
+            off += 4
+            name = wire[off:off + name_len].decode()
+            off += name_len
+            dtype = wire[off]
+            off += 1
+            (rows,) = struct.unpack_from("<I", wire, off)
+            off += 4
+            lens = np.frombuffer(wire, "<u4", rows, off).astype(np.int32)
+            off += 4 * rows
+            (total,) = struct.unpack_from("<Q", wire, off)
+            off += 8
+            if dtype == 0:
+                vals = np.frombuffer(wire, "<i8", total, off)
+                off += 8 * total
+            else:
+                vals = np.frombuffer(wire, "<f4", total, off)
+                off += 4 * total
+            if dense[name]:
+                width = lens[0] if rows else 0
+                batch[name] = vals.reshape(rows, width)
+            else:
+                # ragged -> padded [B, T] + lens (the LoD form)
+                T = int(max_lens[name] or (lens.max() if rows else 1) or 1)
+                arr = np.zeros((rows, T), dtype=vals.dtype)
+                pos = 0
+                for r, l in enumerate(lens):
+                    k = min(int(l), T)
+                    arr[r, :k] = vals[pos:pos + k]
+                    pos += int(l)
+                batch[name] = arr
+                batch[name + "__lens"] = np.minimum(lens, T).astype(np.int32)
+        return batch
+
+
+class AsyncExecutor:
+    """reference: fluid.AsyncExecutor (python/paddle/fluid/async_executor.py
+    → framework/async_executor.cc). run() trains a program from slotted
+    text files: native threads parse; the device loop consumes. The PSlib
+    parameter-server integration (InitServer/InitWorker) is delivered by
+    mesh-sharded params instead (see paddle_tpu.parallel)."""
+
+    def __init__(self, place=None):
+        from paddle_tpu.core.executor import Executor, TPUPlace
+        self._exe = Executor(place or TPUPlace())
+
+    def run(self, program, data_feed: DataFeedDesc, filelist: List[str],
+            thread_num: int = 2, fetch: Optional[List] = None,
+            feed_mapping: Optional[Dict[str, str]] = None,
+            scope=None, debug: bool = False):
+        """feed_mapping: {program feed name: slot name or slot__lens}."""
+        fetch = fetch or []
+        fetch_names = [getattr(v, "name", v) for v in fetch]
+        feed_it = MultiSlotDataFeed(data_feed, filelist, thread_num)
+        results = []
+        for batch in feed_it:
+            if feed_mapping:
+                feed = {dst: batch[src]
+                        for dst, src in feed_mapping.items()}
+            else:
+                feed = {k: v for k, v in batch.items()
+                        if not k.endswith("__lens")}
+            vals = self._exe.run(program, feed=feed,
+                                 fetch_list=fetch_names, scope=scope)
+            if fetch_names:
+                results.append([np.asarray(v) for v in vals])
+            if debug and results:
+                print(f"async_executor batch {len(results)}: "
+                      f"{[float(v.reshape(-1)[0]) for v in results[-1]]}")
+        return results

@@ -1,0 +1,139 @@
+"""Elastic data master: chunk-lease task dispatch over RecordIO datasets
+(reference: the Go EDL master, go/master/service.go — partition :106,
+GetTask :366, TaskFinished :410, TaskFailed :455, failureMax :341,
+snapshot/recover :207/:166; client go/master/client.go).
+
+The C++ state machine lives in csrc/master.cc; this wrapper partitions
+datasets into chunk-range tasks, and `task_reader` drives the
+lease → scan → finish loop a trainer runs. A worker that dies mid-task
+simply never reports; the lease times out and the task is re-issued to a
+surviving worker — elasticity without etcd (snapshots cover master
+crashes; multi-host serving can front this with any RPC layer while the
+JAX coordination service owns liveness)."""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+from paddle_tpu import recordio
+from paddle_tpu.core import native
+
+
+@dataclass
+class Task:
+    id: int
+    epoch: int      # lease epoch: stale reports onto a re-issued lease
+                    # of the same task are rejected (master.cc)
+    path: str
+    chunk_begin: int
+    chunk_end: int
+
+
+class Master:
+    """Task queue with lease timeout + retry + failure-max drop."""
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3):
+        if not native.available():
+            raise native.NativeUnavailable("master requires native runtime")
+        self._h = native.lib().ptpu_master_new(float(timeout_s),
+                                               int(failure_max))
+
+    def set_dataset(self, paths: List[str], chunks_per_task: int = 1):
+        """Partition RecordIO files into chunk-range tasks
+        (reference: service.go:106 partition)."""
+        for p in paths:
+            n = recordio.num_chunks(p)
+            for b in range(0, max(n, 1), chunks_per_task):
+                native.lib().ptpu_master_add_task(
+                    self._h, p.encode(), b, min(b + chunks_per_task, n))
+
+    def add_task(self, path: str, chunk_begin: int, chunk_end: int):
+        native.lib().ptpu_master_add_task(self._h, path.encode(),
+                                          chunk_begin, chunk_end)
+
+    def get_task(self) -> Optional[Task]:
+        """None = nothing leasable right now (retry) ; raises StopIteration
+        semantics via `done` property instead."""
+        cap = 1024
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            r = native.lib().ptpu_master_get_task(self._h, buf, cap)
+            if r == -2:                  # task path longer than the buffer
+                cap *= 8
+                continue
+            if r != 1:
+                return None
+            tid, epoch, path, b, e = buf.value.decode().split("|")
+            return Task(int(tid), int(epoch), path, int(b), int(e))
+
+    def task_finished(self, task: "Task") -> bool:
+        return native.lib().ptpu_master_task_finished(
+            self._h, task.id, task.epoch) == 0
+
+    def task_failed(self, task: "Task") -> bool:
+        return native.lib().ptpu_master_task_failed(
+            self._h, task.id, task.epoch) == 0
+
+    @property
+    def done(self) -> bool:
+        lib = native.lib()
+        return (lib.ptpu_master_num_todo(self._h) == 0
+                and lib.ptpu_master_num_pending(self._h) == 0
+                and lib.ptpu_master_num_done(self._h) > 0)
+
+    def stats(self) -> dict:
+        lib = native.lib()
+        return {"todo": lib.ptpu_master_num_todo(self._h),
+                "pending": lib.ptpu_master_num_pending(self._h),
+                "done": lib.ptpu_master_num_done(self._h),
+                "dropped": lib.ptpu_master_num_dropped(self._h)}
+
+    def snapshot(self, path: str):
+        if native.lib().ptpu_master_snapshot(self._h, path.encode()) != 0:
+            raise IOError(f"snapshot to {path!r} failed")
+
+    def recover(self, path: str):
+        if native.lib().ptpu_master_recover(self._h, path.encode()) != 0:
+            raise IOError(f"recover from {path!r} failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                native.lib().ptpu_master_free(h)
+            except Exception:
+                pass
+            self._h = None
+
+
+def task_reader(master: Master, poll_interval: float = 0.05,
+                fail_injector=None) -> Iterator[bytes]:
+    """The trainer-side loop (reference: go/master/client.go NextRecord):
+    lease a task, scan its chunk range, report finished; on scan error
+    report failed. `fail_injector(task) -> bool` lets tests kill a task
+    mid-flight (the reference tests kill processes; SURVEY §5)."""
+    while True:
+        task = master.get_task()
+        if task is None:
+            if master.done:
+                return
+            time.sleep(poll_interval)
+            continue
+        scanner = None
+        try:
+            if fail_injector is not None and fail_injector(task):
+                continue          # simulate worker death: never report
+            scanner = recordio.Scanner(task.path, task.chunk_begin,
+                                       task.chunk_end)
+            for rec in scanner:
+                yield rec
+        except Exception:
+            master.task_failed(task)
+            continue
+        finally:
+            if scanner is not None:
+                scanner.close()
+        master.task_finished(task)

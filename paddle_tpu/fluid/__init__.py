@@ -17,6 +17,7 @@ from paddle_tpu.fluid.param_attr import ParamAttr
 from paddle_tpu.fluid.compiler import (BuildStrategy, CompiledProgram,
                                        ExecutionStrategy)
 from paddle_tpu.fluid.parallel_executor import ParallelExecutor
+from paddle_tpu.data.datafeed import AsyncExecutor, DataFeedDesc
 from paddle_tpu.fluid import transpiler
 from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
                                          DistributeTranspilerConfig,
@@ -33,4 +34,5 @@ __all__ = [
     "io", "learning_rate_scheduler", "metrics", "profiler", "DataFeeder",
     "ParallelExecutor", "memory_optimize", "release_memory",
     "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
+    "AsyncExecutor", "DataFeedDesc",
 ]

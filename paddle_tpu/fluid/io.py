@@ -35,10 +35,10 @@ def _persistable_names(program) -> List[str]:
 
 
 def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = None,
-              predicate=None, filename=None):
-    """reference: io.py:222."""
+              predicate=None, filename=None, scope=None):
+    """reference: io.py:222 (scope: the fluid.scope_guard capability)."""
     main_program = main_program or framework.default_main_program()
-    scope = global_scope()
+    scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)
     if vars is None:
         vars = _persistable_names(main_program)
@@ -59,16 +59,18 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = 
     return saved
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     """reference: io.py:270."""
-    return save_vars(executor, dirname, main_program, filename=filename)
+    return save_vars(executor, dirname, main_program, filename=filename,
+                     scope=scope)
 
 
 def load_vars(executor, dirname, main_program=None,
               vars: Optional[List[str]] = None, predicate=None,
-              filename=None):
+              filename=None, scope=None):
     """reference: io.py load_vars."""
-    scope = global_scope()
+    scope = scope or global_scope()
     if vars is None:
         with open(os.path.join(dirname, _MANIFEST)) as f:
             vars = json.load(f)["vars"]
@@ -83,9 +85,10 @@ def load_vars(executor, dirname, main_program=None,
     return loaded
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
     """reference: io.py:490."""
-    return load_vars(executor, dirname, main_program)
+    return load_vars(executor, dirname, main_program, scope=scope)
 
 
 def save_inference_model(dirname, feeded_var_names: List[str], target_vars,

@@ -1,0 +1,201 @@
+"""RecordIO: chunked, checksummed, compressed record files
+(reference: paddle/fluid/recordio/ — Chunk chunk.h:27, Scanner scanner.h:40,
+Writer writer.h; python recordio_writer.py convert_reader_to_recordio_file).
+
+Backed by the native C++ runtime (csrc/paddle_tpu_native.cc) with a pure-
+python fallback writing the identical on-disk format, so files round-trip
+between both implementations. Chunks are the seek/lease granularity: the
+elastic data master hands out chunk ranges as tasks
+(reference: go/master/service.go:106 partition)."""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+from typing import Iterator, Optional
+
+from paddle_tpu.core import native
+
+_MAGIC = 0x50545055
+_HDR = struct.Struct("<IIIIQQ")   # magic, nrec, compress, crc, plen, rawlen
+
+
+class Writer:
+    """reference: recordio/writer.h Writer."""
+
+    def __init__(self, path: str, max_chunk_records: int = 1000,
+                 compress: bool = True):
+        self._path = path
+        self._chunks = 0
+        if native.available():
+            self._h = native.lib().ptpu_rio_writer_open(
+                path.encode(), max_chunk_records, int(compress))
+            if not self._h:
+                raise IOError(f"cannot open {path!r} for writing")
+            self._py = None
+        else:
+            self._h = None
+            self._py = _PyWriter(path, max_chunk_records, compress)
+
+    def write(self, record: bytes) -> None:
+        if isinstance(record, str):
+            record = record.encode()
+        if self._h is not None:
+            native.lib().ptpu_rio_writer_write(self._h, record, len(record))
+        else:
+            self._py.write(record)
+
+    def close(self) -> int:
+        if self._h is not None:
+            self._chunks = native.lib().ptpu_rio_writer_close(self._h)
+            self._h = None
+        elif self._py is not None:
+            self._chunks = self._py.close()
+            self._py = None
+        return self._chunks
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Scanner:
+    """reference: recordio/scanner.h Scanner; chunk_begin/chunk_end select
+    a chunk range (RangeScanner capability)."""
+
+    def __init__(self, path: str, chunk_begin: int = 0,
+                 chunk_end: int = -1):
+        self._native = native.available()
+        if self._native:
+            self._h = native.lib().ptpu_rio_scanner_open(
+                path.encode(), chunk_begin, chunk_end)
+            if not self._h:
+                raise IOError(f"cannot open {path!r}")
+        else:
+            self._it = _py_scan(path, chunk_begin, chunk_end)
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._native:
+            out = ctypes.c_char_p()
+            while True:
+                n = native.lib().ptpu_rio_scanner_next(
+                    self._h, ctypes.byref(out))
+                if n == -1:
+                    break
+                if n == -2:
+                    raise IOError("corrupt recordio chunk (crc mismatch)")
+                yield ctypes.string_at(out, n)
+        else:
+            yield from self._it
+
+    def close(self):
+        if self._native and self._h:
+            native.lib().ptpu_rio_scanner_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def num_chunks(path: str) -> int:
+    if native.available():
+        n = native.lib().ptpu_rio_num_chunks(path.encode())
+        if n < 0:
+            raise IOError(f"cannot read {path!r}")
+        return n
+    return sum(1 for _ in _py_chunks(path))
+
+
+# ---------------------------------------------------------------------------
+# pure-python fallback (same on-disk format)
+# ---------------------------------------------------------------------------
+
+class _PyWriter:
+    def __init__(self, path, max_records, compress):
+        self._f = open(path, "wb")
+        self._max = max_records
+        self._compress = compress
+        self._buf = []
+        self._n = 0
+        self._chunks = 0
+
+    def write(self, rec: bytes):
+        self._buf.append(struct.pack("<I", len(rec)) + rec)
+        self._n += 1
+        if self._n >= self._max:
+            self._flush()
+
+    def _flush(self):
+        if not self._n:
+            return
+        raw = b"".join(self._buf)
+        payload = zlib.compress(raw, 6) if self._compress else raw
+        self._f.write(_HDR.pack(_MAGIC, self._n, int(self._compress),
+                                zlib.crc32(payload) & 0xFFFFFFFF,
+                                len(payload), len(raw)))
+        self._f.write(payload)
+        self._buf, self._n = [], 0
+        self._chunks += 1
+
+    def close(self):
+        self._flush()
+        self._f.close()
+        return self._chunks
+
+
+def _py_chunks(path):
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return
+            magic, nrec, comp, crc, plen, rawlen = _HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise IOError("bad recordio magic")
+            payload = f.read(plen)
+            yield nrec, comp, crc, rawlen, payload
+
+
+def _py_scan(path, chunk_begin, chunk_end):
+    for i, (nrec, comp, crc, rawlen, payload) in enumerate(_py_chunks(path)):
+        if i < chunk_begin:
+            continue
+        if chunk_end >= 0 and i >= chunk_end:
+            return
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise IOError("corrupt recordio chunk (crc mismatch)")
+        raw = zlib.decompress(payload) if comp else payload
+        off = 0
+        for _ in range(nrec):
+            (l,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            yield raw[off:off + l]
+            off += l
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor=True, max_num_records=1000):
+    """reference: recordio_writer.py — serialize a reader's batches.
+    Records are pickled feed dicts (the reference serializes LoDTensors)."""
+    import pickle
+    n = 0
+    with Writer(filename, max_num_records, bool(compressor)) as w:
+        for sample in reader_creator():
+            if feeder is not None:
+                sample = feeder.feed([sample] if not isinstance(sample, dict)
+                                     else sample)
+            w.write(pickle.dumps(sample, protocol=4))
+            n += 1
+    return n
