@@ -1,0 +1,10 @@
+"""paddle_tpu.contrib (reference: paddle/contrib + python contrib/ —
+float16 inference transpiler contrib/float16/float16_transpiler.py, mixed
+precision utilities). bfloat16 replaces float16 throughout: it is the
+MXU-native reduced precision and needs no loss-scaling tricks for
+inference."""
+
+from paddle_tpu.contrib import mixed_precision  # noqa: F401
+from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
+
+__all__ = ["BF16Transpiler", "Float16Transpiler", "mixed_precision"]

@@ -1,0 +1,96 @@
+"""Reduced-precision inference transpiler (reference:
+contrib/float16/float16_transpiler.py — rewrites a test-mode program to
+fp16: params cast once, compute in half precision, fetches cast back).
+
+TPU-native: the reduced dtype is **bfloat16** — same exponent range as
+fp32, so the reference's black-list/overflow bookkeeping is unnecessary;
+the MXU natively consumes bf16 operands. The transpile is:
+  1. cast persistable float32 params in the scope to bf16,
+  2. insert cast(feed -> bf16) after feeds and cast(fetch -> fp32) before
+     fetches by rewriting the program desc,
+XLA then runs the interior in bf16 (fp32 islands where dtype promotion
+demands, e.g. batch-norm statistics)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core import ir
+
+
+class BF16Transpiler:
+    """reference: float16_transpiler.py Float16Transpiler.transpile
+    (program, place, scope)."""
+
+    target_dtype = "bfloat16"
+
+    def transpile(self, program, place=None, scope=None,
+                  feed_names=None, fetch_names=None):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.scope import global_scope
+        scope = scope or global_scope()
+        block = program.desc.global_block
+
+        # 1. params -> bf16 in the scope (cast once, like the reference's
+        #    one-time weight conversion)
+        for name, vd in block.vars.items():
+            if not vd.persistable or vd.dtype != "float32":
+                continue
+            val = scope.find_var(name)
+            if val is None:
+                continue
+            scope.set_var(name, jax.device_put(
+                jnp.asarray(np.asarray(val), dtype=jnp.bfloat16)))
+            vd.dtype = self.target_dtype
+
+        # 2. cast feeds in / fetches out
+        feed_names = list(feed_names or [])
+        fetch_names = list(fetch_names or [])
+        renames = {}
+        new_ops = []
+        for fname in feed_names:
+            if not block.has_var(fname):
+                continue
+            vd = block.var(fname)
+            if vd.dtype != "float32":
+                continue                       # int feeds stay integral
+            half = fname + "@BF16"
+            block.add_var(ir.VarDesc(name=half, shape=vd.shape,
+                                     dtype=self.target_dtype))
+            new_ops.append(ir.OpDesc(
+                type="cast", inputs={"X": [fname]}, outputs={"Out": [half]},
+                attrs={"in_dtype": "float32",
+                       "out_dtype": self.target_dtype}))
+            renames[fname] = half
+
+        for op in block.ops:
+            op.inputs = {slot: [renames.get(n, n) for n in names]
+                         for slot, names in op.inputs.items()}
+        block.ops[:0] = new_ops
+
+        for fname in fetch_names:
+            if not block.has_var(fname):
+                continue
+            half = fname + "@PREF32"
+            # the op producing the fetch now writes the @PREF32 temp; a
+            # trailing cast materializes the fp32 fetch
+            for op in block.ops:
+                op.outputs = {slot: [half if n == fname else n
+                                     for n in names]
+                              for slot, names in op.outputs.items()}
+            block.add_var(ir.VarDesc(name=half, shape=block.var(fname).shape,
+                                     dtype=self.target_dtype))
+            block.append_op(ir.OpDesc(
+                type="cast", inputs={"X": [half]}, outputs={"Out": [fname]},
+                attrs={"in_dtype": self.target_dtype,
+                       "out_dtype": "float32"}))
+
+        program.desc.bump_version()
+        return program
+
+
+# the reference spelling; fp16 proper is available for completeness but
+# bf16 is the TPU-correct choice
+class Float16Transpiler(BF16Transpiler):
+    target_dtype = "float16"

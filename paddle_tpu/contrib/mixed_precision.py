@@ -1,0 +1,143 @@
+"""Mixed-precision training decorator (capability successor of the
+reference's fp16 direction: the reference era shipped fp16 *inference*
+(contrib/float16); this adds the training half the way later fluid did —
+loss scaling + overflow-safe updates — expressed dataflow-style for XLA).
+
+On TPU the compute dtype is bfloat16, whose fp32-equal exponent range
+makes loss scaling unnecessary for most models; `decorate` exists for
+capability parity and true-fp16 experiments. Semantics:
+
+  scaled_loss = loss * scale;  grads = backward(scaled_loss)
+  finite      = all(isfinite(g))
+  g'          = g * finite / scale      # zeroed on overflow -> update is
+                                        # skipped in effect (divergence:
+                                        # adaptive moments see a zero grad
+                                        # instead of no op at all)
+  dynamic: scale grows by incr_ratio after incr_every_n_steps clean steps,
+  shrinks by decr_ratio on overflow — all on-device (XLA select), no host
+  round-trip per step."""
+
+from __future__ import annotations
+
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.initializer import ConstantInitializer
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def _emit(op_type, inputs, n_out=1, attrs=None, dtype="float32",
+          out_slot="Out"):
+    helper = LayerHelper(op_type)
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_out)]
+    helper.append_op(op_type, inputs=inputs, outputs={out_slot: outs},
+                     attrs=attrs or {})
+    return outs[0] if n_out == 1 else outs
+
+
+def _const(value):
+    from paddle_tpu.fluid import layers
+    return layers.fill_constant([1], "float32", float(value))
+
+
+def _finite_flag(grads):
+    """all(isfinite(g)) over every gradient, as a float32 [1] tensor."""
+    from paddle_tpu.fluid import layers
+    flags = []
+    for g in grads:
+        fin = _emit("isfinite", {"X": [g]}, dtype="bool")
+        flags.append(layers.cast(fin, "float32"))
+    prod = flags[0]
+    for f in flags[1:]:
+        prod = layers.elementwise_mul(prod, f)
+    return layers.reshape(prod, shape=[1])
+
+
+def decorate(optimizer, init_loss_scaling=2.0 ** 15,
+             use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+             decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5):
+    """reference: fluid.contrib.mixed_precision.decorate(optimizer, ...)
+    -> optimizer whose minimize() trains under loss scaling."""
+    return OptimizerWithMixedPrecision(
+        optimizer, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, incr_ratio, decr_ratio)
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, init_scale, dynamic, incr_every,
+                 incr_ratio, decr_ratio):
+        self._opt = optimizer
+        self._init_scale = float(init_scale)
+        self._dynamic = dynamic
+        self._incr_every = float(incr_every)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+
+    @property
+    def loss_scaling_name(self):
+        return "loss_scaling@AMP"
+
+    def backward(self, *a, **kw):
+        return self._opt.backward(*a, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self._opt.apply_gradients(params_grads)
+
+    def _persistable(self, name, value):
+        main = framework.default_main_program()
+        startup = framework.default_startup_program()
+        v = main.global_block().create_var(
+            name=name, shape=[1], dtype="float32", persistable=True,
+            stop_gradient=True)
+        sv = startup.global_block().create_var(
+            name=name, shape=[1], dtype="float32", persistable=True)
+        ConstantInitializer(float(value))(sv, startup.global_block())
+        return v
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from paddle_tpu.fluid import layers
+
+        scale_var = self._persistable(self.loss_scaling_name,
+                                      self._init_scale)
+        good_steps = self._persistable("good_steps@AMP", 0.0)
+
+        scaled_loss = layers.elementwise_mul(loss, scale_var)
+        params_grads = self._opt.backward(scaled_loss, startup_program,
+                                          parameter_list, no_grad_set)
+
+        finite = _finite_flag([g for _, g in params_grads])
+        # g' = g * (finite / scale): [1] broadcasts against any grad shape
+        mult = layers.elementwise_div(finite, scale_var)
+        safe = [(p, layers.elementwise_mul(g, mult))
+                for p, g in params_grads]
+        opt_ops = self._opt.apply_gradients(safe)
+
+        if self._dynamic:
+            one = _const(1.0)
+            inc = layers.elementwise_mul(
+                layers.elementwise_add(good_steps, one), finite)
+            reached = layers.cast(
+                _ge(inc, _const(self._incr_every)), "float32")
+            grown = layers.elementwise_mul(
+                scale_var,
+                layers.elementwise_add(
+                    one, layers.elementwise_mul(
+                        reached, _const(self._incr_ratio - 1.0))))
+            shrunk = layers.elementwise_add(
+                layers.elementwise_mul(grown, finite),
+                layers.elementwise_mul(
+                    layers.elementwise_mul(scale_var,
+                                           _const(self._decr_ratio)),
+                    layers.elementwise_sub(one, finite)))
+            layers.assign(shrunk, scale_var)
+            keep = layers.elementwise_mul(
+                inc, layers.elementwise_sub(one, reached))
+            layers.assign(keep, good_steps)
+
+        return opt_ops, params_grads
+
+
+def _ge(a, b):
+    """a >= b as a float-friendly bool tensor via the compare ops."""
+    from paddle_tpu.fluid import layers
+    return layers.greater_equal(a, b)

@@ -93,7 +93,8 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
 
 def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
                          executor, main_program=None, model_filename=None,
-                         params_filename=None, export_for_deployment=True):
+                         params_filename=None, export_for_deployment=True,
+                         scope=None):
     """reference: io.py:570 — prune to feed/fetch targets + serialize."""
     main_program = main_program or framework.default_main_program()
     os.makedirs(dirname, exist_ok=True)
@@ -113,12 +114,12 @@ def save_inference_model(dirname, feeded_var_names: List[str], target_vars,
         }, f)
     # save only params the pruned program references
     needed = [n for n, vd in pruned_block.vars.items() if vd.persistable]
-    save_vars(executor, dirname, main_program, vars=needed)
+    save_vars(executor, dirname, main_program, vars=needed, scope=scope)
     return target_names
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     """reference: io.py:704 — returns (program, feed_names, fetch_names)."""
     with open(os.path.join(dirname, model_filename or _MODEL_FILENAME)) as f:
         payload = json.load(f)
@@ -136,7 +137,7 @@ def load_inference_model(dirname, executor, model_filename=None,
     program._is_test = True
     load_vars(executor, dirname,
               vars=[n for n, vd in restored.global_block.vars.items()
-                    if vd.persistable])
+                    if vd.persistable], scope=scope)
     return program, payload["feed_names"], payload["fetch_names"]
 
 

@@ -1,0 +1,20 @@
+"""Inference deployment (reference: paddle/fluid/inference/ — the
+PaddlePredictor C++ API api/paddle_api.h, AnalysisPredictor
+api/analysis_predictor.cc with its IR-pass pipeline, and the TensorRT
+subgraph offload tensorrt_subgraph_pass.cc).
+
+TPU-native redesign: XLA is already the whole-graph compiler, so the
+TRT/Anakin/nGraph subgraph machinery has no analogue — the Predictor is a
+thin shell over the compiled-block cache (one XLA executable per input-shape
+signature), and the "analysis" stage is the inference transpiler's IR
+rewrites (BN folding). StableHLO export replaces the serialized-ProgramDesc
+deployment format for serving stacks that consume portable IR.
+"""
+
+from paddle_tpu.inference.predictor import (AnalysisConfig, PaddlePredictor,
+                                            create_paddle_predictor)
+from paddle_tpu.inference.transpiler import InferenceTranspiler
+from paddle_tpu.inference.export import export_stablehlo
+
+__all__ = ["AnalysisConfig", "InferenceTranspiler", "PaddlePredictor",
+           "create_paddle_predictor", "export_stablehlo"]

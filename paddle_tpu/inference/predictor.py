@@ -1,0 +1,98 @@
+"""Predictor API (reference: inference/api/paddle_api.h PaddlePredictor,
+api/api_impl.cc NativePaddlePredictor, api/analysis_predictor.cc
+AnalysisPredictor + AnalysisConfig; CreatePaddlePredictor factory)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+@dataclass
+class AnalysisConfig:
+    """reference: api/paddle_analysis_config.h. GPU/MKLDNN/TensorRT knobs
+    are accepted for API parity and ignored (XLA compiles the whole graph;
+    there is no subgraph offload tier on TPU)."""
+
+    model_dir: str = ""
+    prog_file: str = ""
+    params_file: str = ""
+    # reference: switch_ir_optim — run the inference transpiler's IR
+    # rewrites (BN fold) before compiling
+    ir_optim: bool = True
+    use_gpu: bool = False          # parity no-op
+    device_id: int = 0             # parity no-op
+    enable_memory_optim_: bool = True   # parity no-op (XLA buffer reuse)
+    tensorrt: dict = field(default_factory=dict)  # parity no-op
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self.use_gpu = True
+        self.device_id = device_id
+
+    def disable_gpu(self):
+        self.use_gpu = False
+
+    def switch_ir_optim(self, x: bool = True):
+        self.ir_optim = x
+
+    def enable_memory_optim(self):
+        self.enable_memory_optim_ = True
+
+    def enable_tensorrt_engine(self, **kw):
+        """reference: analysis_config TensorRT offload — no TPU analogue;
+        recorded and ignored (XLA compiles the full graph)."""
+        self.tensorrt = kw
+
+
+class PaddlePredictor:
+    """reference: paddle_api.h PaddlePredictor::Run. Each distinct input
+    shape signature compiles once and is cached (the reference re-ran the
+    interpreter per call; here repeat calls hit the XLA executable cache,
+    executor.py program cache capability)."""
+
+    def __init__(self, config: AnalysisConfig):
+        self._config = config
+        self._scope = fluid.Scope()
+        self._exe = fluid.Executor(fluid.TPUPlace())
+        import paddle_tpu.fluid.framework as fw
+        # load under a guard so startup-less restore does not pollute the
+        # caller's default programs
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            program, feeds, fetches = fluid.io.load_inference_model(
+                config.model_dir, self._exe,
+                model_filename=config.prog_file or None,
+                params_filename=config.params_file or None,
+                scope=self._scope)
+        if config.ir_optim:
+            from paddle_tpu.inference.transpiler import InferenceTranspiler
+            InferenceTranspiler().transpile(program, scope=self._scope)
+        self._program = program
+        self._feed_names = feeds
+        self._fetch_names = fetches
+
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def run(self, inputs) -> List[np.ndarray]:
+        """inputs: dict {feed name: array} or list in feed order."""
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self._feed_names, inputs))
+        outs = self._exe.run(self._program, feed=inputs,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
+        return [np.asarray(o) for o in outs]
+
+    # reference spelling
+    __call__ = run
+
+
+def create_paddle_predictor(config: AnalysisConfig) -> PaddlePredictor:
+    """reference: CreatePaddlePredictor<AnalysisConfig>."""
+    return PaddlePredictor(config)

@@ -1,0 +1,92 @@
+"""Inference IR rewrites (reference: transpiler/inference_transpiler.py —
+folds batch_norm into the preceding conv for test-mode programs; the
+capability behind the conv_bn_fuse_pass family, ir/conv_bn_fuse_pass.cc).
+
+y = scale * (conv(x) + b - mean) / sqrt(var + eps) + shift
+  = conv'(x) + shift'          with conv' = alpha·W, b' = alpha·b,
+    shift' = shift - alpha·mean,  alpha = scale / sqrt(var + eps)
+
+The batch_norm op is rewritten in place into an elementwise_add of the
+folded shift (cheaper graph, one fewer normalization op; XLA then fuses
+the add into the conv epilogue)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+CONV_TYPES = {"conv2d", "depthwise_conv2d", "conv3d", "conv2d_transpose"}
+
+
+class InferenceTranspiler:
+    """reference: inference_transpiler.py InferenceTranspiler.transpile
+    (program, place, scope)."""
+
+    def transpile(self, program, place=None, scope=None):
+        import jax
+        from paddle_tpu.core.scope import global_scope
+        scope = scope or global_scope()
+        block = program.desc.global_block
+
+        producers = {}
+        for op in block.ops:
+            for n in op.output_names():
+                producers[n] = op
+
+        folded = 0
+        for op in list(block.ops):
+            if op.type != "batch_norm":
+                continue
+            x = op.inputs["X"][0]
+            prod = producers.get(x)
+            bias_op = None
+            conv_op = None
+            if prod is not None and prod.type == "elementwise_add" and \
+                    prod.attrs.get("axis", -1) == 1:
+                bias_op = prod
+                up = producers.get(prod.inputs["X"][0])
+                if up is not None and up.type in CONV_TYPES:
+                    conv_op = up
+            elif prod is not None and prod.type in CONV_TYPES:
+                conv_op = prod
+            if conv_op is None:
+                continue
+
+            w_name = conv_op.inputs["Filter"][0]
+            scale = np.asarray(scope.find_var(op.inputs["Scale"][0]))
+            shift = np.asarray(scope.find_var(op.inputs["Bias"][0]))
+            mean = np.asarray(scope.find_var(op.inputs["Mean"][0]))
+            var = np.asarray(scope.find_var(op.inputs["Variance"][0]))
+            eps = float(op.attrs.get("epsilon", 1e-5))
+            alpha = scale / np.sqrt(var + eps)
+
+            w = np.asarray(scope.find_var(w_name))
+            if conv_op.type == "conv2d_transpose":
+                # filter layout [I, O, kh, kw]
+                w = w * alpha.reshape(1, -1, 1, 1)
+            else:
+                w = w * alpha.reshape(-1, *([1] * (w.ndim - 1)))
+            scope.set_var(w_name, jax.device_put(w.astype(np.float32)))
+
+            if bias_op is not None:
+                b_name = bias_op.inputs["Y"][0]
+                b = np.asarray(scope.find_var(b_name))
+                scope.set_var(b_name,
+                              jax.device_put((alpha * b).astype(np.float32)))
+            shift_new = (shift - alpha * mean).astype(np.float32)
+
+            # reuse the bn Bias var to carry the folded shift (it is
+            # already persistable and correctly shaped)
+            shift_name = op.inputs["Bias"][0]
+            scope.set_var(shift_name, jax.device_put(shift_new))
+
+            # rewrite batch_norm -> elementwise_add(X, shift') in place
+            y = op.outputs["Y"][0]
+            op.type = "elementwise_add"
+            op.inputs = {"X": [x], "Y": [shift_name]}
+            op.outputs = {"Out": [y]}
+            op.attrs = {"axis": 1}
+            folded += 1
+
+        if folded:
+            program.desc.bump_version()
+        return folded
