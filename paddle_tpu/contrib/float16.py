@@ -41,7 +41,8 @@ class BF16Transpiler:
             if val is None:
                 continue
             scope.set_var(name, jax.device_put(
-                jnp.asarray(np.asarray(val), dtype=jnp.bfloat16)))
+                jnp.asarray(np.asarray(val),
+                            dtype=jnp.dtype(self.target_dtype))))
             vd.dtype = self.target_dtype
 
         # 2. cast feeds in / fetches out
@@ -72,15 +73,28 @@ class BF16Transpiler:
         for fname in fetch_names:
             if not block.has_var(fname):
                 continue
+            vd = block.var(fname)
+            if vd.dtype not in ("float32", self.target_dtype):
+                continue                       # int fetches stay integral
+            has_producer = any(
+                fname in names for op in block.ops
+                for names in op.outputs.values())
+            if not has_producer:
+                continue  # direct feed / param fetch: nothing to rewrite
             half = fname + "@PREF32"
-            # the op producing the fetch now writes the @PREF32 temp; a
-            # trailing cast materializes the fp32 fetch
+            # the op producing the fetch now writes the @PREF32 temp (and
+            # every interior consumer reads it); a trailing cast
+            # materializes the fp32 fetch under the original name
             for op in block.ops:
                 op.outputs = {slot: [half if n == fname else n
                                      for n in names]
                               for slot, names in op.outputs.items()}
-            block.add_var(ir.VarDesc(name=half, shape=block.var(fname).shape,
+                op.inputs = {slot: [half if n == fname else n
+                                    for n in names]
+                             for slot, names in op.inputs.items()}
+            block.add_var(ir.VarDesc(name=half, shape=vd.shape,
                                      dtype=self.target_dtype))
+            vd.dtype = "float32"
             block.append_op(ir.OpDesc(
                 type="cast", inputs={"X": [half]}, outputs={"Out": [fname]},
                 attrs={"in_dtype": self.target_dtype,

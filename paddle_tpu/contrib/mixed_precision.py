@@ -59,18 +59,20 @@ def decorate(optimizer, init_loss_scaling=2.0 ** 15,
     -> optimizer whose minimize() trains under loss scaling."""
     return OptimizerWithMixedPrecision(
         optimizer, init_loss_scaling, use_dynamic_loss_scaling,
-        incr_every_n_steps, incr_ratio, decr_ratio)
+        incr_every_n_steps, incr_ratio, decr_ratio,
+        decr_every_n_nan_or_inf)
 
 
 class OptimizerWithMixedPrecision:
     def __init__(self, optimizer, init_scale, dynamic, incr_every,
-                 incr_ratio, decr_ratio):
+                 incr_ratio, decr_ratio, decr_every=2):
         self._opt = optimizer
         self._init_scale = float(init_scale)
         self._dynamic = dynamic
         self._incr_every = float(incr_every)
         self._incr_ratio = float(incr_ratio)
         self._decr_ratio = float(decr_ratio)
+        self._decr_every = float(decr_every)
 
     @property
     def loss_scaling_name(self):
@@ -113,7 +115,9 @@ class OptimizerWithMixedPrecision:
         opt_ops = self._opt.apply_gradients(safe)
 
         if self._dynamic:
+            bad_steps = self._persistable("bad_steps@AMP", 0.0)
             one = _const(1.0)
+            not_finite = layers.elementwise_sub(one, finite)
             inc = layers.elementwise_mul(
                 layers.elementwise_add(good_steps, one), finite)
             reached = layers.cast(
@@ -123,16 +127,29 @@ class OptimizerWithMixedPrecision:
                 layers.elementwise_add(
                     one, layers.elementwise_mul(
                         reached, _const(self._incr_ratio - 1.0))))
-            shrunk = layers.elementwise_add(
-                layers.elementwise_mul(grown, finite),
+            # shrink only after decr_every consecutive nan/inf steps
+            # (reference: decr_every_n_nan_or_inf semantics)
+            bad_inc = layers.elementwise_mul(
+                layers.elementwise_add(bad_steps, one), not_finite)
+            decr_reached = layers.cast(
+                _ge(bad_inc, _const(self._decr_every)), "float32")
+            shrunk_overflow = layers.elementwise_add(
                 layers.elementwise_mul(
                     layers.elementwise_mul(scale_var,
                                            _const(self._decr_ratio)),
-                    layers.elementwise_sub(one, finite)))
-            layers.assign(shrunk, scale_var)
+                    decr_reached),
+                layers.elementwise_mul(
+                    scale_var, layers.elementwise_sub(one, decr_reached)))
+            new_scale = layers.elementwise_add(
+                layers.elementwise_mul(grown, finite),
+                layers.elementwise_mul(shrunk_overflow, not_finite))
+            layers.assign(new_scale, scale_var)
             keep = layers.elementwise_mul(
                 inc, layers.elementwise_sub(one, reached))
             layers.assign(keep, good_steps)
+            keep_bad = layers.elementwise_mul(
+                bad_inc, layers.elementwise_sub(one, decr_reached))
+            layers.assign(keep_bad, bad_steps)
 
         return opt_ops, params_grads
 

@@ -5,9 +5,10 @@ from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, fill_constant,
     fill_constant_batch_size_like, ones, shape, sums, zeros, zeros_like)
 from paddle_tpu.fluid.layers.nn import (  # noqa: F401
-    accuracy, auc, batch_norm, clip, conv2d, conv2d_transpose, cross_entropy,
-    dropout, embedding, expand, fc, gather, huber_loss, l2_normalize,
-    label_smooth, layer_norm, log, matmul, mean, mul, one_hot, pool2d,
+    accuracy, auc, batch_norm, chunk_eval, clip, conv2d, conv2d_transpose,
+    cos_sim, crf_decoding, cross_entropy, dropout, embedding, expand, fc,
+    gather, hsigmoid, huber_loss, l2_normalize, label_smooth, layer_norm,
+    linear_chain_crf, log, matmul, mean, mul, nce, one_hot, pool2d,
     reduce_max, reduce_mean, reduce_min, reduce_prod, reduce_sum, reshape,
     scale, scaled_dot_product_attention, sigmoid_cross_entropy_with_logits, slice, softmax,
     softmax_with_cross_entropy, split, square_error_cost, squeeze, stack,

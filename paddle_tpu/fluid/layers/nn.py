@@ -556,3 +556,128 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
                      attrs={"causal": causal, "scale": scale, "sp": sp,
                             "sp_impl": sp_impl})
     return out
+
+
+def cos_sim(X, Y, name=None):
+    """reference: nn.py cos_sim / operators/cos_sim_op.cc."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None):
+    """reference: nn.py nce / operators/nce_op.cc — NCE loss with a uniform
+    noise sampler. Returns the per-example Cost [B, 1]."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_total_classes],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels = helper.create_variable_for_type_inference("int32")
+    ins = {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b]}
+    if sample_weight is not None:
+        ins["SampleWeight"] = [sample_weight]
+    helper.append_op(
+        "nce", inputs=ins,
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """reference: nn.py hsigmoid / operators/hierarchical_sigmoid_op.cc —
+    complete-binary-tree hierarchical softmax cost [B, 1]."""
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, num_classes - 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "hierarchical_sigmoid",
+        inputs={"X": [input], "Label": [label], "W": [w], "Bias": [b]},
+        outputs={"Out": [out], "PreOut": [pre_out]},
+        attrs={"num_classes": num_classes})
+    return out
+
+
+def linear_chain_crf(input, label, seq_lens=None, param_attr=None, name=None):
+    """reference: nn.py linear_chain_crf / operators/linear_chain_crf_op.cc.
+    `input` is the padded emission [B, T, N] (+ seq_lens mask, the LoD
+    replacement). Returns the per-sequence negative log-likelihood [B, 1];
+    the learned Transition parameter is `<name>.w_0`-style and is what
+    crf_decoding consumes."""
+    helper = LayerHelper("linear_chain_crf", name=name)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(param_attr,
+                                         shape=[num_tags + 2, num_tags],
+                                         dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    em_exps = helper.create_variable_for_type_inference(input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if seq_lens is not None:
+        ins["SeqLens"] = [seq_lens]
+    helper.append_op(
+        "linear_chain_crf", inputs=ins,
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [em_exps], "TransitionExps": [tr_exps]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, seq_lens=None, name=None):
+    """reference: nn.py crf_decoding / operators/crf_decoding_op.cc.
+    `param_attr` must name the transition parameter created by
+    linear_chain_crf (pass its ParamAttr)."""
+    helper = LayerHelper("crf_decoding", name=name)
+    from paddle_tpu.fluid.param_attr import ParamAttr
+    attr = ParamAttr._to_attr(param_attr)
+    transition = helper.main_program.global_block().var(attr.name)
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if seq_lens is not None:
+        ins["SeqLens"] = [seq_lens]
+    helper.append_op("crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_lens=None,
+               excluded_chunk_types=None):
+    """reference: nn.py chunk_eval / operators/metrics/chunk_eval_op.cc.
+    Returns (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval")
+    p = helper.create_variable_for_type_inference("float32")
+    r = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    ni = helper.create_variable_for_type_inference("int64")
+    nl = helper.create_variable_for_type_inference("int64")
+    nc = helper.create_variable_for_type_inference("int64")
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_lens is not None:
+        ins["SeqLens"] = [seq_lens]
+    helper.append_op(
+        "chunk_eval", inputs=ins,
+        outputs={"Precision": [p], "Recall": [r], "F1-Score": [f1],
+                 "NumInferChunks": [ni], "NumLabelChunks": [nl],
+                 "NumCorrectChunks": [nc]},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return p, r, f1, ni, nl, nc

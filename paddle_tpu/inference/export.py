@@ -50,20 +50,20 @@ def export_stablehlo(dirname: str, feed_shapes: Dict[str, Tuple],
                      else "float32"))
         for n in feeds}
 
-    lowered = jax.jit(infer).lower(example)
-    text = lowered.as_text(dialect="stablehlo")
+    # single trace: jax.export both serializes and carries the StableHLO
+    # module text, so the model is lowered exactly once
+    jitted = jax.jit(infer)
     out_path = out_path or os.path.join(dirname, "model.stablehlo")
-    with open(out_path, "w") as f:
-        f.write(text)
-
-    # jax.export artifact: portable serialized StableHLO with calling
-    # convention, reloadable via jax.export.deserialize
     ser_path = out_path + ".bin"
     try:
         from jax import export as jax_export
-        exported = jax_export.export(jax.jit(infer))(example)
+        exported = jax_export.export(jitted)(example)
+        text = exported.mlir_module()
         with open(ser_path, "wb") as f:
             f.write(exported.serialize())
-    except Exception:   # serialization unsupported on this jax build
+    except Exception:   # jax.export unsupported on this jax build
         ser_path = None
+        text = jitted.lower(example).as_text(dialect="stablehlo")
+    with open(out_path, "w") as f:
+        f.write(text)
     return out_path, ser_path

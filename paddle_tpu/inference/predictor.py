@@ -58,7 +58,6 @@ class PaddlePredictor:
         self._config = config
         self._scope = fluid.Scope()
         self._exe = fluid.Executor(fluid.TPUPlace())
-        import paddle_tpu.fluid.framework as fw
         # load under a guard so startup-less restore does not pollute the
         # caller's default programs
         with fluid.program_guard(fluid.Program(), fluid.Program()):

@@ -1,0 +1,255 @@
+"""Specialised loss / scoring ops used by the classic book models:
+cosine similarity, sampled-softmax family (NCE, hierarchical sigmoid) and
+the linear-chain CRF pair (reference: operators/cos_sim_op.cc,
+operators/nce_op.cc, operators/hierarchical_sigmoid_op.cc,
+operators/linear_chain_crf_op.cc, operators/crf_decoding_op.cc).
+
+TPU-native redesign notes:
+- NCE's noise sampling uses the deterministic per-op step rng stream
+  (EmitContext.step_key) so the vjp recompute sees identical samples —
+  replacing the reference's stateful `Sampler` with a seed attr
+  (nce_op.h UniformSampler).
+- The CRF forward recursion runs in log space as one lax.scan over time
+  (padded [B, T, N] + SeqLens instead of LoD), so the backward pass is
+  jax.vjp over the scan rather than the hand-written alpha/beta kernel
+  (linear_chain_crf_op.h Backward).
+- hsigmoid's binary-tree code walk is a static python loop over the max
+  code length with per-row validity masks — XLA sees a fixed unrolled
+  gather/matmul chain (matrix_bit_code.h SimpleCode semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op
+
+
+@register_op("cos_sim", ref="operators/cos_sim_op.cc")
+def _cos_sim(ctx, ins, attrs):
+    """X [N, D], Y [N, D] or [1, D] (broadcast). Outputs Out [N, 1] plus the
+    norms the reference materialises for its backward kernel (kept for
+    output-slot parity; XLA just fuses them)."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-12)
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True) + 1e-12)
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("nce", ref="operators/nce_op.cc; nce_op.h UniformSampler")
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation with a uniform noise sampler.
+
+    inputs: Input [B, D], Label [B, num_true] int, Weight [C, D],
+    optional Bias [C], optional SampleWeight [B].
+    outputs: Cost [B, 1], SampleLogits/SampleLabels [B, num_true + S]
+    (slot parity with the reference).
+
+    cost(true)  = -log(o / (o + b)),  cost(noise) = -log(b / (o + b))
+    with o = exp(logit) and b = num_neg_samples / num_total_classes
+    (uniform sampler), exactly the reference's objective but computed with
+    log1p(exp(..)) for stability.
+    """
+    x = first(ins, "Input")
+    label = first(ins, "Label")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    sample_weight = first(ins, "SampleWeight")
+    num_classes = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    B = x.shape[0]
+    if label.ndim == 1:
+        label = label.reshape(B, 1)
+    num_true = label.shape[1]
+
+    seed = attrs.get("seed")
+    # fixed sampler seed (reference nce_op.cc `seed` attr) makes the noise
+    # draw reproducible across runs — required by numeric gradient checking
+    key = jax.random.key(int(seed)) if seed is not None else ctx.step_key()
+    noise = jax.random.randint(key, (B, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label, noise], axis=1)      # [B, num_true+S]
+    w_s = w[samples]                                       # [B, K, D]
+    logits = jnp.einsum("bd,bkd->bk", x, w_s)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    b_noise = float(num_neg) / float(num_classes)
+    # -log(o/(o+b)) = log1p(b*exp(-z)); -log(b/(o+b)) = log1p(exp(z)/b)
+    z = logits
+    true_cost = jnp.log1p(b_noise * jnp.exp(-z[:, :num_true]))
+    noise_cost = jnp.log1p(jnp.exp(z[:, num_true:]) / b_noise)
+    cost = jnp.sum(true_cost, axis=1) + jnp.sum(noise_cost, axis=1)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1)
+    return {"Cost": [cost.reshape(B, 1)],
+            "SampleLogits": [logits],
+            "SampleLabels": [samples]}
+
+
+@register_op("hierarchical_sigmoid",
+             ref="operators/hierarchical_sigmoid_op.cc; "
+                 "operators/math/matrix_bit_code.h SimpleCode")
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Complete-binary-tree hierarchical softmax.
+
+    inputs: X [B, D], Label [B] or [B,1] int, W [C-1, D], optional Bias
+    [1, C-1]; attr num_classes=C. output: Out [B, 1] (negative
+    log-likelihood along the leaf's root path), PreOut for slot parity.
+
+    Code walk per reference SimpleCode: c = label + C; for bit k
+    (0 = leaf-adjacent): node index = (c >> (k+1)) - 1, target bit =
+    (c >> k) & 1, path length = floor(log2(c)). The loop over the max code
+    length is static; shorter paths are masked.
+    """
+    x = first(ins, "X")
+    label = first(ins, "Label").reshape(-1)
+    w = first(ins, "W")
+    bias = first(ins, "Bias")
+    C = int(attrs["num_classes"])
+    max_len = max(1, (2 * C - 1).bit_length() - 1)
+
+    c = label.astype(jnp.int32) + C
+    # path length = index of the leading one bit of c, via integer shifts
+    # (float log2 rounds wrong near powers of two for large vocabularies)
+    length = sum(((c >> k) > 0).astype(jnp.int32)
+                 for k in range(1, max_len + 1))
+    loss = jnp.zeros(x.shape[0], dtype=x.dtype)
+    pre_out = []
+    for k in range(max_len):
+        idx = jnp.clip((c >> (k + 1)) - 1, 0, C - 2)       # [B]
+        bit = ((c >> k) & 1).astype(x.dtype)
+        z = jnp.einsum("bd,bd->b", x, w[idx])
+        if bias is not None:
+            z = z + bias.reshape(-1)[idx]
+        z = jnp.clip(z, -40.0, 40.0)
+        valid = (k < length).astype(x.dtype)
+        # sigmoid cross-entropy with target `bit`
+        loss = loss + valid * (jnp.logaddexp(0.0, z) - bit * z)
+        pre_out.append(z)
+    return {"Out": [loss.reshape(-1, 1)],
+            "PreOut": [jnp.stack(pre_out, axis=1)]}
+
+
+def _crf_unpack(transition):
+    """Transition [N+2, N]: row 0 start weights, row 1 end weights,
+    rows 2.. the tag->tag matrix (linear_chain_crf_op.cc OpMaker)."""
+    start = transition[0]
+    end = transition[1]
+    trans = transition[2:]
+    return start, end, trans
+
+
+@register_op("linear_chain_crf",
+             ref="operators/linear_chain_crf_op.cc (forward recursion "
+                 "linear_chain_crf_op.h ForwardOneSequence)")
+def _linear_chain_crf(ctx, ins, attrs):
+    """inputs: Emission [B, T, N] (padded; LoD in the reference),
+    Transition [N+2, N], Label [B, T] int, optional SeqLens [B].
+    output: LogLikelihood [B, 1] = negative log-likelihood (a cost, as the
+    layers API minimises its mean), Alpha for slot parity.
+
+    Forward algorithm in log space over one lax.scan; padding steps carry
+    alpha through unchanged so grads there are exactly zero.
+    """
+    emission = first(ins, "Emission")
+    transition = first(ins, "Transition")
+    label = first(ins, "Label")
+    seq_lens = first(ins, "SeqLens")
+    B, T, N = emission.shape
+    if label.ndim == 3:
+        label = label.reshape(B, T)
+    label = label.astype(jnp.int32)
+    start, end, trans = _crf_unpack(transition)
+    if seq_lens is None:
+        lens = jnp.full((B,), T, dtype=jnp.int32)
+    else:
+        lens = seq_lens.reshape(-1).astype(jnp.int32)
+
+    alpha0 = start[None, :] + emission[:, 0, :]            # [B, N]
+    em_seq = jnp.swapaxes(emission, 0, 1)                  # [T, B, N]
+
+    def fwd(carry, inp):
+        alpha, t = carry
+        em_t = inp
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None, :, :], axis=1) \
+            + em_t                                         # [B, N]
+        m = (t < lens)[:, None]
+        alpha = jnp.where(m, nxt, alpha)
+        return (alpha, t + 1), alpha
+
+    (alpha_last, _), alphas = lax.scan(
+        fwd, (alpha0, jnp.asarray(1, jnp.int32)), em_seq[1:])
+    log_z = jax.nn.logsumexp(alpha_last + end[None, :], axis=-1)   # [B]
+
+    # gold path score
+    t_idx = jnp.arange(T)
+    valid = (t_idx[None, :] < lens[:, None])               # [B, T]
+    em_score = jnp.sum(
+        jnp.take_along_axis(emission, label[:, :, None], axis=2)[..., 0]
+        * valid, axis=1)
+    prev_l, cur_l = label[:, :-1], label[:, 1:]
+    pair_valid = valid[:, 1:]
+    tr_score = jnp.sum(trans[prev_l, cur_l] * pair_valid, axis=1)
+    last_tag = jnp.take_along_axis(
+        label, jnp.maximum(lens - 1, 0)[:, None], axis=1)[:, 0]
+    path = start[label[:, 0]] + em_score + tr_score + end[last_tag]
+    nll = (log_z - path).reshape(B, 1)
+    alpha_full = jnp.concatenate(
+        [alpha0[:, None, :], jnp.swapaxes(alphas, 0, 1)], axis=1)
+    return {"LogLikelihood": [nll], "Alpha": [alpha_full],
+            "EmissionExps": [jnp.exp(emission - jnp.max(emission))],
+            "TransitionExps": [jnp.exp(transition)]}
+
+
+@register_op("crf_decoding", no_grad=True,
+             ref="operators/crf_decoding_op.cc Viterbi decode")
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode. inputs: Emission [B, T, N], Transition [N+2, N],
+    optional Label [B, T], optional SeqLens. output ViterbiPath [B, T]
+    int64 — the best tag path, or (with Label) the 0/1 per-position
+    correctness indicator exactly like the reference."""
+    emission = first(ins, "Emission")
+    transition = first(ins, "Transition")
+    label = first(ins, "Label")
+    seq_lens = first(ins, "SeqLens")
+    B, T, N = emission.shape
+    start, end, trans = _crf_unpack(transition)
+    if seq_lens is None:
+        lens = jnp.full((B,), T, dtype=jnp.int32)
+    else:
+        lens = seq_lens.reshape(-1).astype(jnp.int32)
+
+    alpha0 = start[None, :] + emission[:, 0, :]
+    em_seq = jnp.swapaxes(emission, 0, 1)
+
+    def fwd(carry, em_t):
+        alpha, t = carry
+        scores = alpha[:, :, None] + trans[None, :, :]     # [B, N, N]
+        bp = jnp.argmax(scores, axis=1)                    # [B, N]
+        nxt = jnp.max(scores, axis=1) + em_t
+        m = (t < lens)[:, None]
+        alpha = jnp.where(m, nxt, alpha)
+        bp = jnp.where(m, bp, jnp.broadcast_to(jnp.arange(N)[None, :], bp.shape))
+        return (alpha, t + 1), bp
+
+    (alpha_last, _), bps = lax.scan(
+        fwd, (alpha0, jnp.asarray(1, jnp.int32)), em_seq[1:])   # bps [T-1, B, N]
+    best_last = jnp.argmax(alpha_last + end[None, :], axis=-1)  # [B]
+
+    def back(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rest = lax.scan(back, best_last, bps, reverse=True)
+    path = jnp.concatenate([first_tag[None, :], tags_rest], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1)                        # [B, T]
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lens[:, None]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+    if label is not None:
+        lab = label.reshape(B, T).astype(jnp.int64)
+        path = (jnp.where(valid, (path == lab), False)).astype(jnp.int64)
+    return {"ViterbiPath": [path]}

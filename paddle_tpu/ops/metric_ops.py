@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import jax
+from jax import lax
 
 from paddle_tpu.core.registry import first, register_op
 
@@ -78,3 +79,103 @@ def _precision_recall(ctx, ins, attrs):
                         jnp.full_like(tp, float(idx.shape[0])) - pred_cnt - true_cnt + tp], axis=1)
     return {"BatchMetrics": [metrics], "AccumMetrics": [metrics],
             "AccumStatesInfo": [states]}
+
+
+def _chunk_flags(tags, num_chunk_types, scheme, excluded, lens):
+    """Per-position (is_chunk, start, end, type) flags for one padded [B, T]
+    tag matrix, following the reference's segment extraction
+    (chunk_eval_op.h GetSegments / ChunkEnd / ChunkBegin)."""
+    num_tags = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    B, T = tags.shape
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lens[:, None]
+    ctype = tags // num_tags
+    kind = tags % num_tags
+    in_chunk = (tags >= 0) & (tags < num_chunk_types * num_tags) & valid
+    for ex in excluded or ():
+        in_chunk = in_chunk & (ctype != ex)
+
+    prev_in = jnp.concatenate(
+        [jnp.zeros((B, 1), bool), in_chunk[:, :-1]], axis=1)
+    next_in = jnp.concatenate(
+        [in_chunk[:, 1:], jnp.zeros((B, 1), bool)], axis=1)
+    prev_type = jnp.concatenate([-jnp.ones((B, 1), ctype.dtype),
+                                 ctype[:, :-1]], axis=1)
+    next_type = jnp.concatenate([ctype[:, 1:],
+                                 -jnp.ones((B, 1), ctype.dtype)], axis=1)
+    prev_kind = jnp.concatenate([jnp.zeros((B, 1), kind.dtype),
+                                 kind[:, :-1]], axis=1)
+    next_kind = jnp.concatenate([kind[:, 1:],
+                                 jnp.zeros((B, 1), kind.dtype)], axis=1)
+    discont_prev = (~prev_in) | (prev_type != ctype)
+    discont_next = (~next_in) | (next_type != ctype)
+
+    if scheme == "plain":
+        start = in_chunk & discont_prev
+        endf = in_chunk & discont_next
+    elif scheme == "IOB":            # B=0, I=1 within each type
+        start = in_chunk & ((kind == 0) | discont_prev)
+        endf = in_chunk & (discont_next | (next_kind == 0))
+    elif scheme == "IOE":            # I=0, E=1: E closes the chunk
+        start = in_chunk & (discont_prev | (prev_kind == 1))
+        endf = in_chunk & ((kind == 1) | discont_next)
+    else:                            # IOBES: B=0, I=1, E=2, S=3
+        start = in_chunk & ((kind == 0) | (kind == 3) | discont_prev)
+        endf = in_chunk & ((kind == 2) | (kind == 3) | discont_next)
+    return start, endf, ctype
+
+
+def _end_positions(endf):
+    """For each position, the index of the first chunk end at or after it
+    (within the row). Reverse scan; positions after the last end get T."""
+    B, T = endf.shape
+
+    def back(carry, inp):
+        e_t, t = inp
+        nxt = jnp.where(e_t, t, carry)
+        return nxt, nxt
+
+    init = jnp.full((B,), T, dtype=jnp.int32)
+    ts = jnp.arange(T, dtype=jnp.int32)
+    _, ne = lax.scan(back, init, (endf.T, ts), reverse=True)
+    return ne.T                                            # [B, T]
+
+
+@register_op("chunk_eval", no_grad=True,
+             ref="operators/metrics/chunk_eval_op.cc (IOB/IOE/IOBES/plain)")
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 over tag sequences (NER-style).
+    inputs: Inference [B, T] int, Label [B, T] int, optional SeqLens [B].
+    Padded+SeqLens replaces the reference's LoD input. A predicted chunk
+    counts as correct iff (start, end, type) all match a label chunk."""
+    inf = first(ins, "Inference")
+    label = first(ins, "Label")
+    seq_lens = first(ins, "SeqLens")
+    B = inf.shape[0]
+    inf = inf.reshape(B, -1).astype(jnp.int32)
+    label = label.reshape(B, -1).astype(jnp.int32)
+    T = inf.shape[1]
+    lens = (jnp.full((B,), T, jnp.int32) if seq_lens is None
+            else seq_lens.reshape(-1).astype(jnp.int32))
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = attrs.get("excluded_chunk_types") or ()
+
+    s_i, e_i, t_i = _chunk_flags(inf, num_chunk_types, scheme, excluded, lens)
+    s_l, e_l, t_l = _chunk_flags(label, num_chunk_types, scheme, excluded, lens)
+    ne_i = _end_positions(e_i)
+    ne_l = _end_positions(e_l)
+    match = s_i & s_l & (t_i == t_l) & (ne_i == ne_l)
+    num_inf = jnp.sum(s_i)
+    num_lab = jnp.sum(s_l)
+    num_cor = jnp.sum(match)
+    p = num_cor / jnp.maximum(num_inf, 1)
+    r = num_cor / jnp.maximum(num_lab, 1)
+    f1 = jnp.where(num_cor > 0, 2.0 * p * r / (p + r), 0.0)
+    i64 = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+    return {"Precision": [p.astype(jnp.float32).reshape(1)],
+            "Recall": [r.astype(jnp.float32).reshape(1)],
+            "F1-Score": [f1.astype(jnp.float32).reshape(1)],
+            "NumInferChunks": [num_inf.astype(i64).reshape(1)],
+            "NumLabelChunks": [num_lab.astype(i64).reshape(1)],
+            "NumCorrectChunks": [num_cor.astype(i64).reshape(1)]}

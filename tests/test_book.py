@@ -1,0 +1,284 @@
+"""Book-model tests part 2: word2vec, recommender_system,
+label_semantic_roles (CRF), rnn_encoder_decoder, plus grad checks for the
+new loss ops (reference: python/paddle/fluid/tests/book/test_word2vec.py,
+test_recommender_system.py, test_label_semantic_roles.py,
+test_rnn_encoder_decoder.py and unittests/test_cos_sim_op.py,
+test_linear_chain_crf_op.py, test_hsigmoid_op.py, test_nce.py,
+test_chunk_eval_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from op_test import check_grad, run_single_op
+
+
+# -- op-level checks ---------------------------------------------------------
+
+def test_cos_sim_grad():
+    rng = np.random.RandomState(0)
+    check_grad("cos_sim",
+               {"X": {"x": rng.rand(4, 6).astype(np.float32) + 0.1},
+                "Y": {"y": rng.rand(4, 6).astype(np.float32) + 0.1}},
+               extra_out_slots=("XNorm", "YNorm"),
+               delta=5e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_linear_chain_crf_grad():
+    rng = np.random.RandomState(1)
+    B, T, N = 2, 4, 3
+    em = rng.randn(B, T, N).astype(np.float32) * 0.5
+    tr = rng.randn(N + 2, N).astype(np.float32) * 0.5
+    lab = rng.randint(0, N, (B, T)).astype(np.int32)
+    lens = np.array([4, 3], np.int32)
+    check_grad("linear_chain_crf",
+               {"Emission": {"em": em}, "Transition": {"tr": tr},
+                "Label": {"lab": lab}, "SeqLens": {"lens": lens}},
+               out_slot="LogLikelihood",
+               extra_out_slots=("Alpha", "EmissionExps", "TransitionExps"),
+               grad_vars=["em", "tr"], delta=5e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    """NLL against an exhaustive path enumeration."""
+    rng = np.random.RandomState(2)
+    B, T, N = 1, 3, 2
+    em = rng.randn(B, T, N).astype(np.float32)
+    tr = rng.randn(N + 2, N).astype(np.float32)
+    lab = np.array([[1, 0, 1]], np.int32)
+    out = run_single_op(
+        "linear_chain_crf",
+        {"Emission": {"em": em}, "Transition": {"tr": tr},
+         "Label": {"lab": lab}},
+        out_slots=("LogLikelihood", "Alpha", "EmissionExps",
+                   "TransitionExps"))
+    nll = float(np.asarray(out["__out_LogLikelihood_0"]).reshape(()))
+    start, end, trans = tr[0], tr[1], tr[2:]
+
+    def score(path):
+        s = start[path[0]] + em[0, 0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + em[0, t, path[t]]
+        return s + end[path[-1]]
+
+    import itertools
+    scores = [score(p) for p in itertools.product(range(N), repeat=T)]
+    log_z = np.log(np.sum(np.exp(scores)))
+    expect = log_z - score(lab[0])
+    np.testing.assert_allclose(nll, expect, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(3)
+    B, T, N = 2, 4, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    tr = rng.randn(N + 2, N).astype(np.float32)
+    out = run_single_op(
+        "crf_decoding",
+        {"Emission": {"em": em}, "Transition": {"tr": tr}},
+        out_slots=("ViterbiPath",))
+    path = np.asarray(out["__out_ViterbiPath_0"]).reshape(B, T)
+    start, end, trans = tr[0], tr[1], tr[2:]
+    import itertools
+    for b in range(B):
+        best, best_s = None, -1e30
+        for p in itertools.product(range(N), repeat=T):
+            s = start[p[0]] + em[b, 0, p[0]]
+            for t in range(1, T):
+                s += trans[p[t - 1], p[t]] + em[b, t, p[t]]
+            s += end[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(path[b], np.array(best))
+
+
+def test_hsigmoid_grad():
+    rng = np.random.RandomState(4)
+    B, D, C = 3, 5, 6
+    check_grad("hierarchical_sigmoid",
+               {"X": {"x": rng.randn(B, D).astype(np.float32)},
+                "Label": {"lab": rng.randint(0, C, (B,)).astype(np.int32)},
+                "W": {"w": rng.randn(C - 1, D).astype(np.float32) * 0.5},
+                "Bias": {"b": rng.randn(1, C - 1).astype(np.float32) * 0.5}},
+               attrs={"num_classes": C}, extra_out_slots=("PreOut",),
+               grad_vars=["x", "w", "b"], delta=5e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_nce_grad():
+    rng = np.random.RandomState(5)
+    B, D, C = 4, 6, 20
+    check_grad("nce",
+               {"Input": {"x": rng.randn(B, D).astype(np.float32) * 0.3},
+                "Label": {"lab": rng.randint(0, C, (B, 1)).astype(np.int32)},
+                "Weight": {"w": rng.randn(C, D).astype(np.float32) * 0.3},
+                "Bias": {"b": rng.randn(C).astype(np.float32) * 0.3}},
+               attrs={"num_total_classes": C, "num_neg_samples": 5,
+                      "seed": 99},
+               out_slot="Cost",
+               extra_out_slots=("SampleLogits", "SampleLabels"),
+               grad_vars=["x", "w", "b"], delta=5e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_chunk_eval_iob():
+    """IOB with 2 chunk types: B-0=0, I-0=1, B-1=2, I-1=3, O=4."""
+    lab = np.array([[0, 1, 4, 2, 3, 4]], np.int32)      # chunks: (0-1,t0) (3-4,t1)
+    inf = np.array([[0, 1, 4, 2, 4, 4]], np.int32)      # chunks: (0-1,t0) (3-3,t1)
+    out = run_single_op(
+        "chunk_eval", {"Inference": {"inf": inf}, "Label": {"lab": lab}},
+        attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"))
+    assert int(out["__out_NumInferChunks_0"][0]) == 2
+    assert int(out["__out_NumLabelChunks_0"][0]) == 2
+    assert int(out["__out_NumCorrectChunks_0"][0]) == 1
+    np.testing.assert_allclose(float(out["__out_Precision_0"][0]), 0.5)
+
+
+# -- book models -------------------------------------------------------------
+
+def test_word2vec():
+    """N-gram LM: 4 context embeddings -> fc -> softmax CE
+    (reference: book/test_word2vec.py)."""
+    VOCAB, EMB, B = 50, 16, 32
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    with fluid.program_guard(main, startup):
+        words = [layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(4)]
+        target = layers.data(name="tgt", shape=[1], dtype="int64")
+        embs = [layers.embedding(w, size=[VOCAB, EMB],
+                                 param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, size=64, act="relu")
+        pred = layers.fc(hidden, size=VOCAB, act="softmax")
+        cost = layers.cross_entropy(input=pred, label=target)
+        avg = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # deterministic "corpus": next word = (sum of context) % VOCAB
+    losses = []
+    for _ in range(40):
+        ctx = rng.randint(0, VOCAB, (B, 4)).astype(np.int64)
+        tgt = (ctx.sum(axis=1) % VOCAB).reshape(B, 1)
+        feed = {f"w{i}": ctx[:, i:i + 1] for i in range(4)}
+        feed["tgt"] = tgt
+        (l,) = exe.run(main, feed=feed, fetch_list=[avg])
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_recommender_system():
+    """Embedding towers -> cos_sim -> square error
+    (reference: book/test_recommender_system.py)."""
+    N_USR, N_MOV, EMB, B = 30, 40, 16, 24
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    with fluid.program_guard(main, startup):
+        uid = layers.data(name="uid", shape=[1], dtype="int64")
+        mid = layers.data(name="mid", shape=[1], dtype="int64")
+        score = layers.data(name="score", shape=[1], dtype="float32")
+        uemb = layers.embedding(uid, size=[N_USR, EMB])
+        memb = layers.embedding(mid, size=[N_MOV, EMB])
+        uvec = layers.fc(uemb, size=32, act="relu")
+        mvec = layers.fc(memb, size=32, act="relu")
+        sim = layers.cos_sim(uvec, mvec)
+        pred = layers.scale(sim, scale=2.5, bias=2.5)
+        cost = layers.square_error_cost(input=pred, label=score)
+        avg = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(50):
+        u = rng.randint(0, N_USR, (B, 1)).astype(np.int64)
+        m = rng.randint(0, N_MOV, (B, 1)).astype(np.int64)
+        s = ((u * 7 + m * 3) % 5 + 1).astype(np.float32)
+        (l,) = exe.run(main, feed={"uid": u, "mid": m, "score": s},
+                       fetch_list=[avg])
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_label_semantic_roles_crf():
+    """Embedding -> BiLSTM -> emission -> linear_chain_crf cost; decode with
+    crf_decoding and evaluate with chunk_eval
+    (reference: book/test_label_semantic_roles.py)."""
+    VOCAB, EMB, H, N_TAGS, B, T = 40, 16, 16, 5, 8, 10
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 17
+    with fluid.program_guard(main, startup):
+        word = layers.data(name="word", shape=[T], dtype="int64")
+        lens = layers.data(name="lens", shape=[], dtype="int32")
+        target = layers.data(name="target", shape=[T], dtype="int64")
+        emb = layers.embedding(word, size=[VOCAB, EMB])
+        proj = layers.fc(emb, size=4 * H, num_flatten_dims=2)
+        hidden, _ = layers.dynamic_lstm(proj, size=4 * H, seq_lens=lens,
+                                        use_peepholes=False)
+        emission = layers.fc(hidden, size=N_TAGS, num_flatten_dims=2)
+        crf_cost = layers.linear_chain_crf(
+            emission, target, seq_lens=lens,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        avg = layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=1e-2).minimize(avg)
+        decoded = layers.crf_decoding(emission, fluid.ParamAttr(name="crfw"),
+                                      seq_lens=lens)
+        p, r, f1, ni, nl, nc = layers.chunk_eval(
+            decoded, target, chunk_scheme="IOB", num_chunk_types=2,
+            seq_lens=lens)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(30):
+        w = rng.randint(0, VOCAB, (B, T)).astype(np.int64)
+        ln = rng.randint(5, T + 1, (B,)).astype(np.int32)
+        tgt = (w % N_TAGS).astype(np.int64)
+        (l, dec, f1v) = exe.run(
+            main, feed={"word": w, "lens": ln, "target": tgt},
+            fetch_list=[avg, decoded, f1])
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    assert np.asarray(dec).shape == (B, T)
+    assert 0.0 <= float(np.asarray(f1v).reshape(())) <= 1.0
+
+
+def test_rnn_encoder_decoder():
+    """GRU encoder -> decoder init state -> GRU decoder with teacher forcing
+    (reference: book/test_rnn_encoder_decoder.py)."""
+    VOCAB, EMB, H, B, T = 30, 16, 16, 8, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 19
+    with fluid.program_guard(main, startup):
+        src = layers.data(name="src", shape=[T], dtype="int64")
+        tgt_in = layers.data(name="tgt_in", shape=[T], dtype="int64")
+        tgt_out = layers.data(name="tgt_out", shape=[T], dtype="int64")
+        src_emb = layers.embedding(src, size=[VOCAB, EMB])
+        enc_proj = layers.fc(src_emb, size=3 * H, num_flatten_dims=2)
+        enc = layers.dynamic_gru(enc_proj, size=H)
+        enc_last = layers.slice(enc, axes=[1], starts=[T - 1], ends=[T])
+        dec_h0 = layers.fc(layers.squeeze(enc_last, axes=[1]), size=H,
+                           act="tanh")
+        tgt_emb = layers.embedding(tgt_in, size=[VOCAB, EMB])
+        dec_proj = layers.fc(tgt_emb, size=3 * H, num_flatten_dims=2)
+        dec = layers.dynamic_gru(dec_proj, size=H, h_0=dec_h0)
+        logits = layers.fc(dec, size=VOCAB, num_flatten_dims=2)
+        logits2d = layers.reshape(logits, shape=[-1, VOCAB])
+        label2d = layers.reshape(tgt_out, shape=[-1, 1])
+        loss = layers.softmax_with_cross_entropy(logits2d, label2d)
+        avg = layers.mean(loss)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(60):
+        s = rng.randint(1, VOCAB, (B, T)).astype(np.int64)
+        t = (s + 1) % VOCAB          # "translation": shift each token id
+        (l,) = exe.run(main, feed={"src": s, "tgt_in": s, "tgt_out": t},
+                       fetch_list=[avg])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, losses

@@ -1,0 +1,99 @@
+"""contrib coverage: BF16 inference transpiler + mixed-precision decorate
+(reference: contrib/float16/float16_transpiler.py and the later
+fluid.contrib.mixed_precision.decorate capability)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_bf16_transpiler_fetch_consumed_downstream():
+    """The fetched var is ALSO consumed by a later op — the rewrite must
+    keep that consumer reading the produced value."""
+    from paddle_tpu.contrib.float16 import BF16Transpiler
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        hidden = layers.fc(x, size=8, act="relu")
+        out = layers.fc(hidden, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+    ref_h, ref_o = exe.run(main, feed={"x": xv},
+                           fetch_list=[hidden, out])
+
+    BF16Transpiler().transpile(main, scope=fluid.global_scope(),
+                               feed_names=["x"],
+                               fetch_names=[hidden.name, out.name])
+    h2, o2 = exe.run(main, feed={"x": xv}, fetch_list=[hidden, out])
+    assert np.asarray(h2).dtype == np.float32
+    assert np.asarray(o2).dtype == np.float32
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(ref_o),
+                               rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(h2), np.asarray(ref_h),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_amp_decorate_trains():
+    from paddle_tpu.contrib import mixed_precision
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[10], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = mixed_precision.decorate(
+            fluid.optimizer.SGD(learning_rate=0.05),
+            init_loss_scaling=2.0 ** 8, use_dynamic_loss_scaling=True,
+            incr_every_n_steps=5, decr_every_n_nan_or_inf=2)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w = rng.rand(10, 1).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        xv = rng.rand(16, 10).astype(np.float32)
+        yv = xv @ w
+        (l,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, losses
+    scale = np.asarray(fluid.global_scope().find_var("loss_scaling@AMP"))
+    assert float(scale.reshape(())) >= 2.0 ** 8  # grew or held, never shrank
+
+
+def test_amp_decr_every_n_nan_or_inf():
+    """A single overflow step must NOT shrink the scale when
+    decr_every_n_nan_or_inf=2; two consecutive overflows must."""
+    from paddle_tpu.contrib import mixed_precision
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = mixed_precision.decorate(
+            fluid.optimizer.SGD(learning_rate=0.0),
+            init_loss_scaling=1024.0, use_dynamic_loss_scaling=True,
+            incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+            decr_ratio=0.5)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def run(xv):
+        exe.run(main, feed={"x": xv, "y": np.zeros((2, 1), np.float32)},
+                fetch_list=[loss])
+        return float(np.asarray(
+            fluid.global_scope().find_var("loss_scaling@AMP")).reshape(()))
+
+    finite = np.ones((2, 4), np.float32)
+    overflow = np.full((2, 4), np.inf, np.float32)
+    assert run(finite) == 1024.0
+    assert run(overflow) == 1024.0        # first bad step: hold
+    assert run(overflow) == 512.0         # second consecutive: shrink
+    assert run(overflow) == 512.0         # counter reset after shrink
+    assert run(overflow) == 256.0
