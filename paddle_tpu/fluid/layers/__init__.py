@@ -5,7 +5,8 @@ from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, fill_constant,
     fill_constant_batch_size_like, ones, shape, sums, zeros, zeros_like)
 from paddle_tpu.fluid.layers.nn import (  # noqa: F401
-    accuracy, auc, batch_norm, chunk_eval, clip, conv2d, conv2d_transpose,
+    accuracy, auc, batch_norm, beam_search, beam_search_decode, chunk_eval,
+    clip, conv2d, conv2d_transpose,
     cos_sim, crf_decoding, cross_entropy, dropout, embedding, expand, fc,
     gather, hsigmoid, huber_loss, l2_normalize, label_smooth, layer_norm,
     linear_chain_crf, log, matmul, mean, mul, nce, one_hot, pool2d,

@@ -577,12 +577,14 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     dim = input.shape[1]
     w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
                                 dtype=input.dtype)
-    b = helper.create_parameter(bias_attr, shape=[num_total_classes],
-                                dtype=input.dtype, is_bias=True)
     cost = helper.create_variable_for_type_inference(input.dtype)
     sample_logits = helper.create_variable_for_type_inference(input.dtype)
     sample_labels = helper.create_variable_for_type_inference("int32")
-    ins = {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b]}
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_total_classes],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b]
     if sample_weight is not None:
         ins["SampleWeight"] = [sample_weight]
     helper.append_op(
@@ -602,13 +604,15 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     dim = input.shape[1]
     w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
                                 dtype=input.dtype)
-    b = helper.create_parameter(bias_attr, shape=[1, num_classes - 1],
-                                dtype=input.dtype, is_bias=True)
+    ins = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = [b]
     out = helper.create_variable_for_type_inference(input.dtype)
     pre_out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
-        "hierarchical_sigmoid",
-        inputs={"X": [input], "Label": [label], "W": [w], "Bias": [b]},
+        "hierarchical_sigmoid", inputs=ins,
         outputs={"Out": [out], "PreOut": [pre_out]},
         attrs={"num_classes": num_classes})
     return out
@@ -681,3 +685,38 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_lens=None,
                "chunk_scheme": chunk_scheme,
                "excluded_chunk_types": list(excluded_chunk_types or [])})
     return p, r, f1, ni, nl, nc
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, name=None):
+    """reference: nn.py beam_search / operators/beam_search_op.cc. Dense
+    [B, W] lane layout (see ops/beam_ops.py for the LoD divergence).
+    Returns (selected_ids, selected_scores, parent_idx)."""
+    helper = LayerHelper("beam_search", name=name)
+    ids = helper.create_variable_for_type_inference("int32")
+    sc = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "beam_search",
+        inputs={"PreIds": [pre_ids], "PreScores": [pre_scores],
+                "Scores": [scores]},
+        outputs={"SelectedIds": [ids], "SelectedScores": [sc],
+                 "ParentIdx": [parent]},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return ids, sc, parent
+
+
+def beam_search_decode(ids, parent_idx, scores, end_id=0, name=None):
+    """reference: nn.py beam_search_decode /
+    operators/beam_search_decode_op.cc. `ids`/`parent_idx` are the stacked
+    per-step selections [T, B, W]. Returns (sentence_ids [B, W, T],
+    sentence_scores [B, W])."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference("int32")
+    ssc = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        "beam_search_decode",
+        inputs={"Ids": [ids], "ParentIdx": [parent_idx],
+                "Scores": [scores]},
+        outputs={"SentenceIds": [sent], "SentenceScores": [ssc]},
+        attrs={"end_id": end_id})
+    return sent, ssc

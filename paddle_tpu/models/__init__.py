@@ -7,8 +7,9 @@ the fluid-compatible API, so the same graphs run single-chip or sharded over
 a mesh.
 """
 
-from paddle_tpu.models import (alexnet, deepfm, mnist, resnet, se_resnext,
-                               stacked_dynamic_lstm, transformer, vgg)
+from paddle_tpu.models import (alexnet, deepfm, machine_translation, mnist,
+                               resnet, se_resnext, stacked_dynamic_lstm,
+                               transformer, vgg)
 
-__all__ = ["alexnet", "deepfm", "mnist", "resnet", "se_resnext",
-           "stacked_dynamic_lstm", "transformer", "vgg"]
+__all__ = ["alexnet", "deepfm", "machine_translation", "mnist", "resnet",
+           "se_resnext", "stacked_dynamic_lstm", "transformer", "vgg"]

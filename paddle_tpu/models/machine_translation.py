@@ -1,0 +1,119 @@
+"""Attention seq2seq machine-translation model with beam-search inference
+(reference: benchmark/fluid/models/machine_translation.py and
+tests/book/test_machine_translation.py — GRU encoder-decoder with
+attention; decode via While loop + beam_search ops; legacy capability:
+RecurrentGradientMachine beam generation).
+
+TPU-native design: training runs the decoder GRU over the whole target in
+one lax.scan (dynamic_gru) and applies Luong-style attention to all
+decoder states at once — two batched MXU matmuls instead of a per-step
+loop. Inference uses the fused `attention_gru_beam_decode` op: the entire
+beam loop compiles to one XLA while/scan, keeping [B*W, .] matmuls on the
+MXU with no per-step host dispatch.
+
+Both programs share parameter names (the two-program convention), so the
+infer program reads the trained weights straight from the scope.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def _p(name):
+    return fluid.ParamAttr(name=name)
+
+
+def _encoder(src, src_vocab, emb_dim, hid_dim):
+    emb = layers.embedding(src, size=[src_vocab, emb_dim],
+                           param_attr=_p("mt.src_emb"))
+    proj = layers.fc(emb, size=3 * hid_dim, num_flatten_dims=2,
+                     param_attr=_p("mt.enc_proj.w"),
+                     bias_attr=_p("mt.enc_proj.b"))
+    enc = layers.dynamic_gru(proj, size=hid_dim,
+                             param_attr=_p("mt.enc_gru.w"),
+                             bias_attr=_p("mt.enc_gru.b"))
+    return enc
+
+
+def _dec_h0(enc, max_len, hid_dim):
+    enc_last = layers.squeeze(
+        layers.slice(enc, axes=[1], starts=[max_len - 1], ends=[max_len]),
+        axes=[1])
+    return layers.fc(enc_last, size=hid_dim, act="tanh",
+                     param_attr=_p("mt.h0.w"), bias_attr=_p("mt.h0.b"))
+
+
+def build(is_train=True, src_vocab=30, tgt_vocab=30, max_len=8,
+          emb_dim=32, hid_dim=32, beam_size=4, start_id=1, end_id=0,
+          lr=1e-3):
+    """Returns (loss, fetches, feed_specs) for training, or
+    (sentence_ids, sentence_scores, feed_specs) for inference."""
+    src = layers.data(name="src", shape=[max_len], dtype="int64")
+    enc = _encoder(src, src_vocab, emb_dim, hid_dim)
+    dec_h0 = _dec_h0(enc, max_len, hid_dim)
+
+    if is_train:
+        tgt_in = layers.data(name="tgt_in", shape=[max_len], dtype="int64")
+        tgt_out = layers.data(name="tgt_out", shape=[max_len], dtype="int64")
+        temb = layers.embedding(tgt_in, size=[tgt_vocab, emb_dim],
+                                param_attr=_p("mt.tgt_emb"))
+        dproj = layers.fc(temb, size=3 * hid_dim, num_flatten_dims=2,
+                          param_attr=_p("mt.dec_proj.w"), bias_attr=False)
+        dec = layers.dynamic_gru(dproj, size=hid_dim, h_0=dec_h0,
+                                 param_attr=_p("mt.dec_gru.w"),
+                                 bias_attr=_p("mt.dec_gru.b"))
+        # Luong attention over all decoder states at once
+        scores = layers.matmul(dec, layers.transpose(enc, perm=[0, 2, 1]))
+        probs = layers.softmax(layers.scale(scores, scale=hid_dim ** -0.5))
+        ctx = layers.matmul(probs, enc)
+        combined = layers.fc(layers.concat([dec, ctx], axis=2),
+                             size=hid_dim, num_flatten_dims=2, act="tanh",
+                             param_attr=_p("mt.attn.w"), bias_attr=False)
+        logits = layers.fc(combined, size=tgt_vocab, num_flatten_dims=2,
+                           param_attr=_p("mt.out.w"),
+                           bias_attr=_p("mt.out.b"))
+        loss = layers.softmax_with_cross_entropy(
+            layers.reshape(logits, shape=[-1, tgt_vocab]),
+            layers.reshape(tgt_out, shape=[-1, 1]))
+        avg = layers.mean(loss)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg)
+        feed_specs = {"src": ([-1, max_len], "int64"),
+                      "tgt_in": ([-1, max_len], "int64"),
+                      "tgt_out": ([-1, max_len], "int64")}
+        return avg, [avg], feed_specs
+
+    # inference: declare the decoder parameters under their training names
+    # and hand them to the fused whole-loop beam decoder
+    helper = LayerHelper("mt_decode")
+    temb = helper.create_parameter(_p("mt.tgt_emb"),
+                                   shape=[tgt_vocab, emb_dim])
+    proj_w = helper.create_parameter(_p("mt.dec_proj.w"),
+                                     shape=[emb_dim, 3 * hid_dim])
+    gru_w = helper.create_parameter(_p("mt.dec_gru.w"),
+                                    shape=[hid_dim, 3 * hid_dim])
+    gru_b = helper.create_parameter(_p("mt.dec_gru.b"),
+                                    shape=[1, 3 * hid_dim], is_bias=True)
+    attn_w = helper.create_parameter(_p("mt.attn.w"),
+                                     shape=[2 * hid_dim, hid_dim])
+    out_w = helper.create_parameter(_p("mt.out.w"),
+                                    shape=[hid_dim, tgt_vocab])
+    out_b = helper.create_parameter(_p("mt.out.b"), shape=[tgt_vocab],
+                                    is_bias=True)
+    # dec_proj has no bias in training; the fused op wants a ProjB slot
+    zero_b = layers.fill_constant([3 * hid_dim], "float32", 0.0)
+    sent = helper.create_variable_for_type_inference("int32")
+    ssc = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "attention_gru_beam_decode",
+        inputs={"EncOut": [enc], "H0": [dec_h0], "Emb": [temb],
+                "ProjW": [proj_w], "ProjB": [zero_b],
+                "GruW": [gru_w], "GruB": [gru_b], "AttnW": [attn_w],
+                "OutW": [out_w], "OutB": [out_b]},
+        outputs={"SentenceIds": [sent], "SentenceScores": [ssc]},
+        attrs={"beam_size": beam_size, "max_len": max_len,
+               "start_id": start_id, "end_id": end_id})
+    feed_specs = {"src": ([-1, max_len], "int64")}
+    return sent, ssc, feed_specs

@@ -12,3 +12,4 @@ from paddle_tpu.ops import control_flow  # noqa: F401
 from paddle_tpu.ops import rnn_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
+from paddle_tpu.ops import beam_ops  # noqa: F401

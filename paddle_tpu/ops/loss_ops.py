@@ -77,10 +77,12 @@ def _nce(ctx, ins, attrs):
     if bias is not None:
         logits = logits + bias.reshape(-1)[samples]
     b_noise = float(num_neg) / float(num_classes)
-    # -log(o/(o+b)) = log1p(b*exp(-z)); -log(b/(o+b)) = log1p(exp(z)/b)
+    # -log(o/(o+b)) = logaddexp(0, log b - z); -log(b/(o+b)) =
+    # logaddexp(0, z - log b) — overflow-safe for |z| >> 88
     z = logits
-    true_cost = jnp.log1p(b_noise * jnp.exp(-z[:, :num_true]))
-    noise_cost = jnp.log1p(jnp.exp(z[:, num_true:]) / b_noise)
+    log_b = jnp.log(b_noise)
+    true_cost = jnp.logaddexp(0.0, log_b - z[:, :num_true])
+    noise_cost = jnp.logaddexp(0.0, z[:, num_true:] - log_b)
     cost = jnp.sum(true_cost, axis=1) + jnp.sum(noise_cost, axis=1)
     if sample_weight is not None:
         cost = cost * sample_weight.reshape(-1)

@@ -1,0 +1,117 @@
+"""Beam-search tests: step op vs brute force, backtrack decode, and the
+machine-translation model train -> fused beam decode round trip
+(reference: unittests/test_beam_search_op.py,
+test_beam_search_decode_op.py, book/test_machine_translation.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from op_test import run_single_op
+
+
+def test_beam_search_step_bruteforce():
+    rng = np.random.RandomState(0)
+    B, W, V = 2, 3, 7
+    end_id = 0
+    pre_ids = rng.randint(1, V, (B, W)).astype(np.int32)
+    pre_ids[1, 2] = end_id                   # one finished lane
+    pre_scores = rng.randn(B, W).astype(np.float32)
+    scores = np.log(rng.dirichlet(np.ones(V), (B, W))).astype(np.float32)
+    out = run_single_op(
+        "beam_search",
+        {"PreIds": {"pi": pre_ids}, "PreScores": {"ps": pre_scores},
+         "Scores": {"s": scores}},
+        attrs={"beam_size": W, "end_id": end_id},
+        out_slots=("SelectedIds", "SelectedScores", "ParentIdx"))
+    ids = np.asarray(out["__out_SelectedIds_0"])
+    sc = np.asarray(out["__out_SelectedScores_0"])
+    par = np.asarray(out["__out_ParentIdx_0"])
+    for b in range(B):
+        cands = []                           # (score, parent, token)
+        for w in range(W):
+            if pre_ids[b, w] == end_id:
+                cands.append((pre_scores[b, w], w, end_id))
+            else:
+                for v in range(V):
+                    cands.append((pre_scores[b, w] + scores[b, w, v], w, v))
+        cands.sort(key=lambda c: -c[0])
+        for k in range(W):
+            np.testing.assert_allclose(sc[b, k], cands[k][0], rtol=1e-5)
+            assert par[b, k] == cands[k][1]
+            assert ids[b, k] == cands[k][2]
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, B=1, W=2: lane history chosen by hand
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int32)      # [3,1,2]
+    par = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32)
+    scores = np.array([[1.0, 0.5]], np.float32)
+    out = run_single_op(
+        "beam_search_decode",
+        {"Ids": {"i": ids}, "ParentIdx": {"p": par},
+         "Scores": {"s": scores}},
+        attrs={"end_id": 0},
+        out_slots=("SentenceIds", "SentenceScores"))
+    sent = np.asarray(out["__out_SentenceIds_0"])                  # [1,2,3]
+    # lane 0 at t=2: tok 9, parent 0 -> t=1 lane 0: tok 7, parent 1 ->
+    # t=0 lane 1: tok 6
+    np.testing.assert_array_equal(sent[0, 0], [6, 7, 9])
+    # lane 1 at t=2: tok 10, parent 1 -> t=1 lane 1: tok 8, parent 0 ->
+    # t=0 lane 0: tok 5
+    np.testing.assert_array_equal(sent[0, 1], [5, 8, 10])
+
+
+def test_machine_translation_train_and_beam_decode():
+    from paddle_tpu import models
+    V, T, B, E, H = 24, 6, 16, 24, 24
+    train_main, train_startup = fluid.Program(), fluid.Program()
+    train_main.random_seed = 23
+    with fluid.program_guard(train_main, train_startup):
+        avg, _, _ = models.machine_translation.build(
+            is_train=True, src_vocab=V, tgt_vocab=V, max_len=T,
+            emb_dim=E, hid_dim=H, lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(train_startup)
+
+    rng = np.random.RandomState(0)
+
+    def batch():
+        src = rng.randint(2, V, (B, T)).astype(np.int64)
+        # deterministic chain: gold[k] = (tgt_in[k] * 2 + 1) % V, with
+        # tgt_in[0] = start_id=1 -> the decoder alone can learn it
+        tgt_in = np.zeros((B, T), np.int64)
+        tgt_out = np.zeros((B, T), np.int64)
+        tgt_in[:, 0] = 1
+        for k in range(T):
+            tgt_out[:, k] = (tgt_in[:, k] * 2 + 1) % V
+            if k + 1 < T:
+                tgt_in[:, k + 1] = tgt_out[:, k]
+        return src, tgt_in, tgt_out
+
+    losses = []
+    for _ in range(120):
+        src, tgt_in, tgt_out = batch()
+        (l,) = exe.run(train_main,
+                       feed={"src": src, "tgt_in": tgt_in,
+                             "tgt_out": tgt_out},
+                       fetch_list=[avg])
+        losses.append(float(l))
+    assert losses[-1] < 0.5, losses[-10:]
+
+    infer_main, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_main, infer_startup):
+        sent, ssc, _ = models.machine_translation.build(
+            is_train=False, src_vocab=V, tgt_vocab=V, max_len=T,
+            emb_dim=E, hid_dim=H, beam_size=4, start_id=1, end_id=0)
+    src, _, tgt_out = batch()
+    ids, scores = exe.run(infer_main, feed={"src": src},
+                          fetch_list=[sent, ssc])
+    ids = np.asarray(ids)
+    scores = np.asarray(scores)
+    assert ids.shape == (B, 4, T)
+    # lane scores sorted descending
+    assert np.all(np.diff(scores, axis=1) <= 1e-5)
+    # top beam reproduces the learned deterministic chain
+    acc = float((ids[:, 0, :] == tgt_out).mean())
+    assert acc > 0.8, (acc, ids[0, 0], tgt_out[0])
