@@ -5,6 +5,7 @@ from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, fill_constant,
     fill_constant_batch_size_like, ones, shape, sums, zeros, zeros_like)
 from paddle_tpu.fluid.layers.nn import (  # noqa: F401
+    argsort, multiplex, log_loss, rank_loss, margin_rank_loss, bpr_loss, crop, pad2d, pad_constant_like, random_crop, add_position_encoding, similarity_focus, bilinear_tensor_product, row_conv, unstack, sampling_id,
     accuracy, auc, batch_norm, beam_search, beam_search_decode, chunk_eval,
     clip, conv2d, conv2d_transpose,
     cos_sim, crf_decoding, cross_entropy, dropout, embedding, expand, fc,
@@ -32,4 +33,6 @@ from paddle_tpu.fluid.layers.ops import (  # noqa: F401
     greater_than, hard_sigmoid, leaky_relu, less_equal, less_than,
     logsigmoid, not_equal, pow, reciprocal, relu, relu6, round, rsqrt,
     sigmoid, sin, softplus, softsign, sqrt, square, swish, tanh,
-    tanh_shrink)
+    tanh_shrink, selu, hard_shrink, soft_shrink, softshrink,
+    thresholded_relu, brelu, stanh, maxout, flatten, space_to_depth,
+    l1_norm)

@@ -118,3 +118,53 @@ def pow(x, factor=1.0, name=None):
     helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
                      attrs={"factor": factor})
     return out
+
+
+# -- misc-batch wrappers (reference: layers/nn.py + layers/ops.py entries
+# for selu nn.py, hard_shrink/softshrink/thresholded_relu/brelu/stanh
+# generated in layers/ops.py from OpProto) --------------------------------
+
+def _make_attr_unary(op, defaults, in_slot="X"):
+    def layer(x, name=None, **kwargs):
+        attrs = dict(defaults)
+        for k in kwargs:
+            if k not in attrs:
+                raise TypeError(f"{op}() got unexpected kwarg {k!r}")
+        attrs.update(kwargs)
+        helper = LayerHelper(op, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op, inputs={in_slot: [x]}, outputs={"Out": [out]},
+                         attrs=attrs)
+        return out
+    layer.__name__ = op
+    return layer
+
+
+_ATTR_UNARY = {
+    "selu": {"scale": 1.0507009873554805, "alpha": 1.6732632423543772},
+    "hard_shrink": {"threshold": 0.5},
+    "thresholded_relu": {"threshold": 1.0},
+    "brelu": {"t_min": 0.0, "t_max": 24.0},
+    "stanh": {"scale_a": 2.0 / 3.0, "scale_b": 1.7159},
+    "maxout": {"groups": 2},
+    "flatten": {"axis": 1},
+    "space_to_depth": {"blocksize": 2},
+    "l1_norm": {},
+}
+
+for _op, _defaults in _ATTR_UNARY.items():
+    setattr(_mod, _op, _make_attr_unary(_op, _defaults))
+
+
+def soft_shrink(x, alpha=0.5, name=None):
+    """The op attr is named 'lambda' (a Python keyword), so the layer
+    exposes it as `alpha` like the reference's generated softshrink."""
+    helper = LayerHelper("soft_shrink", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("soft_shrink", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"lambda": alpha})
+    return out
+
+
+# `softshrink` is the reference's public layer name for soft_shrink
+softshrink = soft_shrink

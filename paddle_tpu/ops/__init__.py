@@ -13,3 +13,4 @@ from paddle_tpu.ops import rnn_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import beam_ops  # noqa: F401
+from paddle_tpu.ops import misc_ops  # noqa: F401

@@ -1,0 +1,527 @@
+"""Misc math / loss / shape-manipulation ops closing op-corpus parity gaps.
+
+Parity targets (reference): operators/argsort_op.cc, selu_op.cc,
+maxout_op.cc, minus_op.cc, l1_norm_op.cc, log_loss_op.cc, hinge_loss_op.cc,
+rank_loss_op.cc, margin_rank_loss_op.cc, modified_huber_loss_op.cc,
+bpr_loss_op.cc, teacher_student_sigmoid_loss_op.cc,
+squared_l2_distance_op.cc, multiplex_op.cc, fill_op.cc, flatten_op.cc,
+squeeze_op.cc, unsqueeze_op.cc, unstack_op.cc, reverse_op.cc,
+is_empty_op.cc, crop_op.cc, pad2d_op.cc, pad_constant_like_op.cc,
+space_to_depth_op.cc, sampling_id_op.cc, random_crop_op.cc,
+add_position_encoding_op.cc, conv_shift_op.cc, row_conv_op.cc,
+similarity_focus_op.cc, data_norm_op.cc, bilinear_tensor_product_op.cc,
+fc_op.cc, print_op.cc, py_func_op.cc, fill_any_like semantics via
+fill_zeros_like (already present).
+
+All are single-pass jnp/lax emitters: XLA fuses them into neighbours; none
+need Pallas. Dynamic-batch dims survive abstract shape inference because the
+emitters only use relative reshapes (-1) on the batch axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op, single
+
+
+# -- sorting / selection ----------------------------------------------------
+
+@register_op("argsort", ref="operators/argsort_op.cc")
+def _argsort(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1) % x.ndim if x.ndim else 0
+    idx = jnp.argsort(x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", no_grad=True, ref="operators/arg_max_op.cc")
+def _arg_max(ctx, ins, attrs):
+    x = first(ins, "X")
+    return single(jnp.argmax(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min", no_grad=True, ref="operators/arg_min_op.cc")
+def _arg_min(ctx, ins, attrs):
+    x = first(ins, "X")
+    return single(jnp.argmin(x, axis=attrs.get("axis", -1)).astype(jnp.int64))
+
+
+@register_op("multiplex", ref="operators/multiplex_op.cc")
+def _multiplex(ctx, ins, attrs):
+    """Row-wise select among candidate tensors: Out[i] = X[Ids[i]][i]."""
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ins["X"], axis=0)                # [K, N, ...]
+    rows = jnp.arange(ids.shape[0])
+    return single(xs[ids, rows])
+
+
+# -- activations ------------------------------------------------------------
+
+@register_op("selu", ref="operators/selu_op.cc")
+def _selu(ctx, ins, attrs):
+    x = first(ins, "X")
+    scale = attrs.get("scale", 1.0507009873554805)
+    alpha = attrs.get("alpha", 1.6732632423543772)
+    return single(scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)))
+
+
+@register_op("maxout", ref="operators/maxout_op.cc")
+def _maxout(ctx, ins, attrs):
+    """NCHW: channels folded into groups, max over each group."""
+    x = first(ins, "X")
+    groups = attrs.get("groups", 2)
+    n, c, h, w = x.shape
+    return single(x.reshape(n, c // groups, groups, h, w).max(axis=2))
+
+
+@register_op("hard_shrink", ref="operators/activation_op.cc hard_shrink")
+def _hard_shrink(ctx, ins, attrs):
+    x = first(ins, "X")
+    t = attrs.get("threshold", 0.5)
+    return single(jnp.where(jnp.abs(x) > t, x, 0.0))
+
+
+@register_op("soft_shrink", ref="operators/activation_op.cc softshrink")
+def _soft_shrink(ctx, ins, attrs):
+    x = first(ins, "X")
+    lam = attrs.get("lambda", 0.5)
+    return single(jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0)))
+
+
+@register_op("thresholded_relu",
+             ref="operators/activation_op.cc thresholded_relu")
+def _thresholded_relu(ctx, ins, attrs):
+    x = first(ins, "X")
+    t = attrs.get("threshold", 1.0)
+    return single(jnp.where(x > t, x, 0.0))
+
+
+@register_op("brelu", ref="operators/activation_op.cc brelu")
+def _brelu(ctx, ins, attrs):
+    x = first(ins, "X")
+    return single(jnp.clip(x, attrs.get("t_min", 0.0), attrs.get("t_max", 24.0)))
+
+
+@register_op("stanh", ref="operators/activation_op.cc stanh")
+def _stanh(ctx, ins, attrs):
+    x = first(ins, "X")
+    a = attrs.get("scale_a", 2.0 / 3.0)
+    b = attrs.get("scale_b", 1.7159)
+    return single(b * jnp.tanh(a * x))
+
+
+# -- elementwise / norms ----------------------------------------------------
+
+@register_op("minus", ref="operators/minus_op.cc")
+def _minus(ctx, ins, attrs):
+    return single(first(ins, "X") - first(ins, "Y"))
+
+
+@register_op("l1_norm", ref="operators/l1_norm_op.cc")
+def _l1_norm(ctx, ins, attrs):
+    return single(jnp.sum(jnp.abs(first(ins, "X"))))
+
+
+@register_op("squared_l2_distance",
+             ref="operators/squared_l2_distance_op.cc")
+def _squared_l2_distance(ctx, ins, attrs):
+    """Row-wise ||x-y||^2; Y broadcastable [1,D]. Outputs sub_result (kept
+    for the reference's backward kernel; XLA fuses it away) and Out [N,1]."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    sub = x - y
+    out = jnp.sum(sub * sub, axis=-1, keepdims=True)
+    return {"sub_result": [sub], "Out": [out]}
+
+
+# -- classification / ranking losses ---------------------------------------
+
+@register_op("log_loss", ref="operators/log_loss_op.cc")
+def _log_loss(ctx, ins, attrs):
+    p = first(ins, "Predicted")
+    y = first(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": [-y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)]}
+
+
+@register_op("hinge_loss",
+             ref="operators/hinge_loss_op.cc")
+def _hinge_loss(ctx, ins, attrs):
+    logits = first(ins, "Logits")
+    labels = first(ins, "Labels")       # {0, 1}
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register_op("rank_loss", ref="operators/rank_loss_op.cc")
+def _rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss: o = left - right, C = log(1+e^o) - label*o."""
+    label = first(ins, "Label")
+    left = first(ins, "Left")
+    right = first(ins, "Right")
+    o = left - right
+    return single(jnp.logaddexp(0.0, o) - label * o)
+
+
+@register_op("margin_rank_loss",
+             ref="operators/margin_rank_loss_op.cc")
+def _margin_rank_loss(ctx, ins, attrs):
+    label = first(ins, "Label")         # +1/-1
+    x1 = first(ins, "X1")
+    x2 = first(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    act = -label * (x1 - x2) + margin
+    return {"Out": [jnp.maximum(0.0, act)],
+            "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register_op("modified_huber_loss",
+             ref="operators/modified_huber_loss_op.cc")
+def _modified_huber_loss(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")                 # {0, 1}
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z, jnp.maximum(0.0, 1.0 - z) ** 2)
+    return {"IntermediateVal": [z], "Out": [loss]}
+
+
+@register_op("bpr_loss", ref="operators/bpr_loss_op.cc")
+def _bpr_loss(ctx, ins, attrs):
+    """Bayesian personalized ranking: mean over negatives of
+    -log sigmoid(x_pos - x_neg)."""
+    x = first(ins, "X")                 # [N, C]
+    label = first(ins, "Label").reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)      # [N, 1]
+    diff = pos - x                                            # [N, C]
+    loss = jnp.logaddexp(0.0, -diff)                          # -log sigmoid
+    mask = jnp.ones((n, c), x.dtype).at[jnp.arange(n), label].set(0.0)
+    return single((jnp.sum(loss * mask, axis=1, keepdims=True)
+                   / jnp.maximum(c - 1, 1)))
+
+
+@register_op("teacher_student_sigmoid_loss",
+             ref="operators/teacher_student_sigmoid_loss_op.cc")
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """CTR distillation loss: teacher signal in label's fractional part
+    (label < -1: no teacher; see reference op comment)."""
+    x = first(ins, "X").reshape(-1)
+    label = first(ins, "Label").reshape(-1)
+    # student CE with hard label (label>0) + teacher CE with soft label
+    softmax_term = jnp.logaddexp(0.0, x)      # log(1+e^x)
+    hard = jnp.where(label > 0.0, x, 0.0)
+    loss = softmax_term - hard
+    teacher = jnp.clip(label, 0.0, 1.0)
+    teacher_loss = jnp.logaddexp(0.0, x) - teacher * x
+    out = jnp.where(label < -1.0, loss, loss + teacher_loss)
+    return {"Y": [out.reshape(-1, 1)]}
+
+
+# -- shape manipulation -----------------------------------------------------
+
+@register_op("fill", no_grad=True, ref="operators/fill_op.cc")
+def _fill(ctx, ins, attrs):
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    dtype = attrs.get("dtype", "float32")
+    value = np.asarray(attrs.get("value", [0.0]), dtype=dtype).reshape(shape)
+    return single(jnp.asarray(value))
+
+
+def _flatten_impl(x, axis):
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("flatten", ref="operators/flatten_op.cc")
+def _flatten(ctx, ins, attrs):
+    return single(_flatten_impl(first(ins, "X"), attrs.get("axis", 1)))
+
+
+@register_op("flatten2", ref="operators/flatten_op.cc flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = first(ins, "X")
+    out = _flatten_impl(x, attrs.get("axis", 1))
+    return {"Out": [out],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("squeeze2", ref="operators/squeeze_op.cc squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    x = first(ins, "X")
+    axes = attrs.get("axes", [])
+    if axes:
+        out = x.reshape([d for i, d in enumerate(x.shape)
+                         if not (i in [a % x.ndim for a in axes] and d == 1)])
+    else:
+        out = x.reshape([d for d in x.shape if d != 1])
+    return {"Out": [out],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("unsqueeze2", ref="operators/unsqueeze_op.cc unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    x = first(ins, "X")
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("unstack", ref="operators/unstack_op.cc")
+def _unstack(ctx, ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", 0) % x.ndim
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(p, axis=axis)
+                  for p in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("reverse", ref="operators/reverse_op.cc")
+def _reverse(ctx, ins, attrs):
+    x = first(ins, "X")
+    axes = attrs.get("axis", [0])
+    if isinstance(axes, int):
+        axes = [axes]
+    return single(jnp.flip(x, axis=[a % x.ndim for a in axes]))
+
+
+@register_op("is_empty", no_grad=True, ref="operators/is_empty_op.cc")
+def _is_empty(ctx, ins, attrs):
+    x = first(ins, "X")
+    return single(jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+@register_op("crop", ref="operators/crop_op.cc")
+def _crop(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    shape = list(y.shape) if y is not None else [int(s) for s in attrs["shape"]]
+    offsets = [int(o) for o in attrs.get("offsets", [0] * x.ndim)]
+    return single(lax.slice(x, offsets,
+                            [o + s for o, s in zip(offsets, shape)]))
+
+
+@register_op("pad2d", ref="operators/pad2d_op.cc")
+def _pad2d(ctx, ins, attrs):
+    """NCHW spatial padding with constant/reflect/edge modes."""
+    x = first(ins, "X")
+    top, bottom, left, right = attrs.get("paddings", [0, 0, 0, 0])
+    mode = attrs.get("mode", "constant")
+    if attrs.get("data_format", "NCHW") == "NCHW":
+        pads = [(0, 0), (0, 0), (top, bottom), (left, right)]
+    else:
+        pads = [(0, 0), (top, bottom), (left, right), (0, 0)]
+    if mode == "constant":
+        return single(jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0)))
+    return single(jnp.pad(x, pads, mode={"reflect": "reflect", "edge": "edge"}[mode]))
+
+
+@register_op("pad_constant_like",
+             ref="operators/pad_constant_like_op.cc")
+def _pad_constant_like(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    pads = [(0, dx - dy) for dx, dy in zip(x.shape, y.shape)]
+    return single(jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("space_to_depth", ref="operators/space_to_depth_op.cc")
+def _space_to_depth(ctx, ins, attrs):
+    x = first(ins, "X")
+    bs = attrs.get("blocksize", 2)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return single(out.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+# -- sampling / randomized --------------------------------------------------
+
+@register_op("sampling_id", no_grad=True, ref="operators/sampling_id_op.cc")
+def _sampling_id(ctx, ins, attrs):
+    """Sample one column index per row of a probability matrix."""
+    x = first(ins, "X")
+    u = jax.random.uniform(ctx.step_key(), (x.shape[0], 1),
+                           minval=attrs.get("min", 0.0),
+                           maxval=attrs.get("max", 1.0))
+    cdf = jnp.cumsum(x, axis=1)
+    idx = jnp.sum((u > cdf).astype(jnp.int64), axis=1)
+    return single(jnp.clip(idx, 0, x.shape[1] - 1))
+
+
+@register_op("random_crop", no_grad=True, ref="operators/random_crop_op.cc")
+def _random_crop(ctx, ins, attrs):
+    """Random spatial crop of the trailing len(shape) dims (per batch-lot,
+    one offset for the whole batch — the deterministic-rng variant of the
+    reference's per-instance Philox loop, random_crop_op.h)."""
+    x = first(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    key = jax.random.fold_in(ctx.step_key(), int(attrs.get("seed", 0)))
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    begin = [0] * lead + [s for s in starts]
+    sizes = list(x.shape[:lead]) + shape
+    return {"Out": [lax.dynamic_slice(x, begin, sizes)],
+            "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+
+
+# -- sequence-flavoured convs / encodings -----------------------------------
+
+@register_op("add_position_encoding",
+             ref="operators/add_position_encoding_op.cc")
+def _add_position_encoding(ctx, ins, attrs):
+    """out = alpha*x + beta*sinusoid(pos); x [B, T, D] (padded batch)."""
+    x = first(ins, "X")
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    half = (d + 1) // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return single(alpha * x + beta * enc[None, :, :d].astype(x.dtype))
+
+
+@register_op("conv_shift", ref="operators/conv_shift_op.cc")
+def _conv_shift(ctx, ins, attrs):
+    """Circular convolution (NTM attention-shift): X [B, M], Y [B, N] with
+    N odd; out[b, i] = sum_j X[b, (i + j - N//2) mod M] * Y[b, j]."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    m = x.shape[1]
+    n = y.shape[1]
+    shifts = jnp.arange(n) - n // 2
+    idx = (jnp.arange(m)[None, :] + shifts[:, None]) % m       # [N, M]
+    gathered = x[:, idx]                                       # [B, N, M]
+    return single(jnp.einsum("bnm,bn->bm", gathered, y))
+
+
+@register_op("row_conv", ref="operators/row_conv_op.cc")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (DeepSpeech2): out[t] = sum_k W[k]*x[t+k].
+    Padded [B, T, D] + optional SeqLens mask instead of LoD."""
+    x = first(ins, "X")
+    w = first(ins, "Filter")            # [future_ctx, D]
+    k = w.shape[0]
+    b, t, d = x.shape
+    xpad = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + xpad[:, j:j + t, :] * w[j][None, None, :]
+    seq_lens = first(ins, "SeqLens")
+    if seq_lens is not None:
+        mask = jnp.arange(t)[None, :] < seq_lens.reshape(-1, 1)
+        out = out * mask[:, :, None].astype(out.dtype)
+    return single(out)
+
+
+@register_op("similarity_focus", no_grad=True,
+             ref="operators/similarity_focus_op.cc")
+def _similarity_focus(ctx, ins, attrs):
+    """Similarity-focus mask over [B, C, A, B2]: for each selected channel
+    index, mark the per-row/col argmax positions (axis=1 variant)."""
+    x = first(ins, "X")
+    axis = attrs.get("axis", 1)
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    n, c, a, b = x.shape
+    mask = jnp.zeros_like(x)
+    for ci in indexes:
+        ch = x[:, ci]                                  # [N, A, B]
+        rmax = jnp.argmax(ch, axis=2)                  # [N, A] best col per row
+        cmax = jnp.argmax(ch, axis=1)                  # [N, B] best row per col
+        rows = jnp.zeros((n, a, b)).at[jnp.arange(n)[:, None],
+                                       jnp.arange(a)[None, :], rmax].set(1.0)
+        cols = jnp.zeros((n, a, b)).at[jnp.arange(n)[:, None], cmax,
+                                       jnp.arange(b)[None, :]].set(1.0)
+        m = jnp.maximum(rows, cols)[:, None, :, :]     # broadcast over C
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    if axis != 1:
+        mask = jnp.moveaxis(mask, 1, axis)
+    return single(mask.astype(x.dtype))
+
+
+# -- normalization / fused dense -------------------------------------------
+
+@register_op("data_norm", ref="operators/data_norm_op.cc")
+def _data_norm(ctx, ins, attrs):
+    """CTR data normalization from accumulated statistics (no cross-batch
+    reduction at run time — stats are inputs, updated by the optimizer side)."""
+    x = first(ins, "X")
+    bsize = first(ins, "BatchSize")
+    bsum = first(ins, "BatchSum")
+    bsqsum = first(ins, "BatchSquareSum")
+    eps = attrs.get("epsilon", 1e-4)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / (bsqsum - bsum * means + eps))
+    return {"Y": [(x - means) * scales], "Means": [means], "Scales": [scales]}
+
+
+@register_op("bilinear_tensor_product",
+             ref="operators/bilinear_tensor_product_op.cc")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[:, k] = x @ W[k] @ y^T diag + bias; W [K, Dx, Dy]."""
+    x = first(ins, "X")                 # [N, Dx]
+    y = first(ins, "Y")                 # [N, Dy]
+    w = first(ins, "Weight")            # [K, Dx, Dy]
+    out = jnp.einsum("nd,kde,ne->nk", x, w, y)
+    bias = first(ins, "Bias")
+    if bias is not None:
+        out = out + bias
+    return single(out)
+
+
+@register_op("fc", ref="operators/fc_op.cc")
+def _fc(ctx, ins, attrs):
+    """Fused matmul+bias+activation (the reference's CPU fused fc; on TPU
+    XLA fuses the same chain — registered for program-level parity)."""
+    x = first(ins, "Input")
+    w = first(ins, "W")
+    ncol = attrs.get("in_num_col_dims", 1)
+    lead = int(np.prod(x.shape[:ncol]))
+    out = x.reshape(lead, -1) @ w
+    bias = first(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    if attrs.get("activation_type", "") == "relu":
+        out = jnp.maximum(out, 0.0)
+    return single(out.reshape(x.shape[:ncol] + (w.shape[-1],)))
+
+
+# -- debug / host interop ---------------------------------------------------
+
+@register_op("print", ref="operators/print_op.cc")
+def _print(ctx, ins, attrs):
+    """Identity + host-side print (reference prints tensor data under a
+    message prefix; here via jax.debug.print so it works under jit)."""
+    x = first(ins, "In")
+    if x is None:
+        x = first(ins, "X")
+    msg = attrs.get("message", "").replace("{", "{{").replace("}", "}}")
+    jax.debug.print(msg + "{x}", x=x)
+    return single(x)
+
+
+@register_op("py_func", ref="operators/py_func_op.cc")
+def _py_func(ctx, ins, attrs):
+    """Host python callback inside the compiled graph via pure_callback
+    (the reference keeps a registry of callables indexed by forward_callable_id;
+    here the callable itself is carried in attrs)."""
+    fn = attrs["func"]
+    xs = ins.get("X", [])
+    out_shapes = attrs.get("out_shapes")
+    out_dtypes = attrs.get("out_dtypes", ["float32"])
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                    for s, d in zip(out_shapes, out_dtypes)]
+    outs = jax.pure_callback(fn, result_shape, *xs)
+    return {"Out": list(outs)}

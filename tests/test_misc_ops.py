@@ -1,0 +1,331 @@
+"""Forward + numeric-gradient tests for the misc op batch
+(reference OpTest files: test_argsort_op.py, test_selu_op.py,
+test_maxout_op.py, test_log_loss_op.py, test_hinge_loss_op.py,
+test_rank_loss_op.py, test_margin_rank_loss_op.py,
+test_modified_huber_loss_op.py, test_bpr_loss_op.py,
+test_squared_l2_distance_op.py, test_multiplex_op.py, test_flatten_op.py,
+test_unstack_op.py, test_reverse_op.py, test_crop_op.py, test_pad2d_op.py,
+test_space_to_depth_op.py, test_row_conv_op.py, test_conv_shift_op.py,
+test_bilinear_tensor_product_op.py, test_fc_op.py, test_data_norm_op.py,
+test_add_position_encoding_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(*shape, seed=0, lo=0.1, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+# -- forwards ---------------------------------------------------------------
+
+def test_argsort_forward():
+    x = _r(3, 5, lo=-1.0)
+    out = run_single_op("argsort", {"X": {"x": x}}, attrs={"axis": 1},
+                        out_slots=("Out", "Indices"))
+    np.testing.assert_allclose(out["__out_Out_0"], np.sort(x, axis=1),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(out["__out_Indices_0"],
+                                  np.argsort(x, axis=1))
+
+
+def test_arg_max_min_alias():
+    x = _r(3, 5)
+    out = run_single_op("arg_max", {"X": {"x": x}}, attrs={"axis": 1})
+    np.testing.assert_array_equal(out["__out_Out_0"], np.argmax(x, axis=1))
+    out = run_single_op("arg_min", {"X": {"x": x}}, attrs={"axis": 1})
+    np.testing.assert_array_equal(out["__out_Out_0"], np.argmin(x, axis=1))
+
+
+def test_multiplex_forward():
+    xs = [_r(4, 3, seed=s) for s in range(3)]
+    ids = np.array([[2], [0], [1], [0]], dtype=np.int32)
+    out = run_single_op("multiplex",
+                        {"Ids": {"ids": ids},
+                         "X": {f"x{i}": x for i, x in enumerate(xs)}})
+    expect = np.stack([xs[ids[i, 0]][i] for i in range(4)])
+    np.testing.assert_allclose(out["__out_Out_0"], expect, rtol=1e-6)
+
+
+def test_maxout_forward():
+    x = _r(2, 6, 4, 4)
+    out = run_single_op("maxout", {"X": {"x": x}}, attrs={"groups": 3})
+    expect = x.reshape(2, 2, 3, 4, 4).max(axis=2)
+    np.testing.assert_allclose(out["__out_Out_0"], expect, rtol=1e-6)
+
+
+def test_space_to_depth_forward():
+    x = _r(1, 2, 4, 4)
+    out = run_single_op("space_to_depth", {"X": {"x": x}},
+                        attrs={"blocksize": 2})
+    assert out["__out_Out_0"].shape == (1, 8, 2, 2)
+
+
+def test_flatten2_forward():
+    x = _r(2, 3, 4)
+    out = run_single_op("flatten2", {"X": {"x": x}}, attrs={"axis": 2},
+                        out_slots=("Out", "XShape"))
+    assert out["__out_Out_0"].shape == (6, 4)
+
+
+def test_unstack_forward():
+    x = _r(3, 4)
+    out = run_single_op("unstack", {"X": {"x": x}}, attrs={"axis": 0},
+                        out_slots=("Y",), n_out=3)
+    for i in range(3):
+        np.testing.assert_allclose(out[f"__out_Y_{i}"], x[i], rtol=1e-6)
+
+
+def test_reverse_forward():
+    x = _r(3, 4)
+    out = run_single_op("reverse", {"X": {"x": x}}, attrs={"axis": [1]})
+    np.testing.assert_allclose(out["__out_Out_0"], x[:, ::-1], rtol=1e-6)
+
+
+def test_is_empty():
+    out = run_single_op("is_empty", {"X": {"x": _r(2, 3)}})
+    assert not bool(out["__out_Out_0"])
+
+
+def test_crop_forward():
+    x = _r(4, 5)
+    y = np.zeros((2, 3), np.float32)
+    out = run_single_op("crop", {"X": {"x": x}, "Y": {"y": y}},
+                        attrs={"offsets": [1, 1]})
+    np.testing.assert_allclose(out["__out_Out_0"], x[1:3, 1:4], rtol=1e-6)
+
+
+def test_pad2d_modes():
+    x = _r(1, 1, 3, 3)
+    for mode in ("constant", "reflect", "edge"):
+        out = run_single_op("pad2d", {"X": {"x": x}},
+                            attrs={"paddings": [1, 1, 1, 1], "mode": mode})
+        assert out["__out_Out_0"].shape == (1, 1, 5, 5)
+
+
+def test_pad_constant_like():
+    x = np.zeros((4, 5), np.float32)
+    y = _r(2, 3)
+    out = run_single_op("pad_constant_like",
+                        {"X": {"x": x}, "Y": {"y": y}},
+                        attrs={"pad_value": 7.0})
+    got = out["__out_Out_0"]
+    np.testing.assert_allclose(got[:2, :3], y, rtol=1e-6)
+    assert (got[2:, :] == 7.0).all() and (got[:, 3:] == 7.0).all()
+
+
+def test_sampling_id_in_range():
+    x = np.full((8, 5), 0.2, np.float32)
+    out = run_single_op("sampling_id", {"X": {"x": x}})
+    ids = out["__out_Out_0"]
+    assert ids.shape == (8,) and (ids >= 0).all() and (ids < 5).all()
+
+
+def test_fill():
+    out = run_single_op("fill", {}, attrs={"shape": [2, 2], "dtype": "float32",
+                                           "value": [1.0, 2.0, 3.0, 4.0]})
+    np.testing.assert_allclose(out["__out_Out_0"],
+                               [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_data_norm_forward():
+    x = _r(4, 3)
+    size = np.full((3,), 10.0, np.float32)
+    s = _r(3, seed=1) * 10
+    sq = s * s / 10 + 5.0
+    out = run_single_op("data_norm",
+                        {"X": {"x": x}, "BatchSize": {"bs": size},
+                         "BatchSum": {"bsum": s},
+                         "BatchSquareSum": {"bsq": sq}},
+                        out_slots=("Y", "Means", "Scales"))
+    means = s / size
+    scales = np.sqrt(size / (sq - s * means + 1e-4))
+    np.testing.assert_allclose(out["__out_Y_0"], (x - means) * scales,
+                               rtol=1e-5)
+
+
+def test_conv_shift_forward():
+    x = _r(2, 7, lo=-1.0)
+    y = _r(2, 3, lo=-1.0, seed=1)
+    out = run_single_op("conv_shift", {"X": {"x": x}, "Y": {"y": y}})
+    expect = np.zeros((2, 7), np.float32)
+    for b in range(2):
+        for i in range(7):
+            for j in range(3):
+                expect[b, i] += x[b, (i + j - 1) % 7] * y[b, j]
+    np.testing.assert_allclose(out["__out_Out_0"], expect, rtol=1e-5)
+
+
+def test_add_position_encoding_forward():
+    x = _r(2, 4, 6)
+    out = run_single_op("add_position_encoding", {"X": {"x": x}},
+                        attrs={"alpha": 1.0, "beta": 0.0})
+    np.testing.assert_allclose(out["__out_Out_0"], x, rtol=1e-6)
+
+
+def test_similarity_focus_shape():
+    x = _r(2, 3, 4, 5)
+    out = run_single_op("similarity_focus", {"X": {"x": x}},
+                        attrs={"axis": 1, "indexes": [0]})
+    m = out["__out_Out_0"]
+    assert m.shape == x.shape and set(np.unique(m)) <= {0.0, 1.0}
+
+
+def test_teacher_student_sigmoid_loss_forward():
+    x = _r(4, 1, lo=-1.0)
+    label = np.array([[1.0], [0.0], [-2.0], [0.5]], np.float32)
+    out = run_single_op("teacher_student_sigmoid_loss",
+                        {"X": {"x": x}, "Label": {"l": label}},
+                        out_slots=("Y",))
+    assert np.isfinite(out["__out_Y_0"]).all()
+
+
+# -- gradient checks --------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["selu", "hard_shrink", "soft_shrink",
+                                "thresholded_relu", "brelu", "stanh"])
+def test_grad_activations(op):
+    check_grad(op, {"X": {"x": _r(3, 4, lo=-2.0, hi=2.0)}})
+
+
+def test_grad_minus():
+    check_grad("minus", {"X": {"x": _r(2, 3)}, "Y": {"y": _r(2, 3, seed=1)}})
+
+
+def test_grad_l1_norm():
+    check_grad("l1_norm", {"X": {"x": _r(3, 3, lo=0.2)}})
+
+
+def test_grad_maxout():
+    check_grad("maxout", {"X": {"x": _r(2, 4, 3, 3, lo=-1.0)}},
+               attrs={"groups": 2})
+
+
+def test_grad_log_loss():
+    check_grad("log_loss",
+               {"Predicted": {"p": _r(4, 1, lo=0.2, hi=0.8)},
+                "Labels": {"l": np.array([[1], [0], [1], [0]], np.float32)}},
+               out_slot="Loss", grad_vars=["p"])
+
+
+def test_grad_hinge_loss():
+    check_grad("hinge_loss",
+               {"Logits": {"x": _r(4, 1, lo=-2.0, hi=2.0)},
+                "Labels": {"l": np.array([[1], [0], [1], [0]], np.float32)}},
+               out_slot="Loss", grad_vars=["x"])
+
+
+def test_grad_rank_loss():
+    check_grad("rank_loss",
+               {"Label": {"l": np.array([[1.0], [0.0], [0.5]], np.float32)},
+                "Left": {"a": _r(3, 1, lo=-1.0)},
+                "Right": {"b": _r(3, 1, lo=-1.0, seed=1)}},
+               grad_vars=["a", "b"])
+
+
+def test_grad_margin_rank_loss():
+    check_grad("margin_rank_loss",
+               {"Label": {"l": np.array([[1.0], [-1.0], [1.0]], np.float32)},
+                "X1": {"a": _r(3, 1, lo=-1.0)},
+                "X2": {"b": _r(3, 1, lo=-1.0, seed=1)}},
+               attrs={"margin": 0.1}, grad_vars=["a", "b"])
+
+
+def test_grad_modified_huber_loss():
+    check_grad("modified_huber_loss",
+               {"X": {"x": _r(4, 1, lo=-2.0, hi=2.0)},
+                "Y": {"y": np.array([[1], [0], [1], [0]], np.float32)}},
+               grad_vars=["x"], extra_out_slots=("IntermediateVal",))
+
+
+def test_grad_bpr_loss():
+    check_grad("bpr_loss",
+               {"X": {"x": _r(3, 4, lo=-1.0)},
+                "Label": {"l": np.array([[0], [2], [3]], np.int32)}},
+               grad_vars=["x"])
+
+
+def test_grad_squared_l2_distance():
+    check_grad("squared_l2_distance",
+               {"X": {"x": _r(3, 4)}, "Y": {"y": _r(3, 4, seed=1)}},
+               extra_out_slots=("sub_result",))
+    # note: Out is primary slot; sub_result extra
+
+
+def test_grad_flatten():
+    check_grad("flatten", {"X": {"x": _r(2, 3, 4)}}, attrs={"axis": 2})
+
+
+def test_grad_reverse():
+    check_grad("reverse", {"X": {"x": _r(2, 3)}}, attrs={"axis": [0, 1]})
+
+
+def test_grad_crop():
+    check_grad("crop", {"X": {"x": _r(4, 5)},
+                        "Y": {"y": np.zeros((2, 3), np.float32)}},
+               attrs={"offsets": [1, 1]}, grad_vars=["x"])
+
+
+def test_grad_pad2d():
+    check_grad("pad2d", {"X": {"x": _r(1, 2, 3, 3)}},
+               attrs={"paddings": [1, 0, 2, 1]})
+
+
+def test_grad_space_to_depth():
+    check_grad("space_to_depth", {"X": {"x": _r(1, 2, 4, 4)}},
+               attrs={"blocksize": 2})
+
+
+def test_grad_multiplex():
+    ids = np.array([[1], [0], [1]], dtype=np.int32)
+    check_grad("multiplex",
+               {"Ids": {"ids": ids},
+                "X": {"x0": _r(3, 2), "x1": _r(3, 2, seed=1)}},
+               grad_vars=["x0", "x1"])
+
+
+def test_grad_conv_shift():
+    check_grad("conv_shift",
+               {"X": {"x": _r(2, 5, lo=-1.0)}, "Y": {"y": _r(2, 3, seed=1)}})
+
+
+def test_grad_row_conv():
+    check_grad("row_conv",
+               {"X": {"x": _r(2, 5, 3)}, "Filter": {"w": _r(2, 3, seed=1)}})
+
+
+def test_grad_add_position_encoding():
+    check_grad("add_position_encoding", {"X": {"x": _r(2, 4, 6)}},
+               attrs={"alpha": 0.7, "beta": 0.3})
+
+
+def test_grad_bilinear_tensor_product():
+    check_grad("bilinear_tensor_product",
+               {"X": {"x": _r(3, 2)}, "Y": {"y": _r(3, 4, seed=1)},
+                "Weight": {"w": _r(5, 2, 4, seed=2)},
+                "Bias": {"b": _r(5, seed=3)}})
+
+
+def test_grad_fc():
+    check_grad("fc",
+               {"Input": {"x": _r(3, 4)}, "W": {"w": _r(4, 5, seed=1)},
+                "Bias": {"b": _r(5, seed=2)}},
+               attrs={"activation_type": ""})
+
+
+def test_grad_selu_negative_region():
+    check_grad("selu", {"X": {"x": _r(3, 3, lo=-3.0, hi=-0.5)}})
+
+
+def test_grad_data_norm():
+    size = np.full((3,), 10.0, np.float32)
+    s = _r(3, seed=1) * 10
+    sq = s * s / 10 + 5.0
+    check_grad("data_norm",
+               {"X": {"x": _r(4, 3)}, "BatchSize": {"bs": size},
+                "BatchSum": {"bsum": s}, "BatchSquareSum": {"bsq": sq}},
+               out_slot="Y", grad_vars=["x"],
+               extra_out_slots=("Means", "Scales"))
