@@ -5,6 +5,8 @@ from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
     argmax, argmin, assign, cast, concat, fill_constant,
     fill_constant_batch_size_like, ones, shape, sums, zeros, zeros_like)
 from paddle_tpu.fluid.layers.nn import (  # noqa: F401
+    affine_channel, affine_grid, grid_sampler, image_resize,
+    resize_bilinear, resize_nearest, roi_align, roi_pool,
     argsort, multiplex, log_loss, rank_loss, margin_rank_loss, bpr_loss, crop, pad2d, pad_constant_like, random_crop, add_position_encoding, similarity_focus, bilinear_tensor_product, row_conv, unstack, sampling_id,
     accuracy, auc, batch_norm, beam_search, beam_search_decode, chunk_eval,
     clip, conv2d, conv2d_transpose,

@@ -900,3 +900,89 @@ def sampling_id(x, min=0.0, max=1.0, seed=0):
                      outputs={"Out": [out]},
                      attrs={"min": min, "max": max, "seed": seed})
     return out
+
+
+# -- image-op layers (reference: layers/nn.py image_resize, resize_bilinear,
+# roi_pool, roi_align (1.3 backport), affine_grid, grid_sampler, unpool;
+# pool_with_index via pool2d max variant) ----------------------------------
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    helper = LayerHelper("image_resize", name=name)
+    if out_shape is None:
+        out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
+    op = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp"}[resample]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(op, inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"out_h": int(out_shape[0]),
+                            "out_w": int(out_shape[1])})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR", name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_batch_id=None):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("roi_pool", inputs=inputs,
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_batch_id=None):
+    helper = LayerHelper("roi_align")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("roi_align", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    if hasattr(out_shape, "desc"):
+        raise NotImplementedError(
+            "affine_grid with a Variable out_shape is not supported on TPU "
+            "(static shapes); pass a list of 4 ints")
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op("affine_grid", inputs={"Theta": [theta]},
+                     outputs={"Out": [out]},
+                     attrs={"output_shape": [int(v) for v in out_shape]})
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def affine_channel(x, scale, bias, name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]})
+    return out

@@ -14,3 +14,4 @@ from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import beam_ops  # noqa: F401
 from paddle_tpu.ops import misc_ops  # noqa: F401
+from paddle_tpu.ops import image_ops  # noqa: F401

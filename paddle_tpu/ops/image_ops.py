@@ -1,0 +1,433 @@
+"""Image-manipulation ops: interpolation, affine/grid sampling, unpooling,
+ROI extraction (reference: operators/interpolate ops bilinear_interp_op.cc,
+nearest_interp via interpolate_op family in 1.2: bilinear_interp_op.cc,
+operators/affine_channel_op.cc, affine_grid_op.cc, grid_sampler_op.cc,
+unpool_op.cc, spp_op.cc, pool_with_index_op.cc, roi_pool_op.cc,
+roi_align_op.cc, detection/psroi_pool_op.cc (1.3-era location:
+operators/psroi_pool_op.cc), detection/roi_perspective_transform_op.cc,
+conv_transpose_op.cc Conv3DTranspose).
+
+TPU notes: ROI ops are the classic dynamic-shape hazard — the reference
+emits [num_rois, ...] outputs driven by LoD; here ROIs are a static-shape
+[R, 4] tensor with an explicit per-roi batch-index input (padded-roi
+convention), so XLA sees static shapes and the gather/scatter lowers to
+vectorized dynamic slices. Bilinear sampling is expressed as 4 gathers —
+XLA fuses the weight arithmetic into them."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op, single
+
+
+# -- interpolation -----------------------------------------------------------
+
+def _interp_out_hw(x, ins, attrs):
+    if first(ins, "OutSize") is not None:
+        # the reference reads the target size from a runtime tensor
+        # (bilinear_interp_op.cc OutSize priority); under XLA output shapes
+        # must be static, so a runtime OutSize cannot be honored — reject
+        # loudly rather than silently resizing to the attrs.
+        raise NotImplementedError(
+            "runtime OutSize input is not supported on TPU (static shapes); "
+            "pass out_h/out_w attrs instead")
+    return int(attrs["out_h"]), int(attrs["out_w"])
+
+
+@register_op("bilinear_interp", ref="operators/bilinear_interp_op.cc")
+def _bilinear_interp(ctx, ins, attrs):
+    """NCHW bilinear resize with the 1.2 reference's align-corners ratio
+    (in-1)/(out-1) (bilinear_interp_op.h ratio computation)."""
+    x = first(ins, "X")
+    oh, ow = _interp_out_hw(x, ins, attrs)
+    n, c, h, w = x.shape
+    rh = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    ys = jnp.arange(oh, dtype=jnp.float32) * rh
+    xs = jnp.arange(ow, dtype=jnp.float32) * rw
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(x.dtype)
+    wx = (xs - x0).astype(x.dtype)
+    # gather rows then cols; XLA fuses the lerp
+    top = x[:, :, y0, :]
+    bot = x[:, :, y1, :]
+    row = top * (1 - wy)[None, None, :, None] + bot * wy[None, None, :, None]
+    left = row[:, :, :, x0]
+    right = row[:, :, :, x1]
+    out = left * (1 - wx)[None, None, None, :] + right * wx[None, None, None, :]
+    return single(out)
+
+
+@register_op("nearest_interp", ref="operators/nearest_interp (interpolate family)")
+def _nearest_interp(ctx, ins, attrs):
+    x = first(ins, "X")
+    oh, ow = _interp_out_hw(x, ins, attrs)
+    n, c, h, w = x.shape
+    rh = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    ys = jnp.clip(jnp.round(jnp.arange(oh) * rh).astype(jnp.int32), 0, h - 1)
+    xs = jnp.clip(jnp.round(jnp.arange(ow) * rw).astype(jnp.int32), 0, w - 1)
+    return single(x[:, :, ys, :][:, :, :, xs])
+
+
+# -- affine / grid sampling --------------------------------------------------
+
+@register_op("affine_channel", ref="operators/affine_channel_op.cc")
+def _affine_channel(ctx, ins, attrs):
+    x = first(ins, "X")                  # NCHW
+    scale = first(ins, "Scale").reshape(1, -1, 1, 1)
+    bias = first(ins, "Bias").reshape(1, -1, 1, 1)
+    return single(x * scale + bias)
+
+
+@register_op("affine_grid", ref="operators/affine_grid_op.cc")
+def _affine_grid(ctx, ins, attrs):
+    """Theta [N,2,3] → normalized sampling grid [N,H,W,2] (align-corners
+    linspace over [-1,1], matching the reference's h_step/w_step)."""
+    theta = first(ins, "Theta")
+    if first(ins, "OutputShape") is not None:
+        raise NotImplementedError(
+            "runtime OutputShape input is not supported on TPU (static "
+            "shapes); pass the output_shape attr instead")
+    n, c, h, w = [int(v) for v in attrs["output_shape"]]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                       # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+    return single(grid)                                  # [N, H, W, 2]
+
+
+def _bilinear_sample(img, px, py):
+    """img [C,H,W]; px/py pixel coords [...]; zero padding outside."""
+    c, h, w = img.shape
+    x0 = jnp.floor(px).astype(jnp.int32)
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (px - x0).astype(img.dtype)
+    wy = (py - y0).astype(img.dtype)
+
+    def at(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1)
+        xc = jnp.clip(xx, 0, w - 1)
+        v = img[:, yc, xc]                   # [C, ...]
+        return v * valid.astype(img.dtype)
+
+    return (at(y0, x0) * ((1 - wy) * (1 - wx)) + at(y0, x1) * ((1 - wy) * wx)
+            + at(y1, x0) * (wy * (1 - wx)) + at(y1, x1) * (wy * wx))
+
+
+@register_op("grid_sampler", ref="operators/grid_sampler_op.cc")
+def _grid_sampler(ctx, ins, attrs):
+    """X [N,C,H,W] sampled at Grid [N,H',W',2] (normalized [-1,1], bilinear,
+    zero padding — the reference's cuDNN spatial-transformer semantics)."""
+    x = first(ins, "X")
+    grid = first(ins, "Grid")
+    n, c, h, w = x.shape
+    px = (grid[..., 0] + 1.0) * (w - 1) / 2.0           # [N, H', W']
+    py = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    out = jax.vmap(_bilinear_sample)(x, px, py)         # [N, C, H', W']
+    return {"Output": [out]}
+
+
+# -- unpooling / indexed pooling ---------------------------------------------
+
+@register_op("max_pool2d_with_index", ref="operators/pool_with_index_op.cc")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """Max pool returning both values and the flat HW index of each max
+    (the companion of `unpool`)."""
+    x = first(ins, "X")
+    k = attrs.get("ksize", [2, 2])
+    s = attrs.get("strides", k)
+    p = attrs.get("paddings", [0, 0])
+    if attrs.get("global_pooling", False):
+        k = list(x.shape[2:])
+        s, p = k, [0, 0]
+    n, c, h, w = x.shape
+    flat_idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]).astype(jnp.float32),
+        x.shape)
+    window = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    padding = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, jnp.float32(-1)), select,
+        window, strides, padding)
+    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register_op("unpool", ref="operators/unpool_op.cc")
+def _unpool(ctx, ins, attrs):
+    """Max-unpool: scatter X into a zero canvas at Indices (flat HW index
+    per feature map, as produced by max_pool2d_with_index)."""
+    x = first(ins, "X")                  # [N, C, H, W]
+    idx = first(ins, "Indices").astype(jnp.int32)
+    n, c, h, w = x.shape
+    k = attrs.get("ksize", [2, 2])
+    s = attrs.get("strides", k)
+    oh = attrs.get("unpooled_height", (h - 1) * s[0] + k[0])
+    ow = attrs.get("unpooled_width", (w - 1) * s[1] + k[1])
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda canvas, ids, vals: canvas.at[ids.reshape(-1)].set(vals.reshape(-1))
+    ))(flat, idx, x)
+    return single(out.reshape(n, c, oh, ow))
+
+
+@register_op("spp", ref="operators/spp_op.cc")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling: levels 0..ph-1 pool to (2^l)^2 bins each,
+    concatenated channel-wise → [N, C*(4^ph-1)/3]."""
+    x = first(ins, "X")
+    ph = attrs.get("pyramid_height", 2)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(ph):
+        bins = 2 ** level
+        ksize = (int(np.ceil(h / bins)), int(np.ceil(w / bins)))
+        pad = (ksize[0] * bins - h, ksize[1] * bins - w)
+        padding = ((0, 0), (0, 0), (0, pad[0]), (0, pad[1]))
+        window = (1, 1) + ksize
+        if ptype == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, window, padding)
+        else:
+            o = lax.reduce_window(x, 0.0, lax.add, window, window, padding) \
+                / float(ksize[0] * ksize[1])
+        outs.append(o.reshape(n, -1))
+    return single(jnp.concatenate(outs, axis=1))
+
+
+# -- ROI ops -----------------------------------------------------------------
+
+def _roi_batch_ids(ins, num_rois):
+    bid = first(ins, "RoisBatchId")
+    if bid is None:
+        return jnp.zeros((num_rois,), jnp.int32)
+    return bid.reshape(-1).astype(jnp.int32)
+
+
+@register_op("roi_pool", ref="operators/roi_pool_op.cc")
+def _roi_pool(ctx, ins, attrs):
+    """ROIs [R,4] (x1,y1,x2,y2 in image coords) + per-roi batch ids
+    (padded-roi convention replacing the reference's LoD). Max pool each
+    bin; Argmax kept for slot parity with the reference's backward."""
+    x = first(ins, "X")                  # [N, C, H, W]
+    rois = first(ins, "ROIs")
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bids = _roi_batch_ids(ins, r)
+
+    def one_roi(roi, bid):
+        img = x[bid]                     # [C, H, W]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        # per-bin max via masked reduction over the full map (static shape;
+        # maps are small in the detection configs this serves). Bin bounds
+        # follow the reference's overlapping floor/ceil rule
+        # (roi_pool_op.h: hstart=floor(ph*bin_h), hend=ceil((ph+1)*bin_h))
+        # so edge pixels can belong to two bins.
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        out_bins = []
+        for by in range(ph):
+            for bx in range(pw):
+                hs = y1 + jnp.floor(by * bin_h)
+                he = y1 + jnp.ceil((by + 1) * bin_h)
+                ws_ = x1 + jnp.floor(bx * bin_w)
+                we = x1 + jnp.ceil((bx + 1) * bin_w)
+                my = (ys >= hs) & (ys < he)
+                mx = (xs >= ws_) & (xs < we)
+                m = my[:, None] & mx[None, :]
+                masked = jnp.where(m[None], img, -jnp.inf)
+                v = masked.max(axis=(1, 2))
+                out_bins.append(jnp.where(jnp.isfinite(v), v, 0.0))
+        return jnp.stack(out_bins, axis=1).reshape(c, ph, pw)
+
+    out = jax.vmap(one_roi)(rois, bids)
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int32)]}
+
+
+@register_op("roi_align", ref="operators/roi_align_op.cc")
+def _roi_align(ctx, ins, attrs):
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    ratio = attrs.get("sampling_ratio", -1)
+    if ratio <= 0:
+        # the reference adapts per-roi: ceil(roi_size/pooled_size) samples
+        # (roi_align_op.h); roi sizes are runtime values, so under static
+        # shapes we bound them by the full feature map — capped to keep the
+        # sample grid reasonable. Documented TPU divergence: very large ROIs
+        # get at most 8x8 samples per bin instead of the exact count.
+        n_, c_, h_, w_ = x.shape
+        ratio = int(min(8, max(1, np.ceil(max(h_ / ph, w_ / pw)))))
+    r = rois.shape[0]
+    bids = _roi_batch_ids(ins, r)
+
+    def one_roi(roi, bid):
+        img = x[bid]
+        x1, y1, x2, y2 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # ratio x ratio samples per bin, averaged
+        iy = (jnp.arange(ph * ratio) + 0.5) / ratio          # in bin-h units
+        ix = (jnp.arange(pw * ratio) + 0.5) / ratio
+        py = y1 + iy * bin_h                                  # [ph*ratio]
+        px = x1 + ix * bin_w                                  # [pw*ratio]
+        gy, gx = jnp.meshgrid(py, px, indexing="ij")
+        samples = _bilinear_sample(img, gx, gy)               # [C, ph*r, pw*r]
+        c = img.shape[0]
+        return samples.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
+
+    return single(jax.vmap(one_roi)(rois, bids))
+
+
+@register_op("psroi_pool", ref="operators/psroi_pool_op.cc")
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pooling (R-FCN): input channels are
+    output_channels*ph*pw; bin (i,j) reads channel group (i*pw+j)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    oc = attrs.get("output_channels", x.shape[1] // (ph * pw))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bids = _roi_batch_ids(ins, r)
+
+    def one_roi(roi, bid):
+        img = x[bid]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale) + 1.0
+        y2 = jnp.round(roi[3] * scale) + 1.0
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        yb = jnp.floor((ys - y1) / bin_h)
+        xb = jnp.floor((xs - x1) / bin_w)
+        outs = []
+        for by in range(ph):
+            for bx in range(pw):
+                m = ((yb == by)[:, None] & (xb == bx)[None, :]).astype(x.dtype)
+                # channel-major group layout: output channel cc, bin (by,bx)
+                # reads input channel (cc*ph + by)*pw + bx (psroi_pool_op.h)
+                chan_idx = (jnp.arange(oc) * ph + by) * pw + bx
+                grp = img[chan_idx]                      # [oc, H, W]
+                s = (grp * m[None]).sum(axis=(1, 2))
+                cnt = jnp.maximum(m.sum(), 1.0)
+                outs.append(s / cnt)
+        return jnp.stack(outs, axis=1).reshape(oc, ph, pw)
+
+    return single(jax.vmap(one_roi)(rois, bids))
+
+
+@register_op("roi_perspective_transform", no_grad=True,
+             ref="operators/detection/roi_perspective_transform_op.cc")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Quad ROIs [R,8] (4 corner points clockwise from top-left) warped to a
+    fixed [transformed_height, transformed_width] patch by the inverse
+    homography, bilinear-sampled (OCR text rectification)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")            # [R, 8]
+    scale = attrs.get("spatial_scale", 1.0)
+    th = attrs.get("transformed_height", 8)
+    tw = attrs.get("transformed_width", 8)
+    r = rois.shape[0]
+    bids = _roi_batch_ids(ins, r)
+
+    def homography(quad):
+        # solve for H mapping output corners -> quad corners
+        src = jnp.array([[0.0, 0.0], [tw - 1.0, 0.0],
+                         [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.stack([sx, sy, jnp.float32(1), jnp.float32(0),
+                                   jnp.float32(0), jnp.float32(0),
+                                   -dx * sx, -dx * sy]))
+            rows.append(jnp.stack([jnp.float32(0), jnp.float32(0),
+                                   jnp.float32(0), sx, sy, jnp.float32(1),
+                                   -dy * sx, -dy * sy]))
+        a = jnp.stack(rows)              # [8, 8]
+        b = dst.reshape(-1)              # [8]
+        h8 = jnp.linalg.solve(a + 1e-6 * jnp.eye(8), b)
+        return jnp.concatenate([h8, jnp.ones((1,))]).reshape(3, 3)
+
+    def one_roi(quad, bid):
+        img = x[bid]
+        hm = homography(quad)
+        ys = jnp.arange(th, dtype=jnp.float32)
+        xs = jnp.arange(tw, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], axis=-1) @ hm.T     # [th, tw, 3]
+        px = pts[..., 0] / (pts[..., 2] + 1e-8)
+        py = pts[..., 1] / (pts[..., 2] + 1e-8)
+        return _bilinear_sample(img, px, py)
+
+    return single(jax.vmap(one_roi)(rois, bids))
+
+
+# -- transposed 3D / depthwise-transposed convs ------------------------------
+
+@register_op("conv3d_transpose", ref="operators/conv_transpose_op.cc Conv3DTranspose")
+def _conv3d_transpose(ctx, ins, attrs):
+    from paddle_tpu.ops.nn_ops import conv_transpose_nd
+    x = first(ins, "Input")              # NCDHW
+    w = first(ins, "Filter")             # IODHW
+    k = lambda v, d: list(v) if isinstance(v, (list, tuple)) else [v] * d
+    strides = k(attrs.get("strides", [1, 1, 1]), 3)
+    pads = k(attrs.get("paddings", [0, 0, 0]), 3)
+    dil = k(attrs.get("dilations", [1, 1, 1]), 3)
+    out = conv_transpose_nd(x, w, strides, pads, dil,
+                            attrs.get("groups", 1), 3)
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose",
+             ref="operators/conv_transpose_op.cc (depthwise alias)")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    from paddle_tpu.ops.nn_ops import conv_transpose_nd
+    x = first(ins, "Input")              # [N, C, H, W]
+    w = first(ins, "Filter")             # [C, 1, kh, kw]
+    strides = list(attrs.get("strides", [1, 1]))
+    pads = list(attrs.get("paddings", [0, 0]))
+    dil = list(attrs.get("dilations", [1, 1]))
+    out = conv_transpose_nd(x, w, strides, pads, dil, x.shape[1], 2)
+    return {"Output": [out]}

@@ -57,6 +57,28 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
+def conv_transpose_nd(x, w, strides, pads, dilations, groups, nd):
+    """Fluid-semantics transposed conv (out = (H-1)*s - 2p + d*(k-1) + 1):
+    gradient-of-conv formulation — fractionally-strided input (lhs_dilation),
+    spatially flipped kernel, padding d*(k-1)-p. w layout [Cin, Cout/G, *k]
+    (conv_transpose_op.cc filter layout); validated numerically against
+    torch.conv_transpose{2,3}d incl. groups/dilation. Do NOT use
+    lax.conv_transpose: its explicit-padding semantics differ and it does
+    not flip the kernel."""
+    cin, coutg = w.shape[0], w.shape[1]
+    k = w.shape[2:]
+    w = w.reshape((groups, cin // groups, coutg) + k)
+    w = jnp.moveaxis(w, 2, 1).reshape((groups * coutg, cin // groups) + k)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    pad_pairs = [(dilations[i] * (k[i] - 1) - pads[i],) * 2 for i in range(nd)]
+    specs = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+             3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad_pairs,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        feature_group_count=groups, dimension_numbers=specs)
+
+
 @register_op("conv2d_transpose", ref="operators/conv_transpose_op.cc")
 def _conv2d_transpose(ctx, ins, attrs):
     x = first(ins, "Input")
@@ -64,13 +86,8 @@ def _conv2d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
-    out = jax.lax.conv_transpose(
-        x, w,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-    )
+    out = conv_transpose_nd(x, w, strides, pads, dilations,
+                            attrs.get("groups", 1), 2)
     return {"Output": [out]}
 
 

@@ -1,0 +1,237 @@
+"""Image-op batch tests (reference OpTest files: test_bilinear_interp_op.py,
+test_nearest_interp_op.py, test_affine_channel_op.py, test_affine_grid_op.py,
+test_grid_sampler_op.py, test_unpool_op.py, test_spp_op.py,
+test_pool_max_op.py, test_roi_pool_op.py, test_roi_align_op.py,
+test_psroi_pool_op.py, test_conv3d_transpose_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(*shape, seed=0, lo=0.1, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def test_bilinear_interp_identity():
+    x = _r(1, 2, 4, 4)
+    out = run_single_op("bilinear_interp", {"X": {"x": x}},
+                        attrs={"out_h": 4, "out_w": 4})
+    np.testing.assert_allclose(out["__out_Out_0"], x, rtol=1e-5)
+
+
+def test_bilinear_interp_upsample_corners():
+    x = _r(1, 1, 2, 2)
+    out = run_single_op("bilinear_interp", {"X": {"x": x}},
+                        attrs={"out_h": 4, "out_w": 4})["__out_Out_0"]
+    # align-corners: the four corners are preserved exactly
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, -1, -1], x[0, 0, -1, -1], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 0, -1], x[0, 0, 0, -1], rtol=1e-6)
+
+
+def test_nearest_interp():
+    x = _r(1, 1, 2, 2)
+    out = run_single_op("nearest_interp", {"X": {"x": x}},
+                        attrs={"out_h": 4, "out_w": 4})["__out_Out_0"]
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, 0, 0])
+
+
+def test_affine_channel():
+    x = _r(2, 3, 4, 4)
+    s = _r(3, seed=1)
+    b = _r(3, seed=2)
+    out = run_single_op("affine_channel",
+                        {"X": {"x": x}, "Scale": {"s": s}, "Bias": {"b": b}})
+    np.testing.assert_allclose(
+        out["__out_Out_0"], x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-5)
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (2, 1, 1))
+    out = run_single_op("affine_grid", {"Theta": {"t": theta}},
+                        attrs={"output_shape": [2, 1, 3, 3]})["__out_Out_0"]
+    assert out.shape == (2, 3, 3, 2)
+    np.testing.assert_allclose(out[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(out[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_grid_sampler_identity():
+    x = _r(1, 2, 5, 5)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+    out = run_single_op("grid_sampler", {"X": {"x": x}, "Grid": {"g": grid}},
+                        out_slots=("Output",))
+    np.testing.assert_allclose(out["__out_Output_0"], x, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    x = _r(1, 1, 4, 4, lo=-1.0)
+    pooled = run_single_op("max_pool2d_with_index", {"X": {"x": x}},
+                           attrs={"ksize": [2, 2], "strides": [2, 2]},
+                           out_slots=("Out", "Mask"))
+    out, mask = pooled["__out_Out_0"], pooled["__out_Mask_0"]
+    assert out.shape == (1, 1, 2, 2) and mask.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], x[0, 0].reshape(2, 2, 2, 2)
+                               .transpose(0, 2, 1, 3).reshape(2, 2, 4)
+                               .max(-1).reshape(2, 2), rtol=1e-6)
+    unp = run_single_op("unpool",
+                        {"X": {"x": out}, "Indices": {"i": mask}},
+                        attrs={"ksize": [2, 2], "strides": [2, 2],
+                               "unpooled_height": 4, "unpooled_width": 4})
+    got = unp["__out_Out_0"]
+    # each max value lands back at its source position
+    assert got.shape == x.shape
+    np.testing.assert_allclose(got.max(), x.max(), rtol=1e-6)
+    assert (got != 0).sum() == 4
+
+
+def test_spp_shape():
+    x = _r(2, 3, 8, 8)
+    out = run_single_op("spp", {"X": {"x": x}},
+                        attrs={"pyramid_height": 2})["__out_Out_0"]
+    assert out.shape == (2, 3 * (1 + 4))
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 1, 1], [2, 2, 3, 3]], np.float32)
+    out = run_single_op("roi_pool", {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"pooled_height": 1, "pooled_width": 1,
+                               "spatial_scale": 1.0},
+                        out_slots=("Out", "Argmax"))["__out_Out_0"]
+    np.testing.assert_allclose(out.reshape(2), [5.0, 15.0])
+
+
+def test_roi_align_center():
+    x = np.ones((1, 1, 4, 4), np.float32) * 3.0
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    out = run_single_op("roi_align", {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"pooled_height": 2, "pooled_width": 2,
+                               "spatial_scale": 1.0})["__out_Out_0"]
+    np.testing.assert_allclose(out, np.full((1, 1, 2, 2), 3.0), rtol=1e-5)
+
+
+def test_psroi_pool():
+    # C = oc(1) * ph(2) * pw(2) = 4 channels
+    x = _r(1, 4, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    out = run_single_op("psroi_pool", {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"pooled_height": 2, "pooled_width": 2,
+                               "output_channels": 1,
+                               "spatial_scale": 1.0})["__out_Out_0"]
+    assert out.shape == (1, 1, 2, 2)
+    # bin (0,0) averages channel 0 over the top-left quadrant
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :2, :2].mean(),
+                               rtol=1e-5)
+
+
+def test_roi_perspective_transform_axis_aligned():
+    x = _r(1, 1, 6, 6)
+    # axis-aligned quad == crop: corners (1,1),(4,1),(4,4),(1,4)
+    rois = np.array([[1, 1, 4, 1, 4, 4, 1, 4]], np.float32)
+    out = run_single_op("roi_perspective_transform",
+                        {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"transformed_height": 4,
+                               "transformed_width": 4,
+                               "spatial_scale": 1.0})["__out_Out_0"]
+    np.testing.assert_allclose(out[0, 0], x[0, 0, 1:5, 1:5], rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_conv3d_transpose_shape():
+    x = _r(1, 2, 3, 3, 3)
+    w = _r(2, 3, 2, 2, 2, seed=1)       # IODHW
+    out = run_single_op("conv3d_transpose",
+                        {"Input": {"x": x}, "Filter": {"w": w}},
+                        attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0]},
+                        out_slots=("Output",))
+    assert out["__out_Output_0"].shape == (1, 3, 4, 4, 4)
+
+
+def test_depthwise_conv2d_transpose():
+    x = _r(2, 3, 4, 4)
+    w = _r(3, 1, 2, 2, seed=1)
+    out = run_single_op("depthwise_conv2d_transpose",
+                        {"Input": {"x": x}, "Filter": {"w": w}},
+                        attrs={"strides": [2, 2], "paddings": [0, 0]},
+                        out_slots=("Output",))["__out_Output_0"]
+    assert out.shape == (2, 3, 8, 8)
+
+
+# -- gradients ---------------------------------------------------------------
+
+def test_grad_bilinear_interp():
+    check_grad("bilinear_interp", {"X": {"x": _r(1, 1, 3, 3)}},
+               attrs={"out_h": 5, "out_w": 5})
+
+
+def test_grad_affine_channel():
+    check_grad("affine_channel",
+               {"X": {"x": _r(1, 2, 3, 3)}, "Scale": {"s": _r(2, seed=1)},
+                "Bias": {"b": _r(2, seed=2)}})
+
+
+def test_grad_grid_sampler():
+    ys, xs = np.meshgrid(np.linspace(-0.8, 0.8, 3),
+                         np.linspace(-0.8, 0.8, 3), indexing="ij")
+    grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+    check_grad("grid_sampler",
+               {"X": {"x": _r(1, 1, 4, 4)}, "Grid": {"g": grid}},
+               out_slot="Output", grad_vars=["x"])
+
+
+def test_grad_roi_align():
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    check_grad("roi_align",
+               {"X": {"x": _r(1, 1, 4, 4)}, "ROIs": {"r": rois}},
+               attrs={"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0},
+               grad_vars=["x"])
+
+
+def test_grad_spp():
+    check_grad("spp", {"X": {"x": _r(1, 2, 4, 4, lo=-1.0)}},
+               attrs={"pyramid_height": 2})
+
+
+def test_grad_conv3d_transpose():
+    check_grad("conv3d_transpose",
+               {"Input": {"x": _r(1, 1, 2, 2, 2)},
+                "Filter": {"w": _r(1, 1, 2, 2, 2, seed=1)}},
+               out_slot="Output", rtol=2e-2)
+
+
+def test_roi_pool_overlapping_bins():
+    # roi 3x3 pooled to 2x2: middle row/col belongs to both bins
+    # (reference floor/ceil bin bounds, roi_pool_op.h)
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 1, 1] = 9.0
+    rois = np.array([[0, 0, 2, 2]], np.float32)
+    out = run_single_op("roi_pool", {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"pooled_height": 2, "pooled_width": 2,
+                               "spatial_scale": 1.0},
+                        out_slots=("Out", "Argmax"))["__out_Out_0"]
+    np.testing.assert_allclose(out[0, 0], np.full((2, 2), 9.0))
+
+
+def test_psroi_pool_channel_major_layout():
+    # oc=2, ph=pw=2: output channel c bin (by,bx) reads input channel
+    # (c*ph+by)*pw+bx (psroi_pool_op.h)
+    x = np.zeros((1, 8, 4, 4), np.float32)
+    for ch in range(8):
+        x[0, ch] = np.arange(16).reshape(4, 4) + ch * 16
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    out = run_single_op("psroi_pool", {"X": {"x": x}, "ROIs": {"r": rois}},
+                        attrs={"pooled_height": 2, "pooled_width": 2,
+                               "output_channels": 2,
+                               "spatial_scale": 1.0})["__out_Out_0"]
+    expect = np.array([[[2.5, 20.5], [42.5, 60.5]],
+                       [[66.5, 84.5], [106.5, 124.5]]], np.float32)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-5)
