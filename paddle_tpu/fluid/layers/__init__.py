@@ -38,3 +38,9 @@ from paddle_tpu.fluid.layers.ops import (  # noqa: F401
     tanh_shrink, selu, hard_shrink, soft_shrink, softshrink,
     thresholded_relu, brelu, stanh, maxout, flatten, space_to_depth,
     l1_norm)
+from paddle_tpu.fluid.layers import detection  # noqa: F401
+from paddle_tpu.fluid.layers.detection import (  # noqa: F401
+    anchor_generator, bipartite_match, box_coder, density_prior_box,
+    detection_map, detection_output, generate_proposals, iou_similarity,
+    mine_hard_examples, multiclass_nms, polygon_box_transform, prior_box,
+    rpn_target_assign, ssd_loss, target_assign, yolov3_loss)

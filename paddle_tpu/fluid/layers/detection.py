@@ -1,0 +1,310 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py —
+prior_box, density_prior_box, multi_box_head, bipartite_match, target_assign,
+detection_output, ssd_loss, detection_map, anchor_generator,
+generate_proposals, rpn_target_assign, iou_similarity, box_coder,
+polygon_box_transform, roi_perspective_transform).
+
+Padded-batch convention: ground truth arrives as dense [B, G, ...] tensors
+(pad label -1 / zero boxes) instead of the reference's LoD; see
+paddle_tpu/ops/detection_ops.py header."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def _out(helper, dtype="float32"):
+    return helper.create_variable_for_type_inference(dtype)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        "prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset,
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes, var = _out(helper), _out(helper)
+    helper.append_op(
+        "density_prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+               "fixed_ratios": list(fixed_ratios), "variances": list(variance),
+               "clip": clip, "step_w": steps[0], "step_h": steps[1],
+               "offset": offset})
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors, var = _out(helper), _out(helper)
+    helper.append_op(
+        "anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": list(anchor_sizes),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "stride": list(stride),
+               "offset": offset})
+    return anchors, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = _out(helper)
+    helper.append_op("iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = _out(helper)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = _out(helper, "int32")
+    dist = _out(helper)
+    helper.append_op("bipartite_match", inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [idx],
+                              "ColToRowMatchDist": [dist]},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_mask=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = _out(helper, input.dtype)
+    w = _out(helper)
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_mask is not None:
+        inputs["NegMask"] = [negative_mask]
+    helper.append_op("target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [w]},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, w
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=0):
+    helper = LayerHelper("mine_hard_examples")
+    neg_mask = _out(helper, "int32")
+    upd = _out(helper, "int32")
+    inputs = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+              "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        inputs["LocLoss"] = [loc_loss]
+    helper.append_op("mine_hard_examples", inputs=inputs,
+                     outputs={"NegMask": [neg_mask],
+                              "UpdatedMatchIndices": [upd]},
+                     attrs={"neg_pos_ratio": neg_pos_ratio,
+                            "neg_dist_threshold": neg_dist_threshold,
+                            "mining_type": mining_type,
+                            "sample_size": sample_size})
+    return neg_mask, upd
+
+
+def multiclass_nms(bboxes, scores, background_label=0, score_threshold=0.0,
+                   nms_top_k=400, nms_threshold=0.3, keep_top_k=200,
+                   normalized=True, nms_eta=1.0, name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _out(helper)
+    helper.append_op("multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out]},
+                     attrs={"background_label": background_label,
+                            "score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "nms_threshold": nms_threshold,
+                            "keep_top_k": keep_top_k,
+                            "normalized": normalized, "nms_eta": nms_eta})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference: layers/detection.py detection_output — decode then NMS.
+    loc [B, M, 4] predicted offsets, scores [B, M, C] (softmax applied
+    here), prior_box [M, 4]."""
+    from paddle_tpu.fluid.layers import nn
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    sm = nn.softmax(scores)                      # softmax over last dim
+    perm = nn.transpose(sm, [0, 2, 1])           # [B, C, M]
+    return multiclass_nms(decoded, perm, background_label=background_label,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+                          keep_top_k=keep_top_k, nms_eta=nms_eta)
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _out(helper)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, ap_version="integral"):
+    helper = LayerHelper("detection_map")
+    out = _out(helper)
+    helper.append_op("detection_map",
+                     inputs={"DetectRes": [detect_res], "Label": [label]},
+                     outputs={"MAP": [out]},
+                     attrs={"class_num": class_num,
+                            "background_label": background_label,
+                            "overlap_threshold": overlap_threshold,
+                            "ap_type": ap_version})
+    return out
+
+
+def rpn_target_assign(anchor_box, gt_boxes, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3):
+    helper = LayerHelper("rpn_target_assign")
+    score_idx = _out(helper, "int32")
+    tgt_box = _out(helper)
+    loc_idx = _out(helper, "int32")
+    tgt_lbl = _out(helper, "int32")
+    helper.append_op("rpn_target_assign",
+                     inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes]},
+                     outputs={"ScoreIndex": [score_idx],
+                              "TargetBBox": [tgt_box],
+                              "LocationIndex": [loc_idx],
+                              "TargetLabel": [tgt_lbl]},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap})
+    return score_idx, tgt_box, loc_idx, tgt_lbl
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1):
+    helper = LayerHelper("generate_proposals")
+    rois = _out(helper)
+    probs = _out(helper)
+    helper.append_op("generate_proposals",
+                     inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                             "ImInfo": [im_info], "Anchors": [anchors],
+                             "Variances": [variances]},
+                     outputs={"RpnRois": [rois], "RpnRoiProbs": [probs]},
+                     attrs={"pre_nms_topN": pre_nms_top_n,
+                            "post_nms_topN": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, class_num, ignore_thresh=0.7,
+                downsample_ratio=32, name=None):
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = _out(helper)
+    helper.append_op("yolov3_loss",
+                     inputs={"X": [x], "GTBox": [gt_box],
+                             "GTLabel": [gt_label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"anchors": list(anchors), "class_num": class_num,
+                            "ignore_thresh": ignore_thresh,
+                            "downsample_ratio": downsample_ratio})
+    return loss
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """reference: layers/detection.py ssd_loss — the composed SSD training
+    objective: match priors to gt (per-prediction bipartite match), mine
+    hard negatives, encode box targets, smooth-L1 loc loss + softmax conf
+    loss, normalized by the matched-prior count.
+
+    Padded-batch convention: gt_box [B, G, 4] (zero rows pad),
+    gt_label [B, G, 1] (-1 pad); location [B, M, 4]; confidence [B, M, C];
+    prior_box [M, 4]. Returns a scalar loss (the reference returns the
+    per-prior loss tensor; callers invariably reduce it)."""
+    from paddle_tpu.fluid import layers as L
+
+    # 1. IoU of gt vs priors per batch: [B, G, M]
+    similarity = iou_similarity(gt_box, prior_box)
+    # 2. match priors to gt rows
+    matched_idx, matched_dist = bipartite_match(similarity, "per_prediction",
+                                                overlap_threshold)
+    # 3. per-prior labels with current matches (background where unmatched)
+    gt_label_f = L.cast(gt_label, "float32")
+    lbl_for_prior, _ = target_assign(gt_label_f, matched_idx,
+                                     mismatch_value=background_label)
+    conf_loss = L.squeeze(L.softmax_with_cross_entropy(
+        confidence, L.cast(lbl_for_prior, "int64")), [2])     # [B, M]
+    # 4. mine hard negatives on that conf loss
+    neg_mask, _ = mine_hard_examples(
+        conf_loss, matched_idx, matched_dist, neg_pos_ratio=neg_pos_ratio,
+        neg_dist_threshold=neg_overlap, mining_type=mining_type,
+        sample_size=sample_size or 0)
+    # 5. final conf targets: negatives forced to background, weight 1 on
+    # positives + mined negatives
+    target_lbl, target_lbl_w = target_assign(
+        gt_label_f, matched_idx, negative_mask=neg_mask,
+        mismatch_value=background_label)
+    conf_loss = L.squeeze(L.softmax_with_cross_entropy(
+        confidence, L.cast(target_lbl, "int64")), [2])        # [B, M]
+    conf_loss = L.elementwise_mul(conf_loss, L.squeeze(target_lbl_w, [2]))
+    # 6. loc targets: gather matched gt corners per prior, encode vs priors
+    loc_tgt, loc_w = target_assign(gt_box, matched_idx, mismatch_value=0)
+    loc_tgt_enc = box_coder(prior_box, prior_box_var, loc_tgt,
+                            code_type="encode_center_size")   # [B, M, 4]
+    # per-element smooth-L1 (sigma=1): 0.5*m^2 + (|d| - m), m = min(|d|, 1)
+    absd = L.abs(L.elementwise_sub(location, loc_tgt_enc))
+    m = L.elementwise_min(absd, L.fill_constant([1], "float32", 1.0))
+    sl1 = L.elementwise_add(L.scale(L.elementwise_mul(m, m), scale=0.5),
+                            L.elementwise_sub(absd, m))
+    l1 = L.reduce_sum(sl1, dim=[2])                           # [B, M]
+    l1 = L.elementwise_mul(l1, L.squeeze(loc_w, [2]))
+    # 7. combine + normalize by positive count
+    total = L.elementwise_add(
+        L.scale(L.reduce_sum(l1), scale=loc_loss_weight),
+        L.scale(L.reduce_sum(conf_loss), scale=conf_loss_weight))
+    if normalize:
+        pos = L.cast(L.greater_equal(
+            L.cast(matched_idx, "float32"),
+            L.fill_constant([1], "float32", 0.0)), "float32")
+        denom = L.elementwise_max(L.reduce_sum(pos),
+                                  L.fill_constant([1], "float32", 1.0))
+        total = L.elementwise_div(total, denom)
+    return total

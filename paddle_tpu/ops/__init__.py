@@ -15,3 +15,5 @@ from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import beam_ops  # noqa: F401
 from paddle_tpu.ops import misc_ops  # noqa: F401
 from paddle_tpu.ops import image_ops  # noqa: F401
+from paddle_tpu.ops import detection_ops  # noqa: F401
+from paddle_tpu.ops import rpn_ops  # noqa: F401

@@ -1,0 +1,544 @@
+"""Detection op suite (reference: paddle/fluid/operators/detection/ —
+prior_box_op.h, density_prior_box_op.h, anchor_generator_op.h,
+box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc,
+target_assign_op.h, mine_hard_examples_op.cc, multiclass_nms_op.cc,
+polygon_box_transform_op.cc, generate_proposals_op.cc,
+rpn_target_assign_op.cc; operators/detection_map_op.cc).
+
+TPU static-shape redesign of the reference's LoD conventions:
+
+- Ground-truth boxes arrive PADDED per batch: GtBox [B, G, 4] with invalid
+  rows marked by a negative label / zero box (the reference packs a ragged
+  LoD tensor). Ops take dense [B, ...] inputs and emit dense outputs with
+  sentinel -1 indices, so shapes are compile-time constant and XLA can tile
+  everything onto the VPU.
+- multiclass_nms emits a FIXED [B, keep_top_k, 6] tensor padded with -1
+  labels (the reference emits a ragged LoD result). Greedy NMS runs as a
+  lax.fori_loop over the top-k candidates — O(k^2) IoU matrix, which for
+  k<=400 is a small VPU-friendly matmul-shaped workload.
+- mine_hard_examples emits a dense negative MASK [B, M] rather than the
+  reference's LoD NegIndices list; target_assign consumes that mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op, single
+
+
+def _expand_aspect_ratios(ars, flip):
+    """reference: prior_box_op.h:25 ExpandAspectRatios."""
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+@register_op("prior_box", no_grad=True,
+             ref="operators/detection/prior_box_op.h:100 PriorBoxOpKernel")
+def _prior_box(ctx, ins, attrs):
+    x = first(ins, "Input")              # [N, C, H, W] feature map
+    img = first(ins, "Image")            # [N, 3, IH, IW]
+    min_sizes = [float(v) for v in attrs["min_sizes"]]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                                attrs.get("flip", False))
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    clip = attrs.get("clip", False)
+    offset = attrs.get("offset", 0.5)
+    mm_order = attrs.get("min_max_aspect_ratios_order", False)
+
+    fh, fw = x.shape[2], x.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+
+    # per-cell prior (w, h) list in the reference's emission order
+    whs = []
+    for s, mn in enumerate(min_sizes):
+        if mm_order:
+            whs.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = np.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * np.sqrt(ar) / 2.0, mn / np.sqrt(ar) / 2.0))
+        else:
+            for ar in ars:
+                whs.append((mn * np.sqrt(ar) / 2.0, mn / np.sqrt(ar) / 2.0))
+            if max_sizes:
+                m = np.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+    whs = np.asarray(whs, np.float32)    # [P, 2]
+    p = whs.shape[0]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h   # [H]
+    gcx = jnp.broadcast_to(cx[None, :, None], (fh, fw, p))
+    gcy = jnp.broadcast_to(cy[:, None, None], (fh, fw, p))
+    bw = jnp.asarray(whs[:, 0])[None, None, :]
+    bh = jnp.asarray(whs[:, 1])[None, None, :]
+    boxes = jnp.stack([(gcx - bw) / iw, (gcy - bh) / ih,
+                       (gcx + bw) / iw, (gcy + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype),
+                           (fh, fw, p, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("density_prior_box", no_grad=True,
+             ref="operators/detection/density_prior_box_op.h")
+def _density_prior_box(ctx, ins, attrs):
+    """Dense grid of fixed-size priors: for each fixed_size with density d,
+    d*d shifted centers per cell per fixed_ratio."""
+    x = first(ins, "Input")
+    img = first(ins, "Image")
+    fixed_sizes = [float(v) for v in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(v) for v in attrs.get("densities", [1])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    clip = attrs.get("clip", False)
+    offset = attrs.get("offset", 0.5)
+    fh, fw = x.shape[2], x.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or iw / fw
+    step_h = attrs.get("step_h", 0.0) or ih / fh
+
+    # per-cell (dx, dy, w/2, h/2) offsets, reference emission order:
+    # for each density/fixed_size: for each ratio: d*d shifted boxes
+    entries = []
+    for k, fs in enumerate(fixed_sizes):
+        d = densities[k]
+        shift_w = step_w / d
+        shift_h = step_h / d
+        for ar in fixed_ratios:
+            bw = fs * np.sqrt(ar) / 2.0
+            bh = fs / np.sqrt(ar) / 2.0
+            for di in range(d):
+                for dj in range(d):
+                    cx_off = shift_w / 2.0 + dj * shift_w - step_w * offset
+                    cy_off = shift_h / 2.0 + di * shift_h - step_h * offset
+                    entries.append((cx_off, cy_off, bw, bh))
+    entries = np.asarray(entries, np.float32)     # [P, 4]
+    p = entries.shape[0]
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    gcx = cx[None, :, None] + jnp.asarray(entries[:, 0])[None, None, :]
+    gcy = cy[:, None, None] + jnp.asarray(entries[:, 1])[None, None, :]
+    gcx = jnp.broadcast_to(gcx, (fh, fw, p))
+    gcy = jnp.broadcast_to(gcy, (fh, fw, p))
+    bw = jnp.asarray(entries[:, 2])[None, None, :]
+    bh = jnp.asarray(entries[:, 3])[None, None, :]
+    boxes = jnp.stack([(gcx - bw) / iw, (gcy - bh) / ih,
+                       (gcx + bw) / iw, (gcy + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype),
+                           (fh, fw, p, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("anchor_generator", no_grad=True,
+             ref="operators/detection/anchor_generator_op.h:40")
+def _anchor_generator(ctx, ins, attrs):
+    x = first(ins, "Input")
+    sizes = [float(v) for v in attrs["anchor_sizes"]]
+    ars = [float(v) for v in attrs.get("aspect_ratios", [1.0])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in attrs.get("stride", [16.0, 16.0])]
+    offset = attrs.get("offset", 0.5)
+    fh, fw = x.shape[2], x.shape[3]
+    sw, sh = stride[0], stride[1]
+
+    whs = []
+    for ar in ars:
+        for sz in sizes:
+            area = sw * sh
+            base_w = np.round(np.sqrt(area / ar))
+            base_h = np.round(base_w * ar)
+            whs.append((sz / sw * base_w, sz / sh * base_h))
+    whs = np.asarray(whs, np.float32)
+    a = whs.shape[0]
+
+    cx = jnp.arange(fw, dtype=jnp.float32) * sw + offset * (sw - 1)
+    cy = jnp.arange(fh, dtype=jnp.float32) * sh + offset * (sh - 1)
+    gcx = jnp.broadcast_to(cx[None, :, None], (fh, fw, a))
+    gcy = jnp.broadcast_to(cy[:, None, None], (fh, fw, a))
+    aw = jnp.asarray(whs[:, 0])[None, None, :]
+    ah = jnp.asarray(whs[:, 1])[None, None, :]
+    anchors = jnp.stack([gcx - 0.5 * (aw - 1), gcy - 0.5 * (ah - 1),
+                         gcx + 0.5 * (aw - 1), gcy + 0.5 * (ah - 1)],
+                        axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, anchors.dtype),
+                           (fh, fw, a, 4))
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+def _center_size(boxes, normalized):
+    add = 0.0 if normalized else 1.0
+    w = boxes[..., 2] - boxes[..., 0] + add
+    h = boxes[..., 3] - boxes[..., 1] + add
+    cx = (boxes[..., 2] + boxes[..., 0]) / 2.0
+    cy = (boxes[..., 3] + boxes[..., 1]) / 2.0
+    return cx, cy, w, h
+
+
+@register_op("box_coder", ref="operators/detection/box_coder_op.h:34,89")
+def _box_coder(ctx, ins, attrs):
+    prior = first(ins, "PriorBox")       # [M, 4]
+    pvar = first(ins, "PriorBoxVar")     # [M, 4] or None
+    target = first(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    pcx, pcy, pw, ph = _center_size(prior, normalized)
+
+    if code_type == "encode_center_size":
+        if target.ndim == 3:
+            # paired encode: target [B, M, 4] already aligned one-to-one
+            # with the M priors (ssd_loss's gathered gt targets) — the
+            # static-shape variant of the reference's row-gather encode
+            tcx, tcy, tw, th = _center_size(target, normalized)
+            ox = (tcx - pcx[None, :]) / pw[None, :]
+            oy = (tcy - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.maximum(jnp.abs(tw / pw[None, :]), 1e-9))
+            oh = jnp.log(jnp.maximum(jnp.abs(th / ph[None, :]), 1e-9))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if pvar is not None:
+                out = out / pvar[None, :, :]
+            return {"OutputBox": [out]}
+        # target [N, 4] -> out [N, M, 4]
+        tcx, tcy, tw, th = _center_size(target, normalized)
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:
+        # decode: target [N, M, 4] deltas -> boxes
+        t = target
+        if pvar is not None:
+            t = t * pvar[None, :, :]
+        dcx = t[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = t[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(t[..., 2]) * pw[None, :]
+        dh = jnp.exp(t[..., 3]) * ph[None, :]
+        sub = 0.0 if normalized else 1.0
+        out = jnp.stack([dcx - dw / 2.0, dcy - dh / 2.0,
+                         dcx + dw / 2.0 - sub, dcy + dh / 2.0 - sub],
+                        axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b, normalized=True):
+    """a [N,4], b [M,4] -> IoU [N,M] (iou_similarity_op.h semantics)."""
+    add = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + add, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + add, 0.0)
+    inter = iw * ih
+    aa = (ax2 - ax1 + add) * (ay2 - ay1 + add)
+    ab = (bx2 - bx1 + add) * (by2 - by1 + add)
+    union = aa[:, None] + ab[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", no_grad=True,
+             ref="operators/detection/iou_similarity_op.h")
+def _iou_similarity(ctx, ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    if x.ndim == 3:      # batched [B, N, 4]
+        out = jax.vmap(lambda a: _iou_matrix(a, y))(x)
+    else:
+        out = _iou_matrix(x, y)
+    return single(out)
+
+
+@register_op("bipartite_match", no_grad=True,
+             ref="operators/detection/bipartite_match_op.cc BipartiteMatch")
+def _bipartite_match(ctx, ins, attrs):
+    """DistMat [B, N, M] (N gt rows, M priors; batched-padded replacement
+    for the reference's LoD row groups; invalid gt rows must be all-zero).
+    Greedy global-max bipartite matching, then optional per_prediction
+    fill-in for unmatched columns above dist_threshold."""
+    dist = first(ins, "DistMat")
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, n, m = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    thr = attrs.get("dist_threshold", 0.5)
+
+    def one(d):
+        def body(_, state):
+            d_cur, midx, mdist = state
+            flat = jnp.argmax(d_cur)
+            i, j = flat // m, flat % m
+            v = d_cur[i, j]
+            do = v > 0
+            midx = jnp.where(do, midx.at[j].set(i.astype(jnp.int32)), midx)
+            mdist = jnp.where(do, mdist.at[j].set(v), mdist)
+            d_cur = jnp.where(do, d_cur.at[i, :].set(-1.0), d_cur)
+            d_cur = jnp.where(do, d_cur.at[:, j].set(-1.0), d_cur)
+            return d_cur, midx, mdist
+
+        midx = jnp.full((m,), -1, jnp.int32)
+        mdist = jnp.zeros((m,), d.dtype)
+        _, midx, mdist = lax.fori_loop(0, min(n, m), body, (d, midx, mdist))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_val = jnp.max(d, axis=0)
+            fill = (midx < 0) & (best_val > thr)
+            midx = jnp.where(fill, best_row, midx)
+            mdist = jnp.where(fill, best_val, mdist)
+        return midx, mdist
+
+    midx, mdist = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [midx], "ColToRowMatchDist": [mdist]}
+
+
+@register_op("target_assign", no_grad=True,
+             ref="operators/detection/target_assign_op.h")
+def _target_assign(ctx, ins, attrs):
+    """X [B, N, K] per-batch entities (padded), MatchIndices [B, M] →
+    Out [B, M, K] gathered by match row (mismatch_value where unmatched),
+    OutWeight [B, M, 1]. NegMask [B, M] (our dense replacement for the
+    reference's LoD NegIndices) forces mismatch_value rows with weight 1."""
+    x = first(ins, "X")
+    if x.ndim == 2:
+        x = x[None]
+    match = first(ins, "MatchIndices")
+    neg_mask = first(ins, "NegMask")
+    mismatch = attrs.get("mismatch_value", 0)
+
+    def one(xb, mb, negb):
+        gathered = xb[jnp.clip(mb, 0, xb.shape[0] - 1)]      # [M, K]
+        matched = (mb >= 0)
+        out = jnp.where(matched[:, None], gathered,
+                        jnp.full_like(gathered, mismatch))
+        w = matched.astype(xb.dtype)
+        if negb is not None:
+            out = jnp.where(negb[:, None] > 0,
+                            jnp.full_like(out, mismatch), out)
+            w = jnp.maximum(w, (negb > 0).astype(xb.dtype))
+        return out, w[:, None]
+
+    if neg_mask is None:
+        out, w = jax.vmap(lambda a, b: one(a, b, None))(x, match)
+    else:
+        out, w = jax.vmap(one)(x, match, neg_mask)
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("mine_hard_examples", no_grad=True,
+             ref="operators/detection/mine_hard_examples_op.cc:29,59")
+def _mine_hard_examples(ctx, ins, attrs):
+    """max_negative mining: for each batch, pick the top-(neg_pos_ratio *
+    num_pos) unmatched priors by classification loss (dist below
+    neg_dist_threshold). Emits a dense NegMask [B, M] plus
+    UpdatedMatchIndices (unchanged matches; kept for slot parity)."""
+    cls_loss = first(ins, "ClsLoss")             # [B, M]
+    loc_loss = first(ins, "LocLoss")             # optional [B, M]
+    match = first(ins, "MatchIndices")           # [B, M]
+    mdist = first(ins, "MatchDist")              # [B, M]
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_thr = attrs.get("neg_dist_threshold", 0.5)
+    mining = attrs.get("mining_type", "max_negative")
+    sample_size = attrs.get("sample_size", 0)
+
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    b, m = loss.shape
+    eligible = (match == -1) & (mdist < neg_thr)
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)     # [B]
+    if mining == "hard_example" and sample_size > 0:
+        num_neg = jnp.full_like(num_pos, sample_size)
+    else:
+        num_neg = (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    neg_loss = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)                         # [B, M]
+    rank = jnp.argsort(order, axis=1)      # inverse permutation = rank
+    neg_mask = (rank < num_neg[:, None]) & eligible
+    return {"NegMask": [neg_mask.astype(jnp.int32)],
+            "UpdatedMatchIndices": [match]}
+
+
+@register_op("multiclass_nms", no_grad=True,
+             ref="operators/detection/multiclass_nms_op.cc")
+def _multiclass_nms(ctx, ins, attrs):
+    """Scores [B, C, M], BBoxes [B, M, 4] → fixed [B, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), padded with label -1 (the static
+    replacement for the reference's ragged LoD output)."""
+    boxes = first(ins, "BBoxes")
+    scores = first(ins, "Scores")
+    bg = attrs.get("background_label", 0)
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_top_k = int(attrs.get("nms_top_k", 100))
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    eta = attrs.get("nms_eta", 1.0)
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    normalized = attrs.get("normalized", True)
+    b, c, m = scores.shape
+    k = min(nms_top_k, m)
+
+    def nms_one_class(cls_scores, cls_boxes):
+        # top-k candidates
+        sc, idx = lax.top_k(cls_scores, k)
+        cand = cls_boxes[idx]                             # [k, 4]
+        iou = _iou_matrix(cand, cand, normalized)
+        valid0 = sc > score_thr
+
+        def body(i, state):
+            keep, thr_cur = state
+            # suppressed if any higher-scoring kept box overlaps > thr
+            mask_prior = (jnp.arange(k) < i) & keep
+            suppressed = jnp.any((iou[i] > thr_cur) & mask_prior)
+            kept_i = valid0[i] & ~suppressed
+            keep = keep.at[i].set(kept_i)
+            # adaptive NMS: decay only after keeping a box while the
+            # threshold is still above 0.5 (multiclass_nms_op.cc NMSFast)
+            decay = (eta < 1.0) & kept_i & (thr_cur > 0.5)
+            thr_next = jnp.where(decay, thr_cur * eta, thr_cur)
+            return keep, thr_next
+
+        keep = jnp.zeros((k,), bool)
+        keep, _ = lax.fori_loop(0, k, body, (keep, jnp.float32(nms_thr)))
+        return jnp.where(keep, sc, -jnp.inf), cand
+
+    def one_batch(sb, bb):
+        all_scores = []
+        all_boxes = []
+        all_labels = []
+        for ci in range(c):
+            if ci == bg:
+                continue
+            s, bx = nms_one_class(sb[ci], bb)
+            all_scores.append(s)
+            all_boxes.append(bx)
+            all_labels.append(jnp.full((k,), ci, jnp.float32))
+        sc = jnp.concatenate(all_scores)                 # [(C-1)*k]
+        bx = jnp.concatenate(all_boxes, axis=0)
+        lb = jnp.concatenate(all_labels)
+        kk = min(keep_top_k, sc.shape[0])
+        top_sc, top_i = lax.top_k(sc, kk)
+        sel_b = bx[top_i]
+        sel_l = jnp.where(jnp.isfinite(top_sc), lb[top_i], -1.0)
+        top_sc = jnp.where(jnp.isfinite(top_sc), top_sc, 0.0)
+        out = jnp.concatenate([sel_l[:, None], top_sc[:, None], sel_b],
+                              axis=1)                    # [kk, 6]
+        if kk < keep_top_k:
+            pad = jnp.full((keep_top_k - kk, 6), -1.0, out.dtype)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out
+
+    return single(jax.vmap(one_batch)(scores, boxes))
+
+
+@register_op("polygon_box_transform", no_grad=True,
+             ref="operators/detection/polygon_box_transform_op.cc:24")
+def _polygon_box_transform(ctx, ins, attrs):
+    """EAST-style geometry map: even channels x-offsets (4*w - in), odd
+    channels y-offsets (4*h - in)."""
+    x = first(ins, "Input")              # [N, 2k, H, W]
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype) * 4.0
+    ys = jnp.arange(h, dtype=x.dtype) * 4.0
+    even = xs[None, None, None, :] - x
+    odd = ys[None, None, :, None] - x
+    is_even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(is_even, even, odd)]}
+
+
+@register_op("detection_map", no_grad=True,
+             ref="operators/detection_map_op.cc")
+def _detection_map(ctx, ins, attrs):
+    """Batch mAP (11-point interpolated or integral) over padded inputs:
+    DetectRes [B, D, 6] (label, score, box; label<0 = pad) and GtLabelBox
+    [B, G, 5] (label, box; label<0 = pad). Stateless single-batch form of
+    the reference's accumulating evaluator (detection_map_op.cc); the
+    python evaluator accumulates across batches."""
+    det = first(ins, "DetectRes")
+    gt = first(ins, "Label")
+    overlap_thr = attrs.get("overlap_threshold", 0.5)
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs["class_num"])
+    bg = attrs.get("background_label", 0)
+
+    b, d, _ = det.shape
+    g = gt.shape[1]
+
+    det_label = det[..., 0]
+    det_score = det[..., 1]
+    det_box = det[..., 2:6]
+    gt_label = gt[..., 0]
+    gt_box = gt[..., 1:5]
+
+    # per-batch IoU of dets vs gts
+    iou = jax.vmap(lambda a, bb: _iou_matrix(a, bb))(det_box, gt_box)
+
+    aps = []
+    for ci in range(class_num):
+        if ci == bg:
+            continue
+        dmask = (det_label == ci)                       # [B, D]
+        gmask = (gt_label == ci)                        # [B, G]
+        npos = jnp.sum(gmask)
+        # flatten dets across batch, sort by score desc
+        flat_scores = jnp.where(dmask, det_score, -jnp.inf).reshape(-1)
+        order = jnp.argsort(-flat_scores)
+        # greedy TP assignment: each det is TP if IoU with an unmatched
+        # same-class gt in its batch > thr. Static approximation: a det is
+        # TP if its best same-class gt IoU > thr AND it is that gt's highest
+        # -scoring det (one TP per gt).
+        iou_c = jnp.where(gmask[:, None, :], iou, 0.0)  # [B, D, G]
+        iou_c = jnp.where(dmask[:, :, None], iou_c, 0.0)
+        best_gt = jnp.argmax(iou_c, axis=2)             # [B, D]
+        best_iou = jnp.max(iou_c, axis=2)
+        # is this det the argmax-scoring det for its matched gt?
+        score_for_gt = jnp.where(
+            (best_iou > overlap_thr),
+            det_score, -jnp.inf)                        # [B, D]
+        onehot = jax.nn.one_hot(best_gt, g) * score_for_gt[..., None]
+        max_per_gt = jnp.max(onehot, axis=1)            # [B, G]
+        is_tp = (best_iou > overlap_thr) & \
+                (jnp.take_along_axis(max_per_gt, best_gt, axis=1)
+                 <= det_score + 1e-9) & dmask
+        flat_tp = is_tp.reshape(-1)[order]
+        flat_valid = jnp.isfinite(flat_scores[order])
+        tp_cum = jnp.cumsum(flat_tp & flat_valid)
+        fp_cum = jnp.cumsum((~flat_tp) & flat_valid)
+        recall = tp_cum / jnp.maximum(npos, 1)
+        precision = tp_cum / jnp.maximum(tp_cum + fp_cum, 1)
+        if ap_type == "11point":
+            pts = [jnp.max(jnp.where(recall >= t, precision, 0.0))
+                   for t in np.arange(0.0, 1.1, 0.1)]
+            ap = jnp.mean(jnp.stack(pts))
+        else:
+            dr = jnp.diff(jnp.concatenate([jnp.zeros(1), recall]))
+            ap = jnp.sum(precision * dr)
+        aps.append(jnp.where(npos > 0, ap, jnp.nan))
+    aps = jnp.stack(aps)
+    valid = jnp.isfinite(aps)
+    m_ap = jnp.sum(jnp.where(valid, aps, 0.0)) / jnp.maximum(
+        jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {"MAP": [m_ap]}

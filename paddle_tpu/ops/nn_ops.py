@@ -350,11 +350,12 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        lab = label.reshape(-1)
-        picked = jnp.take_along_axis(logp, lab[:, None].astype(jnp.int32), axis=-1)
+        # rank-general: label [..., 1] (or [...]) indexes the last logits dim
+        lab = label.astype(jnp.int32).reshape(logp.shape[:-1] + (1,))
+        picked = jnp.take_along_axis(logp, lab, axis=-1)
         loss = -picked
         ignore = attrs.get("ignore_index", -100)
-        loss = jnp.where(label.reshape(-1, 1) == ignore, 0.0, loss)
+        loss = jnp.where(lab == ignore, 0.0, loss)
     return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
 
 

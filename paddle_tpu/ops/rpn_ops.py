@@ -1,0 +1,269 @@
+"""RPN / proposal-generation / YOLO ops (reference:
+operators/detection/generate_proposals_op.cc,
+operators/detection/rpn_target_assign_op.cc,
+operators/detection/generate_proposal_labels_op.cc,
+operators/yolov3_loss_op.cc (1.3-era; present in the reference tree)).
+
+Static-shape redesign: the reference emits ragged proposal lists (LoD);
+here every stage emits fixed-size tensors — top-k selection instead of
+score-threshold filtering, masks instead of index lists, and fixed
+pos/neg sample quotas chosen by ranked random keys instead of
+reservoir sampling."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op, single
+from paddle_tpu.ops.detection_ops import _iou_matrix
+
+
+def _decode_anchor_deltas(anchors, deltas, variances):
+    """anchors [A,4] corner form (unnormalized, +1 sizes per
+    anchor_generator), deltas [A,4] → boxes [A,4]
+    (generate_proposals_op.cc BoxCoder)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    if variances is not None:
+        deltas = deltas * variances
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(deltas[:, 2], np.log(1000.0 / 16))) * aw
+    h = jnp.exp(jnp.minimum(deltas[:, 3], np.log(1000.0 / 16))) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+
+
+@register_op("generate_proposals", no_grad=True,
+             ref="operators/detection/generate_proposals_op.cc")
+def _generate_proposals(ctx, ins, attrs):
+    """Scores [B, A, H, W], BboxDeltas [B, 4A, H, W], Anchors [H, W, A, 4],
+    Variances, ImInfo [B, 3] → RpnRois [B, post_nms_topN, 4] + RpnRoiProbs
+    (fixed-size; unkept slots have prob 0)."""
+    scores = first(ins, "Scores")
+    deltas = first(ins, "BboxDeltas")
+    im_info = first(ins, "ImInfo")
+    anchors = first(ins, "Anchors").reshape(-1, 4)
+    variances = first(ins, "Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = attrs.get("nms_thresh", 0.7)
+    min_size = attrs.get("min_size", 0.0)
+
+    b, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+    post_n = min(post_n, pre_n)
+
+    def one(sc, dl, info):
+        # score layout [A,H,W] -> flat [H*W*A] matching anchors [H,W,A,4]
+        sflat = sc.transpose(1, 2, 0).reshape(-1)
+        dflat = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        top_s, top_i = lax.top_k(sflat, pre_n)
+        boxes = _decode_anchor_deltas(anchors[top_i], dflat[top_i],
+                                      None if variances is None
+                                      else variances[top_i])
+        # clip to image
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        # min_size is in original-image pixels; scale by im_scale
+        # (generate_proposals_op.cc FilterBoxes: min_size * im_info[2])
+        ms = min_size * info[2]
+        valid = (ws >= ms) & (hs >= ms)
+        top_s = jnp.where(valid, top_s, -jnp.inf)
+        # greedy NMS over the pre_n candidates
+        iou = _iou_matrix(boxes, boxes, normalized=False)
+
+        def body(i, keep):
+            prior = (jnp.arange(pre_n) < i) & keep
+            suppressed = jnp.any((iou[i] > nms_thr) & prior)
+            return keep.at[i].set(jnp.isfinite(top_s[i]) & ~suppressed)
+
+        keep = lax.fori_loop(0, pre_n, body, jnp.zeros((pre_n,), bool))
+        kept_s = jnp.where(keep, top_s, -jnp.inf)
+        out_s, out_i = lax.top_k(kept_s, post_n)
+        out_b = boxes[out_i]
+        out_s = jnp.where(jnp.isfinite(out_s), out_s, 0.0)
+        return out_b, out_s
+
+    rois, probs = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs[..., None]]}
+
+
+@register_op("rpn_target_assign", no_grad=True,
+             ref="operators/detection/rpn_target_assign_op.cc")
+def _rpn_target_assign(ctx, ins, attrs):
+    """Anchor [A, 4], GtBoxes [B, G, 4] (zero rows = pad) → per-anchor
+    labels [B, A] (1 pos / 0 neg / -1 ignore) and box targets [B, A, 4].
+    Dense-mask form of the reference's sampled index lists: the fixed
+    pos/neg quotas are enforced by score-ranked truncation with the
+    deterministic per-step rng as tiebreak."""
+    anchors = first(ins, "Anchor").reshape(-1, 4)
+    gt = first(ins, "GtBoxes")
+    if gt.ndim == 2:
+        gt = gt[None]
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    a = anchors.shape[0]
+    num_fg = int(batch_per_im * fg_frac)
+    key = ctx.step_key()
+
+    def one(gtb, k):
+        valid_gt = jnp.any(gtb != 0, axis=1)
+        iou = _iou_matrix(anchors, gtb, normalized=False)   # [A, G]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # anchors that are argmax for some gt are positive too; use a max-
+        # scatter so a padded gt row (argmax=0, valid=False) can't clobber
+        # a valid gt that also maps to anchor 0
+        best_anchor_per_gt = jnp.argmax(iou, axis=0)        # [G]
+        forced = jnp.zeros((a,), jnp.int32).at[best_anchor_per_gt].max(
+            valid_gt.astype(jnp.int32)) > 0
+        pos = (best_iou >= pos_thr) | forced
+        neg = (best_iou < neg_thr) & ~pos
+        # quota by random ranking
+        rnd = jax.random.uniform(k, (a,))
+        pos_rank_src = jnp.where(pos, rnd, 2.0)
+        pos_rank = jnp.argsort(jnp.argsort(pos_rank_src))
+        pos = pos & (pos_rank < num_fg)
+        n_pos = jnp.sum(pos.astype(jnp.int32))
+        num_bg = batch_per_im - n_pos
+        neg_rank_src = jnp.where(neg, rnd, 2.0)
+        neg_rank = jnp.argsort(jnp.argsort(neg_rank_src))
+        neg = neg & (neg_rank < num_bg)
+        labels = jnp.where(pos, 1, jnp.where(neg, 0, -1))
+        # box targets for positives
+        matched = gtb[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + 0.5 * aw
+        acy = anchors[:, 1] + 0.5 * ah
+        gw = matched[:, 2] - matched[:, 0] + 1.0
+        gh = matched[:, 3] - matched[:, 1] + 1.0
+        gcx = (matched[:, 0] + matched[:, 2]) * 0.5
+        gcy = (matched[:, 1] + matched[:, 3]) * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        return labels.astype(jnp.int32), tgt
+
+    keys = jax.random.split(key, gt.shape[0])
+    labels, targets = jax.vmap(one)(gt, keys)
+    return {"ScoreIndex": [labels], "TargetBBox": [targets],
+            "LocationIndex": [(labels == 1).astype(jnp.int32)],
+            "TargetLabel": [labels]}
+
+
+@register_op("yolov3_loss", ref="operators/yolov3_loss_op.cc (1.3-era)")
+def _yolov3_loss(ctx, ins, attrs):
+    """X [B, A*(5+C), H, W], GTBox [B, G, 4] (cx, cy, w, h normalized),
+    GTLabel [B, G] (-1 pad). Per-cell responsible-anchor assignment, with
+    objectness/noobj BCE, xywh loss, class BCE — the reference's per-gt
+    loops become dense one-hot scatters."""
+    x = first(ins, "X")
+    gt_box = first(ins, "GTBox")
+    gt_label = first(ins, "GTLabel")
+    anchors = [float(v) for v in attrs["anchors"]]       # flat [2A]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    b, cdim, h, w = x.shape
+    a = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(a, 2))
+    x5 = x.reshape(b, a, 5 + class_num, h, w)
+    tx, ty = x5[:, :, 0], x5[:, :, 1]
+    tw, th = x5[:, :, 2], x5[:, :, 3]
+    tobj = x5[:, :, 4]
+    tcls = x5[:, :, 5:]
+
+    g = gt_box.shape[1]
+    valid = gt_label >= 0                                  # [B, G]
+    gx = gt_box[..., 0] * w                                # in grid units
+    gy = gt_box[..., 1] * h
+    gw = gt_box[..., 2] * w
+    gh = gt_box[..., 3] * h
+    gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+    # responsible anchor: max shape-only IoU of (w,h) with anchor shapes.
+    # anchors are given in input-image pixels; grid units = pixels /
+    # downsample_ratio (reference attr, default 32)
+    anc_g = anc / float(attrs.get("downsample_ratio", 32))
+    aw = anc_g[None, None, :, 0]
+    ah = anc_g[None, None, :, 1]
+    iw = jnp.minimum(gw[..., None], aw)
+    ih = jnp.minimum(gh[..., None], ah)
+    inter = iw * ih
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    shape_iou = inter / jnp.maximum(union, 1e-9)           # [B, G, A]
+    best_a = jnp.argmax(shape_iou, axis=2)                 # [B, G]
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def one(txb, tyb, twb, thb, tobjb, tclsb, gxb, gyb, gwb, ghb,
+            gib, gjb, bab, vb, glb):
+        # scatter gt targets into [A, H, W] maps
+        obj_t = jnp.zeros((a, h, w))
+        loss = 0.0
+        for gidx in range(g):
+            va = vb[gidx]
+            ai, yj, xi = bab[gidx], gjb[gidx], gib[gidx]
+            sx = gxb[gidx] - gib[gidx]
+            sy = gyb[gidx] - gjb[gidx]
+            swt = jnp.log(jnp.maximum(gwb[gidx], 1e-9) /
+                          anc_g[ai, 0])
+            sht = jnp.log(jnp.maximum(ghb[gidx], 1e-9) / anc_g[ai, 1])
+            scale = 2.0 - gwb[gidx] * ghb[gidx] / (h * w)
+            lx = bce(txb[ai, yj, xi], sx) * scale
+            ly = bce(tyb[ai, yj, xi], sy) * scale
+            lw = jnp.abs(twb[ai, yj, xi] - swt) * scale
+            lh = jnp.abs(thb[ai, yj, xi] - sht) * scale
+            lobj = bce(tobjb[ai, yj, xi], 1.0)
+            onehot = jax.nn.one_hot(glb[gidx], class_num)
+            lcls = jnp.sum(bce(tclsb[:, ai, yj, xi], onehot))
+            loss = loss + va * (lx + ly + lw + lh + lobj + lcls)
+            obj_t = jnp.where(va, obj_t.at[ai, yj, xi].set(1.0), obj_t)
+        # noobj loss everywhere not assigned, EXCEPT cells whose predicted
+        # box overlaps some gt above ignore_thresh (yolov3_loss_op.h: such
+        # predictions are ignored, neither obj nor noobj)
+        cell_x = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        cell_y = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        pcx = jax.nn.sigmoid(txb) + cell_x                 # [A, H, W] grid
+        pcy = jax.nn.sigmoid(tyb) + cell_y
+        pw_ = jnp.exp(jnp.clip(twb, -10, 10)) * anc_g[:, 0][:, None, None]
+        ph_ = jnp.exp(jnp.clip(thb, -10, 10)) * anc_g[:, 1][:, None, None]
+        px1, px2 = pcx - pw_ / 2, pcx + pw_ / 2
+        py1, py2 = pcy - ph_ / 2, pcy + ph_ / 2
+        gx1, gx2 = gxb - gwb / 2, gxb + gwb / 2            # [G]
+        gy1, gy2 = gyb - ghb / 2, gyb + ghb / 2
+        iw_ = jnp.maximum(jnp.minimum(px2[..., None], gx2) -
+                          jnp.maximum(px1[..., None], gx1), 0.0)
+        ih_ = jnp.maximum(jnp.minimum(py2[..., None], gy2) -
+                          jnp.maximum(py1[..., None], gy1), 0.0)
+        inter_ = iw_ * ih_                                 # [A, H, W, G]
+        union_ = (pw_ * ph_)[..., None] + gwb * ghb - inter_
+        iou_pred = jnp.where(vb, inter_ / jnp.maximum(union_, 1e-9), 0.0)
+        best_iou = jnp.max(iou_pred, axis=-1)              # [A, H, W]
+        noobj_mask = (1.0 - obj_t) * (best_iou < ignore_thresh)
+        lnoobj = jnp.sum(bce(tobjb, 0.0) * noobj_mask)
+        return loss + lnoobj
+
+    losses = jax.vmap(one)(tx, ty, tw, th, tobj,
+                           jnp.moveaxis(tcls, 2, 1),
+                           gx, gy, gw, gh, gi, gj, best_a, valid,
+                           gt_label)
+    return {"Loss": [losses]}

@@ -1,0 +1,312 @@
+"""Detection-suite tests (reference OpTest files: test_prior_box_op.py,
+test_density_prior_box_op.py, test_anchor_generator_op.py,
+test_box_coder_op.py, test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_mine_hard_examples_op.py,
+test_multiclass_nms_op.py, test_polygon_box_transform.py,
+test_detection_map_op.py, test_generate_proposals.py,
+test_rpn_target_assign_op.py, test_yolov3_loss_op.py; layer composition
+test_ssd_loss.py / test_detection.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import run_single_op
+
+import paddle_tpu.fluid as fluid
+
+
+def _r(*shape, seed=0, lo=0.0, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def _iou_np(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1, 0)
+    ih = np.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    u = aa[:, None] + ab[None, :] - inter
+    return np.where(u > 0, inter / u, 0)
+
+
+def test_prior_box_basic():
+    x = _r(1, 8, 4, 4)
+    img = _r(1, 3, 32, 32)
+    out = run_single_op(
+        "prior_box", {"Input": {"x": x}, "Image": {"img": img}},
+        attrs={"min_sizes": [8.0], "max_sizes": [16.0],
+               "aspect_ratios": [2.0], "flip": True, "clip": True,
+               "variances": [0.1, 0.1, 0.2, 0.2]},
+        out_slots=("Boxes", "Variances"))
+    boxes, var = out["__out_Boxes_0"], out["__out_Variances_0"]
+    # priors per cell: ars {1, 2, 1/2} = 3 + 1 max box = 4
+    assert boxes.shape == (4, 4, 4, 4) and var.shape == boxes.shape
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # first prior at cell (0,0): center (4,4), half-size 4 → [0,0,8,8]/32
+    np.testing.assert_allclose(boxes[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box_count():
+    x = _r(1, 8, 2, 2)
+    img = _r(1, 3, 16, 16)
+    out = run_single_op(
+        "density_prior_box", {"Input": {"x": x}, "Image": {"img": img}},
+        attrs={"fixed_sizes": [4.0], "fixed_ratios": [1.0],
+               "densities": [2]},
+        out_slots=("Boxes", "Variances"))
+    # density 2 → 4 shifted priors per cell
+    assert out["__out_Boxes_0"].shape == (2, 2, 4, 4)
+
+
+def test_anchor_generator_matches_reference_formula():
+    x = _r(1, 8, 2, 3)
+    out = run_single_op(
+        "anchor_generator", {"Input": {"x": x}},
+        attrs={"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+               "stride": [16.0, 16.0]},
+        out_slots=("Anchors", "Variances"))
+    anchors = out["__out_Anchors_0"]
+    assert anchors.shape == (2, 3, 1, 4)
+    # reference math: base=round(sqrt(256))=16, scale=2 → w=h=32,
+    # ctr=(0*16 + 0.5*15)=7.5 → [-8, -8, 23, 23]
+    np.testing.assert_allclose(anchors[0, 0, 0],
+                               [7.5 - 15.5, 7.5 - 15.5, 7.5 + 15.5, 7.5 + 15.5])
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]], np.float32)
+    pvar = np.full((2, 4), 0.1, np.float32)
+    gt = np.array([[0.15, 0.2, 0.45, 0.55]], np.float32)
+    enc = run_single_op("box_coder",
+                        {"PriorBox": {"p": prior}, "PriorBoxVar": {"v": pvar},
+                         "TargetBox": {"t": gt}},
+                        attrs={"code_type": "encode_center_size"},
+                        out_slots=("OutputBox",))["__out_OutputBox_0"]
+    assert enc.shape == (1, 2, 4)
+    dec = run_single_op("box_coder",
+                        {"PriorBox": {"p": prior}, "PriorBoxVar": {"v": pvar},
+                         "TargetBox": {"t": enc}},
+                        attrs={"code_type": "decode_center_size"},
+                        out_slots=("OutputBox",))["__out_OutputBox_0"]
+    np.testing.assert_allclose(dec[0, 0], gt[0], atol=1e-5)
+    np.testing.assert_allclose(dec[0, 1], gt[0], atol=1e-5)
+
+
+def test_iou_similarity_matches_numpy():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [5, 5, 6, 6]], np.float32)
+    out = run_single_op("iou_similarity", {"X": {"a": a}, "Y": {"b": b}})
+    np.testing.assert_allclose(out["__out_Out_0"], _iou_np(a, b), atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # 2 gt x 3 priors; global max first
+    d = np.array([[[0.9, 0.2, 0.1], [0.3, 0.8, 0.05]]], np.float32)
+    out = run_single_op("bipartite_match", {"DistMat": {"d": d}},
+                        out_slots=("ColToRowMatchIndices",
+                                   "ColToRowMatchDist"))
+    idx = out["__out_ColToRowMatchIndices_0"][0]
+    np.testing.assert_array_equal(idx, [0, 1, -1])
+
+
+def test_bipartite_match_per_prediction():
+    d = np.array([[[0.9, 0.6, 0.1], [0.3, 0.8, 0.05]]], np.float32)
+    out = run_single_op("bipartite_match", {"DistMat": {"d": d}},
+                        attrs={"match_type": "per_prediction",
+                               "dist_threshold": 0.5},
+                        out_slots=("ColToRowMatchIndices",
+                                   "ColToRowMatchDist"))
+    idx = out["__out_ColToRowMatchIndices_0"][0]
+    # col1: bipartite gives row 1 (after row0 took col0); col1 stays 1;
+    # col2 below threshold stays -1
+    np.testing.assert_array_equal(idx, [0, 1, -1])
+
+
+def test_target_assign_with_neg_mask():
+    x = _r(1, 2, 3)   # [B, N, K]
+    match = np.array([[0, -1, 1, -1]], np.int32)
+    neg = np.array([[0, 1, 0, 0]], np.int32)
+    out = run_single_op("target_assign",
+                        {"X": {"x": x}, "MatchIndices": {"m": match},
+                         "NegMask": {"n": neg}},
+                        attrs={"mismatch_value": 7},
+                        out_slots=("Out", "OutWeight"))
+    got = out["__out_Out_0"][0]
+    w = out["__out_OutWeight_0"][0]
+    np.testing.assert_allclose(got[0], x[0, 0], atol=1e-6)
+    np.testing.assert_allclose(got[1], np.full(3, 7.0))      # mined negative
+    np.testing.assert_allclose(got[2], x[0, 1], atol=1e-6)
+    np.testing.assert_allclose(got[3], np.full(3, 7.0))      # unmatched
+    np.testing.assert_allclose(w.reshape(-1), [1, 1, 1, 0])
+
+
+def test_mine_hard_examples_quota():
+    cls_loss = np.array([[5.0, 4.0, 3.0, 2.0, 1.0, 0.5]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1, -1]], np.int32)   # 1 positive
+    mdist = np.zeros((1, 6), np.float32)
+    out = run_single_op("mine_hard_examples",
+                        {"ClsLoss": {"c": cls_loss},
+                         "MatchIndices": {"m": match},
+                         "MatchDist": {"d": mdist}},
+                        attrs={"neg_pos_ratio": 3.0,
+                               "neg_dist_threshold": 0.5},
+                        out_slots=("NegMask", "UpdatedMatchIndices"))
+    neg = out["__out_NegMask_0"][0]
+    # 1 pos * ratio 3 = 3 negatives: the highest-loss unmatched priors
+    np.testing.assert_array_equal(neg, [0, 1, 1, 1, 0, 0])
+
+
+def test_multiclass_nms_shape_and_suppression():
+    # 1 batch, 2 classes (bg=0), 4 boxes; two overlapping high-score boxes
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30], [40, 40, 50, 50]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8, 0.05]
+    out = run_single_op("multiclass_nms",
+                        {"BBoxes": {"b": boxes}, "Scores": {"s": scores}},
+                        attrs={"background_label": 0, "score_threshold": 0.1,
+                               "nms_threshold": 0.5, "nms_top_k": 4,
+                               "keep_top_k": 3, "normalized": False})
+    res = out["__out_Out_0"][0]
+    assert res.shape == (3, 6)
+    kept = res[res[:, 0] >= 0]
+    # box1 suppressed by box0 (IoU ~0.82); box3 below score threshold
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.8, 0.9], atol=1e-6)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    out = run_single_op("polygon_box_transform", {"Input": {"x": x}},
+                        out_slots=("Output",))["__out_Output_0"]
+    # even channel: 4*w - 0; odd channel: 4*h - 0
+    np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])
+    np.testing.assert_allclose(out[0, 1, :, 0], [0, 4])
+
+
+def test_detection_map_perfect_predictions():
+    # detections exactly equal gt → mAP 1
+    det = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                     [2, 0.8, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+    gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4],
+                    [2, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+    out = run_single_op("detection_map",
+                        {"DetectRes": {"d": det}, "Label": {"g": gt}},
+                        attrs={"class_num": 3},
+                        out_slots=("MAP",))
+    np.testing.assert_allclose(float(out["__out_MAP_0"]), 1.0, atol=1e-5)
+
+
+def test_generate_proposals_shapes():
+    b, a, h, w = 1, 3, 4, 4
+    scores = _r(b, a, h, w, seed=1)
+    deltas = _r(b, 4 * a, h, w, seed=2, lo=-0.1, hi=0.1)
+    anchors = run_single_op(
+        "anchor_generator", {"Input": {"x": _r(1, 8, h, w)}},
+        attrs={"anchor_sizes": [16.0, 32.0, 64.0],
+               "aspect_ratios": [1.0], "stride": [8.0, 8.0]},
+        out_slots=("Anchors", "Variances"))
+    anc, var = anchors["__out_Anchors_0"], anchors["__out_Variances_0"]
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    out = run_single_op("generate_proposals",
+                        {"Scores": {"s": scores}, "BboxDeltas": {"d": deltas},
+                         "ImInfo": {"i": im_info}, "Anchors": {"a": anc},
+                         "Variances": {"v": var}},
+                        attrs={"pre_nms_topN": 20, "post_nms_topN": 5,
+                               "nms_thresh": 0.7, "min_size": 1.0},
+                        out_slots=("RpnRois", "RpnRoiProbs"))
+    rois = out["__out_RpnRois_0"]
+    assert rois.shape == (1, 5, 4)
+    # all rois inside image
+    assert (rois[..., 0] >= 0).all() and (rois[..., 2] <= 31).all()
+
+
+def test_rpn_target_assign_quota_and_targets():
+    h = w = 4
+    anchors = run_single_op(
+        "anchor_generator", {"Input": {"x": _r(1, 8, h, w)}},
+        attrs={"anchor_sizes": [16.0], "aspect_ratios": [1.0],
+               "stride": [8.0, 8.0]},
+        out_slots=("Anchors", "Variances"))["__out_Anchors_0"]
+    gt = np.zeros((1, 2, 4), np.float32)
+    gt[0, 0] = [4, 4, 20, 20]
+    out = run_single_op("rpn_target_assign",
+                        {"Anchor": {"a": anchors}, "GtBoxes": {"g": gt}},
+                        attrs={"rpn_batch_size_per_im": 8,
+                               "rpn_fg_fraction": 0.5,
+                               "rpn_positive_overlap": 0.6,
+                               "rpn_negative_overlap": 0.3},
+                        out_slots=("ScoreIndex", "TargetBBox",
+                                   "LocationIndex", "TargetLabel"))
+    labels = out["__out_TargetLabel_0"][0]
+    assert (labels == 1).sum() >= 1          # at least the forced best anchor
+    assert (labels == 0).sum() <= 8
+    assert set(np.unique(labels)) <= {-1, 0, 1}
+
+
+def test_yolov3_loss_finite_and_positive():
+    b, a, c, h, w = 1, 2, 3, 4, 4
+    x = _r(b, a * (5 + c), h, w, lo=-1.0, seed=3)
+    gt_box = np.array([[[0.5, 0.5, 0.25, 0.25], [0, 0, 0, 0]]], np.float32)
+    gt_label = np.array([[1, -1]], np.int32)
+    out = run_single_op("yolov3_loss",
+                        {"X": {"x": x}, "GTBox": {"g": gt_box},
+                         "GTLabel": {"l": gt_label}},
+                        attrs={"anchors": [32.0, 32.0, 64.0, 64.0],
+                               "class_num": c, "downsample_ratio": 32},
+                        out_slots=("Loss",))
+    loss = out["__out_Loss_0"]
+    assert np.isfinite(loss).all() and (loss > 0).all()
+
+
+def test_ssd_loss_layer_end_to_end():
+    """Composed ssd_loss trains: loss is finite and decreases with Adam on a
+    tiny fixed problem (layer parity: layers/detection.py ssd_loss)."""
+    b, m, g, c = 2, 8, 2, 4
+    rng = np.random.RandomState(0)
+    prior = np.linspace(0.05, 0.9, m).astype(np.float32)
+    prior_boxes = np.stack([prior, prior,
+                            np.clip(prior + 0.1, 0, 1),
+                            np.clip(prior + 0.1, 0, 1)], axis=1)
+    pvar = np.full((m, 4), 0.1, np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="feat", shape=[16], dtype="float32")
+        gt_box = fluid.layers.data(name="gt_box", shape=[g, 4],
+                                   dtype="float32")
+        gt_label = fluid.layers.data(name="gt_label", shape=[g, 1],
+                                     dtype="int64")
+        pb = fluid.layers.data(name="pb", shape=[4], dtype="float32",
+                               append_batch_size=False)
+        pbv = fluid.layers.data(name="pbv", shape=[4], dtype="float32",
+                                append_batch_size=False)
+        hidden = fluid.layers.fc(feat, 64, act="relu")
+        loc = fluid.layers.reshape(
+            fluid.layers.fc(hidden, m * 4), [-1, m, 4])
+        conf = fluid.layers.reshape(
+            fluid.layers.fc(hidden, m * c), [-1, m, c])
+        loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label, pb, pbv)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feats = rng.rand(b, 16).astype(np.float32)
+    gtb = np.array([[[0.05, 0.05, 0.15, 0.15], [0.6, 0.6, 0.75, 0.75]],
+                    [[0.3, 0.3, 0.45, 0.45], [0, 0, 0, 0]]], np.float32)
+    gtl = np.array([[[1], [2]], [[3], [-1]]], np.int64)
+    feed = {"feat": feats, "gt_box": gtb, "gt_label": gtl,
+            "pb": prior_boxes, "pbv": pvar}
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+        losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
