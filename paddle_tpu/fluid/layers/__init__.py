@@ -7,7 +7,7 @@ from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
 from paddle_tpu.fluid.layers.nn import (  # noqa: F401
     affine_channel, affine_grid, grid_sampler, image_resize,
     resize_bilinear, resize_nearest, roi_align, roi_pool,
-    argsort, multiplex, log_loss, rank_loss, margin_rank_loss, bpr_loss, crop, pad2d, pad_constant_like, random_crop, add_position_encoding, similarity_focus, bilinear_tensor_product, row_conv, unstack, sampling_id,
+    argsort, multiplex, warpctc, ctc_greedy_decoder, log_loss, rank_loss, margin_rank_loss, bpr_loss, crop, pad2d, pad_constant_like, random_crop, add_position_encoding, similarity_focus, bilinear_tensor_product, row_conv, unstack, sampling_id,
     accuracy, auc, batch_norm, beam_search, beam_search_decode, chunk_eval,
     clip, conv2d, conv2d_transpose,
     cos_sim, crf_decoding, cross_entropy, dropout, embedding, expand, fc,

@@ -986,3 +986,36 @@ def affine_channel(x, scale, bias, name=None):
                      inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
                      outputs={"Out": [out]})
     return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """reference: layers/nn.py warpctc → warpctc_op.cc. Padded layout:
+    input [B, T, C] logits, label [B, S] (pad -1)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op("warpctc", inputs=inputs,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """reference: layers/nn.py ctc_greedy_decoder — argmax over classes then
+    merge-repeats + drop-blanks (ctc_align). input [B, T, C] probs/logits;
+    output [B, T] ids padded with -1."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = helper.create_variable_for_type_inference("int64")
+    helper.append_op("argmax", inputs={"X": [input]}, outputs={"Out": [ids]},
+                     attrs={"axis": 2})
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op("ctc_align", inputs={"Input": [ids]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out

@@ -17,3 +17,5 @@ from paddle_tpu.ops import misc_ops  # noqa: F401
 from paddle_tpu.ops import image_ops  # noqa: F401
 from paddle_tpu.ops import detection_ops  # noqa: F401
 from paddle_tpu.ops import rpn_ops  # noqa: F401
+from paddle_tpu.ops import lod_ops  # noqa: F401
+from paddle_tpu.ops import ctc_ops  # noqa: F401

@@ -1,0 +1,110 @@
+"""CTC ops (reference: operators/warpctc_op.cc — wraps the external
+warp-ctc library; operators/ctc_align_op.cc).
+
+TPU-native design: the CTC forward recursion (log-alpha over the extended
+blank-interleaved label sequence) runs as one lax.scan over time — a dense
+[B, 2S+1] log-space dynamic program that XLA vectorizes on the VPU. The
+gradient is jax.vjp over the scan (the reference relies on warp-ctc's
+hand-written backward). Inputs are padded: Logits [B, T, C],
+LogitsLength [B], Label [B, S] (pad -1), LabelLength [B]."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import first, register_op, single
+
+_NEG = -1e30
+
+
+def _ctc_loss_single_batch(logp, labels, t_len, l_len, blank):
+    """logp [T, C] log-softmax; labels [S] (pad anything); returns -log p."""
+    t_max, c = logp.shape
+    s_max = labels.shape[0]
+    n = 2 * s_max + 1
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((n,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    # valid positions given true label length
+    n_valid = 2 * l_len + 1
+    pos = jnp.arange(n)
+    # can skip from i-2 when ext[i] != blank and ext[i] != ext[i-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -2, jnp.int32), ext[:-2]])
+    can_skip = (pos % 2 == 1) & (ext != ext_m2)
+
+    alpha0 = jnp.full((n,), _NEG)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(l_len > 0, logp[0, ext[1]], _NEG))
+
+    def step(alpha, t):
+        a_prev1 = jnp.concatenate([jnp.full((1,), _NEG), alpha[:-1]])
+        a_prev2 = jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]])
+        a = jnp.logaddexp(alpha, a_prev1)
+        a = jnp.where(can_skip, jnp.logaddexp(a, a_prev2), a)
+        emit = logp[t, ext]
+        new = a + emit
+        # freeze past the true time length
+        new = jnp.where(t < t_len, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, t_max))
+    # total prob: last two valid positions (n_valid-1, n_valid-2); with an
+    # empty label only the all-blank path exists — don't double-count it
+    idx_last = n_valid - 1
+    idx_prev = jnp.maximum(n_valid - 2, 0)
+    total = jnp.where(l_len > 0,
+                      jnp.logaddexp(alpha[idx_last], alpha[idx_prev]),
+                      alpha[idx_last])
+    return -total
+
+
+@register_op("warpctc", ref="operators/warpctc_op.cc (capability; CTC "
+                            "recursion per Graves et al. in lax.scan)")
+def _warpctc(ctx, ins, attrs):
+    logits = first(ins, "Logits")        # [B, T, C] (padded batch layout)
+    labels = first(ins, "Label")         # [B, S] int
+    logits_len = first(ins, "LogitsLength")
+    label_len = first(ins, "LabelLength")
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = attrs.get("norm_by_times", False)
+    b, t, c = logits.shape
+    if logits_len is None:
+        logits_len = jnp.full((b,), t, jnp.int32)
+    if label_len is None:
+        label_len = jnp.sum((labels >= 0).astype(jnp.int32), axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    safe_labels = jnp.where(labels >= 0, labels, blank)
+    loss = jax.vmap(_ctc_loss_single_batch,
+                    in_axes=(0, 0, 0, 0, None))(
+        logp, safe_labels, logits_len.reshape(-1).astype(jnp.int32),
+        label_len.reshape(-1).astype(jnp.int32), blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+    return {"Loss": [loss[:, None]], "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("ctc_align", no_grad=True, ref="operators/ctc_align_op.cc")
+def _ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode: collapse repeats then drop blanks. Input [B, T]
+    argmax ids (padded); output [B, T] with -1 padding (static-shape form
+    of the reference's shrunk LoD output)."""
+    x = first(ins, "Input").astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    merge = attrs.get("merge_repeated", True)
+    b, t = x.shape
+    prev = jnp.concatenate([jnp.full((b, 1), -99, jnp.int32), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank)
+    if merge:
+        keep = keep & (x != prev)
+
+    def compact(row, keep_row):
+        # stable partition: kept values to the front, -1 padding behind
+        order = jnp.argsort(~keep_row, stable=True)
+        vals = jnp.where(keep_row, row, -1)
+        return vals[order]
+
+    return {"Output": [jax.vmap(compact)(x, keep)]}

@@ -1,0 +1,57 @@
+"""CTC tests: warpctc vs torch.nn.CTCLoss ground truth + numeric grad;
+ctc_align greedy decode (reference: test_warpctc_op.py, test_ctc_align.py)."""
+
+import numpy as np
+import torch
+
+from op_test import check_grad, run_single_op
+
+
+def test_warpctc_matches_torch():
+    rng = np.random.RandomState(0)
+    b, t, c, s = 3, 8, 5, 3
+    logits = rng.randn(b, t, c).astype(np.float32)
+    labels = np.array([[1, 2, 1], [3, 3, -1], [4, -1, -1]], np.int32)
+    t_lens = np.array([8, 6, 5], np.int32)
+    l_lens = np.array([3, 2, 1], np.int32)
+
+    out = run_single_op("warpctc",
+                        {"Logits": {"x": logits}, "Label": {"l": labels},
+                         "LogitsLength": {"tl": t_lens},
+                         "LabelLength": {"ll": l_lens}},
+                        attrs={"blank": 0},
+                        out_slots=("Loss", "WarpCTCGrad"))
+    got = out["__out_Loss_0"].reshape(-1)
+
+    tl = torch.nn.CTCLoss(blank=0, reduction="none")
+    tlogits = torch.tensor(logits).permute(1, 0, 2).log_softmax(-1)
+    tgt = torch.tensor([[1, 2, 1], [3, 3, 0], [4, 0, 0]], dtype=torch.long)
+    expect = tl(tlogits, tgt, torch.tensor(t_lens, dtype=torch.long),
+                torch.tensor(l_lens, dtype=torch.long)).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_grad_numeric():
+    rng = np.random.RandomState(1)
+    b, t, c = 2, 5, 4
+    logits = rng.randn(b, t, c).astype(np.float32)
+    labels = np.array([[1, 2], [3, -1]], np.int32)
+    t_lens = np.array([5, 4], np.int32)
+    l_lens = np.array([2, 1], np.int32)
+    check_grad("warpctc",
+               {"Logits": {"x": logits}, "Label": {"l": labels},
+                "LogitsLength": {"tl": t_lens},
+                "LabelLength": {"ll": l_lens}},
+               attrs={"blank": 0}, out_slot="Loss",
+               extra_out_slots=("WarpCTCGrad",), grad_vars=["x"],
+               rtol=2e-2, atol=1e-3)
+
+
+def test_ctc_align_greedy():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0],
+                  [3, 0, 3, 3, 0, 0, 0]], np.int32)
+    out = run_single_op("ctc_align", {"Input": {"x": x}},
+                        attrs={"blank": 0, "merge_repeated": True},
+                        out_slots=("Output",))["__out_Output_0"]
+    np.testing.assert_array_equal(out[0], [1, 2, -1, -1, -1, -1, -1])
+    np.testing.assert_array_equal(out[1], [3, 3, -1, -1, -1, -1, -1])

@@ -1,0 +1,249 @@
+"""LoD-infrastructure + fused-tier op tests (reference OpTest files:
+test_lod_reset_op.py, test_lod_rank_table.py, test_reorder_lod_tensor.py,
+test_split_merge_lod_tensor_op.py, test_shrink_rnn_memory.py,
+test_sequence_scatter_op.py, test_fused_embedding_seq_pool_op.py (1.3),
+test_fusion_gru_op.py, test_fusion_lstm_op.py,
+test_fused_elemwise_activation_op.py, test_fusion_seqpool_concat_op.py,
+test_fusion_transpose_flatten_concat_op.py, test_lstmp_op.py,
+test_attention_lstm_op.py, test_fusion_seqexpand_concat_fc_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(*shape, seed=0, lo=-0.5, hi=0.5):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def test_alias_registration():
+    from paddle_tpu.core.registry import has_op
+    for op in ["write_to_array", "read_from_array", "lod_array_length",
+               "gru", "lstm", "recurrent", "lstmp", "attention_lstm",
+               "fusion_gru", "fusion_lstm", "fused_embedding_seq_pool"]:
+        assert has_op(op), op
+
+
+def test_lod_rank_table_and_reorder():
+    lens = np.array([2, 5, 3], np.int32)
+    out = run_single_op("lod_rank_table", {"SeqLens": {"l": lens}},
+                        out_slots=("Index", "Lens"))
+    np.testing.assert_array_equal(out["__out_Index_0"], [1, 2, 0])
+    np.testing.assert_array_equal(out["__out_Lens_0"], [5, 3, 2])
+    x = _r(3, 4)
+    ro = run_single_op("reorder_lod_tensor_by_rank",
+                       {"X": {"x": x}, "RankTable":
+                        {"t": out["__out_Index_0"].astype(np.int32)}})
+    np.testing.assert_allclose(ro["__out_Out_0"], x[[1, 2, 0]], rtol=1e-6)
+
+
+def test_max_sequence_len():
+    lens = np.array([2, 5, 3], np.int32)
+    out = run_single_op("max_sequence_len", {"SeqLens": {"l": lens}})
+    assert int(out["__out_Out_0"]) == 5
+
+
+def test_lod_reset_target():
+    x = _r(4, 3)
+    out = run_single_op("lod_reset", {"X": {"x": x}},
+                        attrs={"target_lod": [0, 2, 4]},
+                        out_slots=("Out", "OutLens"))
+    np.testing.assert_allclose(out["__out_Out_0"], x, rtol=1e-6)
+    np.testing.assert_array_equal(out["__out_OutLens_0"], [2, 2])
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    x = _r(4, 3)
+    mask = np.array([1, 0, 1, 0], np.int32)
+    sp = run_single_op("split_lod_tensor",
+                       {"X": {"x": x}, "Mask": {"m": mask}},
+                       out_slots=("OutTrue", "OutFalse"))
+    mg = run_single_op("merge_lod_tensor",
+                       {"InTrue": {"t": sp["__out_OutTrue_0"]},
+                        "InFalse": {"f": sp["__out_OutFalse_0"]},
+                        "Mask": {"m": mask}})
+    np.testing.assert_allclose(mg["__out_Out_0"], x, rtol=1e-6)
+
+
+def test_shrink_rnn_memory_masks_finished_rows():
+    x = _r(3, 4)
+    lens = np.array([1, 3, 2], np.float32)
+    out = run_single_op("shrink_rnn_memory",
+                        {"X": {"x": x}, "I": {"i": np.array([1], np.int32)},
+                         "RankTableLens": {"l": lens}})
+    got = out["__out_Out_0"]
+    np.testing.assert_allclose(got[0], np.zeros(4))   # len 1 ended at step 1
+    np.testing.assert_allclose(got[1], x[1], rtol=1e-6)
+    np.testing.assert_allclose(got[2], x[2], rtol=1e-6)
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), np.float32)
+    ids = np.array([[0, 2, -1], [4, 4, 1]], np.int32)
+    upd = np.array([[1.0, 2.0, 9.0], [3.0, 4.0, 5.0]], np.float32)
+    out = run_single_op("sequence_scatter",
+                        {"X": {"x": x}, "Ids": {"i": ids},
+                         "Updates": {"u": upd}})["__out_Out_0"]
+    np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+    np.testing.assert_allclose(out[1], [0, 5, 0, 0, 7])   # 3+4 at idx 4
+
+
+def test_lod_tensor_array_roundtrip():
+    x = _r(2, 3, 4)
+    arr = run_single_op("lod_tensor_to_array", {"X": {"x": x}})
+    back = run_single_op("array_to_lod_tensor",
+                         {"X": {"x": arr["__out_Out_0"]}})
+    np.testing.assert_allclose(back["__out_Out_0"], x, rtol=1e-6)
+
+
+def test_tensor_array_to_tensor_stack():
+    xs = {f"x{i}": _r(2, 3, seed=i) for i in range(3)}
+    out = run_single_op("tensor_array_to_tensor", {"X": xs},
+                        attrs={"axis": 0, "use_stack": True},
+                        out_slots=("Out", "OutIndex"))
+    assert out["__out_Out_0"].shape == (3, 2, 3)
+
+
+def test_fused_embedding_seq_pool():
+    w = _r(10, 4, seed=1)
+    ids = np.array([[1, 2, 0], [3, 0, 0]], np.int32)
+    lens = np.array([2, 1], np.int32)
+    out = run_single_op("fused_embedding_seq_pool",
+                        {"W": {"w": w}, "Ids": {"i": ids},
+                         "SeqLens": {"l": lens}})["__out_Out_0"]
+    np.testing.assert_allclose(out[0], w[1] + w[2], rtol=1e-5)
+    np.testing.assert_allclose(out[1], w[3], rtol=1e-5)
+
+
+def test_fused_elemwise_activation():
+    x = _r(2, 3)
+    y = _r(2, 3, seed=1)
+    out = run_single_op("fused_elemwise_activation",
+                        {"X": {"x": x}, "Y": {"y": y}},
+                        attrs={"functor_list": ["elementwise_add", "relu"]},
+                        out_slots=("Out", "IntermediateOut"))
+    np.testing.assert_allclose(out["__out_Out_0"],
+                               np.maximum(x + y, 0), rtol=1e-6)
+
+
+def test_fusion_seqpool_concat():
+    x1 = _r(2, 3, 4)
+    x2 = _r(2, 3, 2, seed=1)
+    out = run_single_op("fusion_seqpool_concat",
+                        {"X": {"a": x1, "b": x2}},
+                        attrs={"pooltype": "SUM"})["__out_Out_0"]
+    np.testing.assert_allclose(out, np.concatenate(
+        [x1.sum(1), x2.sum(1)], axis=1), rtol=1e-5)
+
+
+def test_fusion_transpose_flatten_concat():
+    x1 = _r(2, 3, 4, 5)
+    out = run_single_op("fusion_transpose_flatten_concat",
+                        {"X": {"a": x1}},
+                        attrs={"trans_axis": [0, 2, 3, 1],
+                               "flatten_axis": 1, "concat_axis": 1})
+    np.testing.assert_allclose(
+        out["__out_Out_0"], x1.transpose(0, 2, 3, 1).reshape(2, -1),
+        rtol=1e-6)
+
+
+def test_conv2d_fusion_matches_conv_relu():
+    x = _r(1, 2, 5, 5)
+    w = _r(3, 2, 3, 3, seed=1)
+    b = _r(3, seed=2)
+    fused = run_single_op("conv2d_fusion",
+                          {"Input": {"x": x}, "Filter": {"w": w},
+                           "Bias": {"b": b}},
+                          attrs={"strides": [1, 1], "paddings": [1, 1],
+                                 "activation": "relu"},
+                          out_slots=("Output",))["__out_Output_0"]
+    plain = run_single_op("conv2d",
+                          {"Input": {"x": x}, "Filter": {"w": w}},
+                          attrs={"strides": [1, 1], "paddings": [1, 1]},
+                          out_slots=("Output",))["__out_Output_0"]
+    np.testing.assert_allclose(
+        fused, np.maximum(plain + b.reshape(1, -1, 1, 1), 0),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_gru_matches_manual():
+    b, t, din, h = 2, 3, 4, 3
+    x = _r(b, t, din)
+    wx = _r(din, 3 * h, seed=1)
+    wh = _r(h, 3 * h, seed=2)
+    fused = run_single_op("fusion_gru",
+                          {"X": {"x": x}, "WeightX": {"wx": wx},
+                           "WeightH": {"wh": wh}},
+                          out_slots=("Hidden",))["__out_Hidden_0"]
+    proj = np.einsum("btd,dk->btk", x, wx)
+    plain = run_single_op("dynamic_gru",
+                          {"Input": {"p": proj}, "Weight": {"wh": wh}},
+                          out_slots=("Hidden",))["__out_Hidden_0"]
+    np.testing.assert_allclose(fused, plain, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_lstm_matches_manual():
+    b, t, din, h = 2, 3, 4, 3
+    x = _r(b, t, din)
+    wx = _r(din, 4 * h, seed=1)
+    wh = _r(h, 4 * h, seed=2)
+    fused = run_single_op("fusion_lstm",
+                          {"X": {"x": x}, "WeightX": {"wx": wx},
+                           "WeightH": {"wh": wh}},
+                          out_slots=("Hidden", "Cell"))["__out_Hidden_0"]
+    proj = np.einsum("btd,dk->btk", x, wx)
+    plain = run_single_op("dynamic_lstm",
+                          {"Input": {"p": proj}, "Weight": {"wh": wh}},
+                          out_slots=("Hidden",))["__out_Hidden_0"]
+    np.testing.assert_allclose(fused, plain, rtol=1e-4, atol=1e-5)
+
+
+def test_lstmp_shapes_and_grad():
+    b, t, d, p = 2, 3, 4, 2
+    x = _r(b, t, 4 * d)
+    wh = _r(p, 4 * d, seed=1)
+    wproj = _r(d, p, seed=2)
+    out = run_single_op("lstmp",
+                        {"Input": {"x": x}, "Weight": {"wh": wh},
+                         "ProjWeight": {"wp": wproj}},
+                        out_slots=("Projection", "Cell"))
+    assert out["__out_Projection_0"].shape == (b, t, p)
+    assert out["__out_Cell_0"].shape == (b, t, d)
+    check_grad("lstmp",
+               {"Input": {"x": x}, "Weight": {"wh": wh},
+                "ProjWeight": {"wp": wproj}},
+               out_slot="Projection", extra_out_slots=("Cell",),
+               rtol=2e-2)
+
+
+def test_attention_lstm_runs_and_grads():
+    b, t, d = 2, 4, 3
+    x = _r(b, t, d)
+    att_w = _r(2 * d, 1, seed=1)
+    lstm_w = _r(2 * d, 4 * d, seed=2)
+    out = run_single_op("attention_lstm",
+                        {"X": {"x": x}, "AttentionWeight": {"aw": att_w},
+                         "LSTMWeight": {"lw": lstm_w}},
+                        out_slots=("Hidden", "Cell"))
+    assert out["__out_Hidden_0"].shape == (b, t, d)
+    check_grad("attention_lstm",
+               {"X": {"x": x}, "AttentionWeight": {"aw": att_w},
+                "LSTMWeight": {"lw": lstm_w}},
+               out_slot="Hidden", extra_out_slots=("Cell",), rtol=2e-2)
+
+
+def test_fusion_seqexpand_concat_fc():
+    b, t, d0, d1, k = 2, 3, 2, 3, 4
+    seq = _r(b, t, d0)
+    vec = _r(b, d1, seed=1)
+    w = _r(d0 + d1, k, seed=2)
+    out = run_single_op("fusion_seqexpand_concat_fc",
+                        {"X": {"a_seq": seq, "b_vec": vec},
+                         "FCWeight": {"w": w}},
+                        attrs={"fc_activation": "relu"})["__out_Out_0"]
+    cat = np.concatenate(
+        [seq, np.broadcast_to(vec[:, None], (b, t, d1))], axis=-1)
+    np.testing.assert_allclose(out, np.maximum(cat @ w, 0),
+                               rtol=1e-4, atol=1e-5)
