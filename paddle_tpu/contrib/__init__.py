@@ -7,4 +7,7 @@ inference."""
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
 
-__all__ = ["BF16Transpiler", "Float16Transpiler", "mixed_precision"]
+from paddle_tpu.contrib.quantize_transpiler import QuantizeTranspiler  # noqa: F401
+
+__all__ = ["BF16Transpiler", "Float16Transpiler", "QuantizeTranspiler",
+           "mixed_precision"]

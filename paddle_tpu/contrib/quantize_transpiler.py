@@ -1,0 +1,120 @@
+"""Quantization-aware-training transpiler (reference:
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py:81
+QuantizeTranspiler — training_transpile inserts fake_quantize/
+fake_dequantize pairs around conv2d/depthwise_conv2d/mul;
+freeze_program rewrites for int8 inference).
+
+TPU note: the fake-quant ops are plain jnp emitters, so after transpile the
+whole quantize→op→dequantize chain is one fused XLA computation — QAT costs
+one extra abs-max reduction per quantized tensor."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.core import ir
+from paddle_tpu.fluid import framework, unique_name
+
+_QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul")
+# input slots carrying quantizable tensors per op type
+_QUANT_SLOTS = {"conv2d": ("Input", "Filter"),
+                "depthwise_conv2d": ("Input", "Filter"),
+                "mul": ("X", "Y")}
+
+
+class QuantizeTranspiler:
+    """reference: quantize_transpiler.py:81."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        if activation_quantize_type not in ("abs_max", "range_abs_max"):
+            raise ValueError(activation_quantize_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.window_size = window_size
+
+    def training_transpile(self, program: Optional[framework.Program] = None,
+                           startup_program=None):
+        """Insert fake_quant(+dequant) before every quantizable input of
+        conv2d/depthwise_conv2d/mul ops, in place. range_abs_max state
+        buffers are zero-initialized in the startup program (two-program
+        convention)."""
+        program = program or framework.default_main_program()
+        self._startup = startup_program or framework.default_startup_program()
+        block = program.desc.global_block
+        params = {v.name for v in block.vars.values()
+                  if getattr(v, "persistable", False)}
+        new_ops = []
+        quanted = {}          # var name -> dequantized replacement name
+        for op in block.ops:
+            if op.type in _QUANTIZABLE_OP_TYPES:
+                for slot in _QUANT_SLOTS[op.type]:
+                    names = op.inputs.get(slot, [])
+                    for i, name in enumerate(names):
+                        if name not in quanted:
+                            is_w = name in params
+                            bits = self.weight_bits if is_w \
+                                else self.activation_bits
+                            qtype = self.weight_type if is_w \
+                                else self.act_type
+                            quanted[name] = self._insert_quant_dequant(
+                                block, new_ops, name, bits, qtype, program)
+                        names[i] = quanted[name]
+            new_ops.append(op)
+        block.ops[:] = new_ops
+        program.desc.bump_version()
+        return program
+
+    def _insert_quant_dequant(self, block, new_ops, name, bits, qtype,
+                              program):
+        vd = block.var(name)
+        qname = unique_name.generate(name + ".quantized")
+        sname = unique_name.generate(name + ".scale")
+        dqname = unique_name.generate(name + ".dequantized")
+        for nm in (qname, dqname):
+            block.add_var(ir.VarDesc(name=nm, shape=vd.shape,
+                                     dtype=vd.dtype))
+        block.add_var(ir.VarDesc(name=sname, shape=[1], dtype=vd.dtype))
+        if qtype == "range_abs_max":
+            # running-window scale state: persistable ring buffer + step
+            # counter, updated in place through the state-output round-trip
+            # (same convention as batch_norm's MeanOut/VarianceOut)
+            scales_name = unique_name.generate(name + ".scales_window")
+            iter_name = unique_name.generate(name + ".quant_iter")
+            block.add_var(ir.VarDesc(name=scales_name,
+                                     shape=[self.window_size],
+                                     dtype=vd.dtype, persistable=True))
+            block.add_var(ir.VarDesc(name=iter_name, shape=[1],
+                                     dtype="int32", persistable=True))
+            sb = self._startup.desc.global_block
+            for nm, shape, dtype in ((scales_name, [self.window_size],
+                                      vd.dtype), (iter_name, [1], "int32")):
+                sb.add_var(ir.VarDesc(name=nm, shape=shape, dtype=dtype,
+                                      persistable=True))
+                sb.append_op(ir.OpDesc(
+                    type="fill_constant", outputs={"Out": [nm]},
+                    attrs={"shape": shape, "dtype": dtype, "value": 0.0}))
+            new_ops.append(ir.OpDesc(
+                type="fake_quantize_range_abs_max",
+                inputs={"X": [name], "InScales": [scales_name],
+                        "Iter": [iter_name]},
+                outputs={"Out": [qname], "OutScale": [sname],
+                         "OutScales": [scales_name],
+                         "OutIter": [iter_name]},
+                attrs={"bit_length": bits,
+                       "window_size": self.window_size}))
+        else:
+            new_ops.append(ir.OpDesc(
+                type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qname], "OutScale": [sname]},
+                attrs={"bit_length": bits}))
+        new_ops.append(ir.OpDesc(
+            type="fake_dequantize_max_abs",
+            inputs={"X": [qname], "Scale": [sname]},
+            outputs={"Out": [dqname]},
+            attrs={"max_range": float(2 ** (bits - 1) - 1)}))
+        return dqname

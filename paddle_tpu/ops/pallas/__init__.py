@@ -1,0 +1,56 @@
+"""Pallas TPU kernel tier.
+
+Capability parity with the reference's JIT microkernel library
+(reference: operators/jit/ — runtime Xbyak x86 codegen for the hot
+LSTM/GRU/seqpool/softmax microkernels, with `refer/` scalar fallbacks and
+per-shape benchmarking to pick an implementation, jit/gen/jitcode.h:22,
+jit/kernel_pool.cc). The TPU analogue: hand-written Pallas kernels for the
+few patterns XLA schedules sub-optimally — flash attention (online-softmax
+tiling keeps the [Tq, Tk] score matrix out of HBM) and whole-sequence
+recurrent cells (h/c live in VMEM across all timesteps instead of
+round-tripping HBM per lax.scan step) — with the plain-jnp emitters as the
+`refer` tier.
+
+Tier selection (mirrors jit/kernel_pool.cc Get): `kernel_enabled(name)`
+returns True only on a real TPU backend with aligned shapes; the
+PADDLE_TPU_DISABLE_PALLAS env var forces the refer tier. On CPU the
+kernels still run under interpret=True for the self-test
+(tests/test_pallas_kernels.py, the analogue of jit/test.cc)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def kernels_disabled() -> bool:
+    return os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "0") == "1"
+
+
+def interpret_mode() -> bool:
+    """Interpret kernels when not on real TPU (CPU tests)."""
+    return not on_tpu()
+
+
+def kernel_enabled(min_align: int = 128, *dims) -> bool:
+    """Pallas path is worth it only when the lane dims align to hardware
+    tiles; otherwise the refer (jnp) tier wins."""
+    if kernels_disabled():
+        return False
+    if not on_tpu():
+        return False
+    return all(d % min_align == 0 for d in dims)
+
+
+from paddle_tpu.ops.pallas.flash_attention import (  # noqa: E402,F401
+    flash_attention, pick_blocks)
+from paddle_tpu.ops.pallas.fused_rnn import fused_lstm_sequence  # noqa: E402,F401
+from paddle_tpu.ops.pallas.seqpool import masked_seqpool  # noqa: E402,F401

@@ -1,0 +1,168 @@
+"""Flash attention forward kernel (Pallas TPU).
+
+Replaces the materialized [B, H, Tq, Tk] score tensor of the refer path
+(parallel/ring_attention.py full_attention) with online-softmax tiling:
+each grid step owns one [BQ, D] query block in VMEM, streams [BK, D]
+key/value blocks, and keeps running (max, denom, acc) statistics — the
+standard flash recurrence. HBM traffic drops from O(Tq*Tk) to
+O(Tq*D + Tk*D) per head, which is the difference between HBM-bound and
+MXU-bound attention at long sequence length (the whole point of ring
+attention's per-shard compute too — this kernel is the per-shard inner
+loop of paddle_tpu.parallel.ring_attention when shapes align).
+
+Backward: jax.custom_vjp. Residuals are only (q, k, v, o, lse) — O(T*D) —
+but the bwd body itself recomputes the FULL [B, H, Tq, Tk] score matrix in
+plain jnp, so *training* peak memory is O(T^2) exactly like the refer
+path; only the forward (inference / activation-recompute) path gets the
+O(T*D) flash memory profile. A blockwise Pallas bwd kernel is the known
+follow-up."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, bq, bk, nk, causal, scale, q_off):
+    """Grid (BH, Tq/bq, Tk/bk): the innermost k dimension streams [bk, D]
+    key/value tiles from HBM while (m, l, acc) persist in VMEM scratch —
+    TPU grid steps run sequentially, so the scratch carries the online-
+    softmax state across k blocks; VMEM use is O(bq*d + bk*d), independent
+    of sequence length."""
+    qb = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: key blocks wholly above the diagonal contribute nothing
+    visible = True
+    if causal:
+        visible = (j * bk) < (q_off + (qb + 1) * bq)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = (q_off + qb * bq +
+                    jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m = m_scr[:]
+        l = l_scr[:]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        safe_l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(safe_l)           # [BQ, 1]
+
+
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    q4 = q.reshape(b * h, tq, d)
+    k4 = k.reshape(b * h, tk, d)
+    v4 = v.reshape(b * h, tk, d)
+    nk = tk // bk
+    grid = (b * h, tq // bq, nk)
+    kern = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk, causal=causal,
+                             scale=scale, q_off=tk - tq)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q4, k4, v4)
+    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+
+
+def pick_blocks(tq, tk):
+    """Largest hardware-friendly block sizes dividing the sequence lengths
+    (bq=512/bk=1024 won the on-chip sweep at T=4096..16384)."""
+    bq = next((s for s in (512, 256, 128) if tq % s == 0), None)
+    bk = next((s for s in (1024, 512, 256, 128) if tk % s == 0), None)
+    return bq, bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None, bq=128, bk=128,
+                    interpret=False):
+    """q [B,H,Tq,D], k/v [B,H,Tk,D] → [B,H,Tq,D]. Tq % bq == Tk % bk == 0."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    out, _ = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return out
+
+
+def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
+    q, k, v, o, lse = res
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf * scale, kf)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        qp = jnp.arange(tq) + (tk - tq)
+        s = jnp.where((qp[:, None] >= jnp.arange(tk)[None, :])[None, None],
+                      s, _NEG)
+    p = jnp.exp(s - lse[..., None])                   # softmax via saved lse
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,80 @@
+"""Whole-sequence fused LSTM kernel (Pallas TPU).
+
+The refer tier (ops/rnn_ops.py dynamic_lstm) is a lax.scan whose carried
+h/c round-trip HBM every step and whose per-step [B,H]x[H,4H] matmul
+launches separately. Here the whole sequence is ONE kernel: the TPU grid
+is sequential, so h/c persist in VMEM scratch across grid steps — the
+recurrent matmul reads its operands from VMEM every step (the reference's
+jit/ LSTM microkernel plays the same register-residency game on x86,
+jit/gen/ jitcode; math/lstm_compute.cc is the scalar refer).
+
+Layout: xproj [T, B, 4H] time-major (gate pre-activations = x@Wx + b,
+like dynamic_lstm's Input), w [H, 4H] recurrent weights, h0/c0 [B, H].
+Gate order i, f, c, o (lstm_compute.cc)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _lstm_kernel(x_ref, w_ref, h0_ref, c0_ref, hid_ref, cell_ref,
+                 h_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    c = c_scr[:]
+    gates = x_ref[0].astype(jnp.float32) + jnp.dot(
+        h, w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32)            # [B, 4H]
+    hdim = h.shape[-1]
+    i = jax.nn.sigmoid(gates[:, 0 * hdim:1 * hdim])
+    f = jax.nn.sigmoid(gates[:, 1 * hdim:2 * hdim])
+    g = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
+    o = jax.nn.sigmoid(gates[:, 3 * hdim:4 * hdim])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    hid_ref[0] = h_new.astype(hid_ref.dtype)
+    cell_ref[0] = c_new.astype(cell_ref.dtype)
+
+
+def fused_lstm_sequence(xproj, w, h0, c0, interpret=False):
+    """xproj [T, B, 4H], w [H, 4H], h0/c0 [B, H] →
+    (hidden [T, B, H], cell [T, B, H])."""
+    t, b, h4 = xproj.shape
+    hdim = h4 // 4
+    hidden, cell = pl.pallas_call(
+        _lstm_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, h4), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hdim, h4), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hdim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, hdim), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hdim), xproj.dtype),
+            jax.ShapeDtypeStruct((t, b, hdim), xproj.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hdim), jnp.float32),
+            pltpu.VMEM((b, hdim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, w, h0, c0)
+    return hidden, cell

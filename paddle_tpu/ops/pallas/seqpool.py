@@ -1,0 +1,100 @@
+"""Masked sequence-pool kernel (Pallas TPU).
+
+The reference's seqpool jit microkernel (operators/jit/ seqpool kernels;
+math/sequence_pooling.cc is the refer) pools ragged rows; here the padded
+[B, T, D] + lens layout pools BB=8 batch rows per grid step (sublane-
+aligned output tiles) with the validity mask computed on-chip — one pass
+over HBM, no intermediate masked tensor. Lengths ride in SMEM via scalar
+prefetch."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -3.4e38
+_BB = 8             # batch rows per grid step (fp32 sublane tile)
+
+
+def _seqpool_kernel(lens_ref, x_ref, o_ref, *, ptype):
+    bb, t, d = x_ref.shape
+    i = pl.program_id(0)
+    tpos = jax.lax.broadcasted_iota(jnp.int32, (t, d), 0)
+    # static unroll over the 8 sublane rows: per-row scalar length from
+    # SMEM, 2D mask on the VPU (vector-of-scalars reshape is unsupported
+    # by Mosaic, so no cross-row batched mask)
+    for j in range(bb):
+        n = lens_ref[i * bb + j]
+        x = x_ref[j].astype(jnp.float32)              # [T, D]
+        mask = tpos < n
+        if ptype == "MAX":
+            o_ref[j] = jnp.max(jnp.where(mask, x, _NEG), axis=0).astype(
+                o_ref.dtype)
+            continue
+        s = jnp.sum(jnp.where(mask, x, 0.0), axis=0)  # [D]
+        denom = jnp.maximum(n.astype(jnp.float32), 1.0)
+        if ptype == "AVERAGE":
+            s = s / denom
+        elif ptype == "SQRT":
+            s = s / jax.lax.sqrt(denom)
+        o_ref[j] = s.astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def masked_seqpool(x, lens, ptype="SUM", interpret=False):
+    """x [B, T, D] (B % 8 == 0), lens [B] → [B, D];
+    ptype SUM/AVERAGE/SQRT/MAX (MAX grad not defined — use refer tier
+    for training MAX pools)."""
+    return _masked_seqpool_impl(x, lens, ptype, interpret)
+
+
+def _seqpool_fwd(x, lens, ptype, interpret):
+    return _masked_seqpool_impl(x, lens, ptype, interpret), (x.shape, lens)
+
+
+def _seqpool_bwd(ptype, interpret, res, g):
+    shape, lens = res
+    b, t, d = shape
+    ptype = ptype.upper()
+    if ptype == "MAX":
+        raise NotImplementedError("masked_seqpool MAX has no VJP; the "
+                                  "sequence_pool refer tier handles it")
+    mask = (jnp.arange(t)[None, :] < lens.reshape(-1, 1))
+    gx = jnp.broadcast_to(g[:, None, :], (b, t, d))
+    denom = jnp.maximum(lens.reshape(-1, 1, 1).astype(g.dtype), 1.0)
+    if ptype == "AVERAGE":
+        gx = gx / denom
+    elif ptype == "SQRT":
+        gx = gx / jnp.sqrt(denom)
+    return gx * mask[:, :, None].astype(g.dtype), None
+
+
+masked_seqpool.defvjp(_seqpool_fwd, _seqpool_bwd)
+
+
+def _masked_seqpool_impl(x, lens, ptype="SUM", interpret=False):
+    b, t, d = x.shape
+    if b % _BB != 0:
+        pad = _BB - b % _BB
+        x = jnp.concatenate([x, jnp.zeros((pad, t, d), x.dtype)], axis=0)
+        lens = jnp.concatenate([lens.reshape(-1),
+                                jnp.ones((pad,), lens.dtype)])
+    bp = x.shape[0]
+    kern = functools.partial(_seqpool_kernel, ptype=ptype.upper())
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,          # lens live in SMEM, prefetched
+        grid=(bp // _BB,),
+        in_specs=[pl.BlockSpec((_BB, t, d), lambda i, lens: (i, 0, 0))],
+        out_specs=pl.BlockSpec((_BB, d), lambda i, lens: (i, 0)),
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bp, d), x.dtype),
+        interpret=interpret,
+    )(lens.reshape(-1).astype(jnp.int32), x)
+    return out[:b]

@@ -1,0 +1,104 @@
+"""Fake-quantization ops (reference: operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_dequantize_max_abs; operators/fake_dequantize_op.cc;
+operators/dequantize_op.cc / quantize_op.cc (MKLDNN int8 pair)).
+
+Quantize-aware-training emitters: forward quantizes to the int grid and
+rescales; backward is straight-through (identity on the clipped region) —
+obtained for free because the emitters are expressed with jnp.clip/round
+whose VJP is exactly the STE used by the reference's grad kernels.
+
+range_abs_max keeps its running scale window as an explicit state output
+(OutScales / OutState) like the reference's in-place buffers; under the
+functional executor these are persistable vars round-tripped through the
+Scope."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import first, register_op, single
+
+
+def _ste_round(x):
+    """round with straight-through gradient."""
+    zero = x - jax.lax.stop_gradient(x)
+    return zero + jax.lax.stop_gradient(jnp.round(x))
+
+
+@register_op("fake_quantize_abs_max",
+             ref="operators/fake_quantize_op.cc FakeQuantizeAbsMax")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    x = first(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    safe = jnp.maximum(scale, 1e-8)
+    q = _ste_round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
+    return {"Out": [q], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             ref="operators/fake_quantize_op.cc FakeQuantizeRangeAbsMax")
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Running-window abs-max: InScales [window] ring buffer + Iter state.
+    In test mode uses the recorded scale."""
+    x = first(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    window = attrs.get("window_size", 10000)
+    qmax = float(2 ** (bits - 1) - 1)
+    scales = first(ins, "InScales")          # [window] ring buffer
+    it = first(ins, "Iter")                  # [1] int
+    cur = jnp.max(jnp.abs(x))
+    if ctx.is_test or scales is None:
+        scale = cur if scales is None else jnp.max(scales)
+        out_scales = scales
+        new_it = it
+    else:
+        pos = (it.reshape(()).astype(jnp.int32)) % window
+        out_scales = scales.at[pos].set(cur)
+        scale = jnp.max(out_scales)
+        new_it = it + 1
+    safe = jnp.maximum(scale, 1e-8)
+    q = _ste_round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
+    outs = {"Out": [q], "OutScale": [scale.reshape(1)]}
+    if out_scales is not None:
+        outs["OutScales"] = [out_scales]
+    if it is not None:
+        outs["OutIter"] = [new_it]
+    return outs
+
+
+@register_op("fake_dequantize_max_abs",
+             ref="operators/fake_dequantize_op.cc")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    max_range = attrs.get("max_range", 127.0)
+    return single(x * scale.reshape(()) / max_range)
+
+
+@register_op("quantize", no_grad=True, ref="operators/quantize_op.cc (int8)")
+def _quantize(ctx, ins, attrs):
+    x = first(ins, "Input")
+    scale = attrs.get("Scale", attrs.get("scale", 1.0))
+    return {"Output": [jnp.clip(jnp.round(x * scale), -128, 127)
+                       .astype(jnp.int8)]}
+
+
+@register_op("dequantize", no_grad=True,
+             ref="operators/dequantize_op.cc (int8)")
+def _dequantize(ctx, ins, attrs):
+    x = first(ins, "Input")
+    scale = attrs.get("Scale", attrs.get("scale", 1.0))
+    return {"Output": [x.astype(jnp.float32) / scale]}
+
+
+@register_op("fake_init", no_grad=True,
+             ref="operators/fill_constant_op.cc fake_init (pserver-side "
+                 "lazy init for sharded tables)")
+def _fake_init(ctx, ins, attrs):
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    return single(jnp.zeros(shape, attrs.get("dtype", "float32")))

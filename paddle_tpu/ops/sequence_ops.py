@@ -62,6 +62,16 @@ def _sequence_pool(ctx, ins, attrs):
     seq_lens = first(ins, "SeqLens")
     B, T = x.shape[0], x.shape[1]
     pooltype = str(attrs.get("pooltype", "AVERAGE")).upper()
+    # Pallas tier (ops/pallas/seqpool.py): one-pass masked pool on TPU for
+    # the plain [B, T, D] SUM/AVG/SQRT cases with lane-aligned D. The
+    # kernel keeps an [8, T, D] fp32 block in VMEM, so cap T*D at a ~4 MB
+    # budget — beyond that the refer tier's XLA pipeline wins anyway.
+    if (x.ndim == 3 and pooltype in ("SUM", "AVERAGE", "SQRT")):
+        from paddle_tpu.ops import pallas as pk
+        if (pk.kernel_enabled(128, x.shape[2])
+                and 8 * T * x.shape[2] * 4 <= 4 * 1024 * 1024):
+            lens_ = _lens_or_full(seq_lens, B, T)
+            return {"Out": [pk.masked_seqpool(x, lens_, pooltype, False)]}
     mask = _mask_bt(seq_lens, B, T)
     lens = _lens_or_full(seq_lens, B, T).astype(x.dtype)
     fmask = mask.astype(x.dtype).reshape(B, T, *([1] * (x.ndim - 2)))

@@ -97,3 +97,87 @@ def test_amp_decr_every_n_nan_or_inf():
     assert run(overflow) == 512.0         # second consecutive: shrink
     assert run(overflow) == 512.0         # counter reset after shrink
     assert run(overflow) == 256.0
+
+
+def test_quantize_transpiler_qat():
+    """QAT transpile inserts fake quant/dequant pairs and the program still
+    trains (reference: contrib/quantize/quantize_transpiler.py:81,
+    tests in contrib/tests/test_quantize_transpiler.py)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import QuantizeTranspiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        QuantizeTranspiler().training_transpile(main)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    types = [op.type for op in main.desc.global_block.ops]
+    assert "fake_quantize_abs_max" in types
+    assert "fake_dequantize_max_abs" in types
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(32, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.3).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                        fetch_list=[loss.name])
+        losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_fake_quantize_abs_max_grid():
+    import numpy as np
+    import sys
+    sys.path.insert(0, "tests")
+    from op_test import run_single_op
+    x = np.array([[-1.0, 0.5, 0.25, 1.0]], np.float32)
+    out = run_single_op("fake_quantize_abs_max", {"X": {"x": x}},
+                        attrs={"bit_length": 8},
+                        out_slots=("Out", "OutScale"))
+    q = out["__out_Out_0"]
+    assert float(out["__out_OutScale_0"]) == 1.0
+    np.testing.assert_allclose(q, np.round(x * 127.0), atol=0.5)
+
+
+def test_quantize_transpiler_range_abs_max():
+    """range_abs_max activations keep a persistable scale window updated
+    across steps (reference: fake_quantize_range_abs_max window buffers)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import QuantizeTranspiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, 8, act="relu"), 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        QuantizeTranspiler(activation_quantize_type="range_abs_max",
+                           window_size=16).training_transpile(main)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    types = [op.type for op in main.desc.global_block.ops]
+    assert "fake_quantize_range_abs_max" in types
+    assert "fake_quantize_abs_max" in types     # weights still abs_max
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32) * 0.2
+    for _ in range(10):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys},
+                        fetch_list=[loss.name])
+    assert np.isfinite(float(np.asarray(lv).reshape(())))
