@@ -141,6 +141,17 @@ class Executor:
 
         self._step += 1
         outs = cb(scope, feeds, self._step)
+        if _check_nan_inf_enabled():
+            # FLAGS_check_nan_inf capability (reference: operator.cc:978-990
+            # scans every op output per step). Here outputs are fused, so
+            # the debug scan covers fetches + every updated state var —
+            # the observable surface of the compiled step.
+            for name, o in zip(fetch_names, outs):
+                _assert_finite(name, o)
+            for name in cb.sig.state_names:
+                v = scope.find_var(name)
+                if v is not None:
+                    _assert_finite(name, v)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return list(outs)
@@ -152,3 +163,20 @@ def run_startup(startup_program, scope: Optional[Scope] = None,
     exe = Executor(place)
     exe.run(startup_program, scope=scope)
     return exe
+
+
+def _check_nan_inf_enabled() -> bool:
+    """env FLAGS_check_nan_inf=1|true — same flag name as the reference's
+    gflags re-export convention (python __init__.py:125 tryfromenv)."""
+    import os
+    return os.environ.get("FLAGS_check_nan_inf", "0").lower() in ("1", "true")
+
+
+def _assert_finite(name: str, arr):
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+        n_nan = int(np.isnan(a).sum())
+        n_inf = int(np.isinf(a).sum())
+        raise FloatingPointError(
+            f"check_nan_inf: variable {name!r} has {n_nan} NaN / {n_inf} "
+            f"Inf values (shape {a.shape})")

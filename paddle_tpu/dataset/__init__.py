@@ -7,6 +7,9 @@ environments they fall back to deterministic synthetic data with the real
 shapes/vocab sizes so training pipelines and benchmarks run unchanged.
 """
 
-from paddle_tpu.dataset import cifar, imdb, mnist, uci_housing
+from paddle_tpu.dataset import (cifar, conll05, flowers, imdb, mnist,
+                                movielens, sentiment, uci_housing, voc2012,
+                                wmt14, wmt16)
 
-__all__ = ["cifar", "imdb", "mnist", "uci_housing"]
+__all__ = ["cifar", "conll05", "flowers", "imdb", "mnist", "movielens",
+           "sentiment", "uci_housing", "voc2012", "wmt14", "wmt16"]

@@ -15,7 +15,8 @@ DATA_HOME = os.environ.get(
 def cached_npz(name: str):
     path = os.path.join(DATA_HOME, name + ".npz")
     if os.path.exists(path):
-        return np.load(path)
+        # ragged datasets (conll05/movielens/sentiment) cache object arrays
+        return np.load(path, allow_pickle=True)
     return None
 
 

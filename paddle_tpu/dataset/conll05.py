@@ -1,0 +1,54 @@
+"""CoNLL-2005 SRL reader (reference: python/paddle/dataset/conll05.py —
+get_dict() returning (word_dict, verb_dict, label_dict), get_embedding(),
+test() yielding (word, ctx_n2..ctx_p2, verb, mark, label) sequences)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+WORD_VOCAB = 44068
+VERB_VOCAB = 3162
+LABEL_COUNT = 67        # B-/I-/O tags over 33 roles
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(VERB_VOCAB)}
+    label_dict = {f"l{i}": i for i in range(LABEL_COUNT)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """reference: pre-trained word embedding table [WORD_VOCAB, 32]."""
+    rng = np.random.RandomState(110)
+    return (rng.rand(WORD_VOCAB, 32).astype(np.float32) - 0.5) / 16.0
+
+
+def _reader(n, seed):
+    def reader():
+        data = common.cached_npz("conll05_test")
+        if data is not None:
+            for row in data["rows"]:
+                yield tuple(row)
+            return
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            slen = rng.randint(3, 12)
+            words = rng.randint(0, 2000, size=slen).tolist()
+            verb_idx = rng.randint(0, slen)
+            verb = [int(words[verb_idx]) % VERB_VOCAB] * slen
+            mark = [1 if i == verb_idx else 0 for i in range(slen)]
+            # learnable labels: function of word id bucket + proximity
+            labels = [int((w + abs(i - verb_idx)) % LABEL_COUNT)
+                      for i, w in enumerate(words)]
+            ctx = [words[max(0, min(slen - 1, verb_idx + o))]
+                   for o in (-2, -1, 0, 1, 2)]
+            yield (words, [ctx[0]] * slen, [ctx[1]] * slen, [ctx[2]] * slen,
+                   [ctx[3]] * slen, [ctx[4]] * slen, verb, mark, labels)
+    return reader
+
+
+def test():
+    return _reader(512, 111)

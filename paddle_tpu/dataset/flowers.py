@@ -1,0 +1,36 @@
+"""Oxford-102 flowers reader (reference: python/paddle/dataset/flowers.py —
+train()/test()/valid() yielding (3x224x224 float image, int label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+N_CLASSES = 102
+IMG_SHAPE = (3, 224, 224)
+
+
+def _reader(split, n, seed):
+    def reader():
+        data = common.cached_npz(f"flowers_{split}")
+        if data is not None:
+            xs, ys = data["x"], data["y"]
+        else:
+            xs, ys = common.synthetic_classification(
+                n, IMG_SHAPE, N_CLASSES, seed)
+        for x, y in zip(xs, ys):
+            yield x.astype(np.float32), int(y)
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("train", 256, 100)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("test", 64, 101)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", 64, 102)

@@ -1,0 +1,80 @@
+"""MovieLens-1M reader (reference: python/paddle/dataset/movielens.py —
+get_movie_title_dict, max_movie_id, max_user_id, max_job_id, age_table,
+train()/test() yielding [user_id, gender, age, job, movie_id, categories,
+title, rating])."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+MAX_USER_ID = 6040
+MAX_MOVIE_ID = 3952
+MAX_JOB_ID = 20
+CATEGORIES = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+              "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir",
+              "Horror", "Musical", "Mystery", "Romance", "Sci-Fi",
+              "Thriller", "War", "Western"]
+AGES = [1, 18, 25, 35, 45, 50, 56]
+_TITLE_VOCAB = 5000
+
+
+def max_user_id():
+    return MAX_USER_ID
+
+
+def max_movie_id():
+    return MAX_MOVIE_ID
+
+
+def max_job_id():
+    return MAX_JOB_ID
+
+
+def age_table():
+    return list(AGES)
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {f"w{i}": i for i in range(_TITLE_VOCAB)}
+
+
+def _rows(split, n, seed):
+    data = common.cached_npz(f"movielens_{split}")
+    if data is not None:
+        return data["rows"]
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n):
+        user = rng.randint(1, MAX_USER_ID + 1)
+        gender = rng.randint(0, 2)
+        age = rng.randint(0, len(AGES))
+        job = rng.randint(0, MAX_JOB_ID + 1)
+        movie = rng.randint(1, MAX_MOVIE_ID + 1)
+        cats = rng.choice(len(CATEGORIES), size=rng.randint(1, 4),
+                          replace=False).tolist()
+        title = rng.randint(0, _TITLE_VOCAB, size=rng.randint(1, 6)).tolist()
+        # synthetic-but-learnable rating: hash of user/movie buckets
+        rating = float((user * 7 + movie * 13) % 5 + 1)
+        rows.append((user, gender, age, job, movie, cats, title, rating))
+    return rows
+
+
+def _reader(split, n, seed):
+    def reader():
+        for row in _rows(split, n, seed):
+            yield row
+    return reader
+
+
+def train():
+    return _reader("train", 4096, 70)
+
+
+def test():
+    return _reader("test", 512, 71)

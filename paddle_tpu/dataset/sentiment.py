@@ -1,0 +1,40 @@
+"""Movie-review sentiment reader (reference:
+python/paddle/dataset/sentiment.py — NLTK movie_reviews; get_word_dict(),
+train()/test() yielding (word-id list, 0/1 label))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+VOCAB = 5147
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _reader(split, n, seed):
+    def reader():
+        data = common.cached_npz(f"sentiment_{split}")
+        if data is not None:
+            for ids, y in zip(data["ids"], data["y"]):
+                yield list(map(int, ids)), int(y)
+            return
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            slen = rng.randint(4, 24)
+            ids = rng.randint(0, VOCAB, size=slen)
+            # learnable: positive iff mean id below vocab midpoint
+            y = int(ids.mean() < VOCAB / 2)
+            yield ids.tolist(), y
+    return reader
+
+
+def train():
+    return _reader("train", 1024, 120)
+
+
+def test():
+    return _reader("test", 256, 121)

@@ -1,0 +1,40 @@
+"""PASCAL VOC2012 segmentation reader (reference:
+python/paddle/dataset/voc2012.py — train()/test()/val() yielding
+(3xHxW image, HxW label mask))."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+N_CLASSES = 21
+IMG_SHAPE = (3, 128, 128)     # reference images vary; synthetic fixed size
+
+
+def _reader(split, n, seed):
+    def reader():
+        data = common.cached_npz(f"voc2012_{split}")
+        if data is not None:
+            for x, y in zip(data["x"], data["y"]):
+                yield x, y
+            return
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img = rng.rand(*IMG_SHAPE).astype(np.float32)
+            # blocky learnable mask: argmax over channel thresholds
+            mask = (img[0] * N_CLASSES).astype(np.int64) % N_CLASSES
+            yield img, mask
+    return reader
+
+
+def train():
+    return _reader("train", 128, 130)
+
+
+def test():
+    return _reader("test", 32, 131)
+
+
+def val():
+    return _reader("val", 32, 132)

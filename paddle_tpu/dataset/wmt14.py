@@ -1,0 +1,43 @@
+"""WMT14 en-fr reader (reference: python/paddle/dataset/wmt14.py —
+train(dict_size)/test(dict_size) yielding (src_ids, trg_ids, trg_ids_next)
+with <s>/<e>/<unk> framing)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+START = 0        # <s>
+END = 1          # <e>
+UNK = 2          # <unk>
+
+
+def _reader(split, dict_size, n, seed):
+    def reader():
+        data = common.cached_npz(f"wmt14_{split}_{dict_size}")
+        if data is not None:
+            pairs = list(zip(data["src"], data["trg"]))
+        else:
+            rng = np.random.RandomState(seed)
+            pairs = []
+            for _ in range(n):
+                slen = rng.randint(3, 12)
+                src = rng.randint(3, dict_size, size=slen).tolist()
+                # learnable synthetic task: target = reversed source
+                trg = list(reversed(src))
+                pairs.append((src, trg))
+        for src, trg in pairs:
+            src_ids = [START] + list(map(int, src)) + [END]
+            trg_ids = [START] + list(map(int, trg))
+            trg_next = list(map(int, trg)) + [END]
+            yield src_ids, trg_ids, trg_next
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader("train", dict_size, 2048, 80)
+
+
+def test(dict_size=30000):
+    return _reader("test", dict_size, 256, 81)

@@ -1,0 +1,27 @@
+"""WMT16 en-de reader (reference: python/paddle/dataset/wmt16.py —
+train/test/validation(src_dict_size, trg_dict_size, src_lang) with BPE
+dicts; same (src, trg, trg_next) framing as wmt14)."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import wmt14
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return wmt14._reader("wmt16_train", min(src_dict_size, trg_dict_size),
+                         2048, 90)
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return wmt14._reader("wmt16_test", min(src_dict_size, trg_dict_size),
+                         256, 91)
+
+
+def validation(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return wmt14._reader("wmt16_val", min(src_dict_size, trg_dict_size),
+                         256, 92)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {i: f"{lang}_tok_{i}" for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d

@@ -36,3 +36,5 @@ __all__ = [
     "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
     "AsyncExecutor", "DataFeedDesc",
 ]
+
+from paddle_tpu.fluid import debugger  # noqa: F401,E402

@@ -16,6 +16,7 @@ from typing import Optional
 
 _events = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": float("inf"),
                                "max": 0.0})
+_spans = []          # (name, start_s, end_s) while active — timeline source
 _active = False
 
 
@@ -26,16 +27,49 @@ def record_event(name: str):
     try:
         yield
     finally:
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         e = _events[name]
         e["calls"] += 1
         e["total"] += dt
         e["min"] = min(e["min"], dt)
         e["max"] = max(e["max"], dt)
+        if _active:
+            _spans.append((name, t0, t1))
 
 
 def reset_profiler():
     _events.clear()
+    _spans.clear()
+
+
+def export_spans(path: str):
+    """Write (name, start, end) span rows (csv-quoted — names are arbitrary
+    caller strings) — input for tools/timeline.py."""
+    import csv
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        for name, t0, t1 in _spans:
+            w.writerow([name, t0, t1])
+
+
+def spans_to_chrome_trace(spans, pid=0):
+    """(name, start_s, end_s[, tid]) rows → chrome://tracing JSON dict
+    (reference capability: tools/timeline.py output format)."""
+    events = []
+    for row in spans:
+        name, start, end = row[0], float(row[1]), float(row[2])
+        tid = int(row[3]) if len(row) > 3 else 0
+        events.append({"name": name, "cat": "host", "ph": "X",
+                       "ts": start * 1e6, "dur": (end - start) * 1e6,
+                       "pid": pid, "tid": tid})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str):
+    import json
+    with open(path, "w") as f:
+        json.dump(spans_to_chrome_trace(_spans), f)
 
 
 def start_profiler(state: str = "All", tracer_option: Optional[str] = None,

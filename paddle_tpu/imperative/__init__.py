@@ -1,0 +1,19 @@
+"""Imperative (dygraph) prototype — eager op execution with a recorded
+tape (reference: paddle/fluid/imperative/ — VarBase with RunBackward
+layer.h:97,130, OpBase holding its grad desc layer.h:156, Tracer::Trace
+recording ops as they run tracer.cc:42, exposed via pybind/imperative.cc;
+python side python/paddle/fluid/imperative/).
+
+TPU-native design: every op executes immediately through the same emitter
+registry the compiled path uses (ops run op-by-op on device — eager means
+per-op dispatch, exactly the trade the reference makes), while the Tracer
+appends (op, inputs, outputs) to a tape. `backward()` walks the tape in
+reverse pulling per-op VJPs from `jax.vjp` over the forward emitter — the
+same single-grad-rule design as the graph path's __vjp__ op, so eager and
+graph gradients can never diverge.
+"""
+
+from paddle_tpu.imperative.base import (  # noqa: F401
+    Layer, Tracer, VarBase, enabled, guard, to_variable)
+
+__all__ = ["Layer", "Tracer", "VarBase", "enabled", "guard", "to_variable"]

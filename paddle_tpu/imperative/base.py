@@ -1,0 +1,254 @@
+"""Eager-mode core: VarBase, Tracer, Layer (see package docstring).
+
+reference: imperative/layer.h:97 VarBase, :130 RunBackward, :156 OpBase,
+imperative/tracer.cc:42 Tracer::Trace, python/paddle/fluid/imperative/
+(base.py guard/enabled, layers.py Layer, nn.py FC/Conv2D)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import EmitContext, get_op
+
+
+class VarBase:
+    """Eager tensor: a jax array + grad slot + the tape edge that made it
+    (reference: imperative/layer.h:97)."""
+
+    _counter = [0]
+
+    def __init__(self, value, stop_gradient=False, name=None):
+        self.value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[jnp.ndarray] = None
+        VarBase._counter[0] += 1
+        self.name = name or f"eager_var_{VarBase._counter[0]}"
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def backward(self):
+        """Reverse-mode over the recorded tape from this scalar
+        (reference: VarBase::RunBackward layer.h:130)."""
+        _tracer().run_backward(self)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape})"
+
+
+class _TapeEntry:
+    __slots__ = ("op_type", "attrs", "ins", "outs", "op_index")
+
+    def __init__(self, op_type, attrs, ins, outs, op_index):
+        self.op_type = op_type
+        self.attrs = attrs
+        self.ins = ins        # {slot: [VarBase]}
+        self.outs = outs      # {slot: [VarBase]}
+        self.op_index = op_index
+
+
+class Tracer:
+    """Records eagerly-executed ops (reference: imperative/tracer.cc:42)."""
+
+    def __init__(self, seed: int = 0):
+        self.tape: List[_TapeEntry] = []
+        self._key = jax.random.PRNGKey(seed)
+        self._op_index = 0
+
+    def trace(self, op_type: str, ins: Dict[str, List[VarBase]],
+              attrs: Optional[dict] = None, out_slots=("Out",)) \
+            -> Dict[str, List[VarBase]]:
+        """Execute `op_type` now; return {slot: [VarBase]}."""
+        attrs = attrs or {}
+        spec = get_op(op_type)
+        ctx = EmitContext(base_key=self._key, step_base_key=self._key,
+                          op_index=self._op_index, is_test=False)
+        self._op_index += 1
+        jin = {slot: [v.value for v in vs] for slot, vs in ins.items()}
+        jout = spec.emit(ctx, jin, attrs)
+        outs = {slot: [VarBase(a, stop_gradient=True) for a in vals]
+                for slot, vals in jout.items()}
+        needs_grad = (not spec.no_grad) and any(
+            not v.stop_gradient for vs in ins.values() for v in vs)
+        if needs_grad:
+            for vs in outs.values():
+                for v in vs:
+                    v.stop_gradient = False
+            self.tape.append(_TapeEntry(op_type, attrs, dict(ins),
+                                        dict(outs), ctx.op_index))
+        return outs
+
+    def run_backward(self, loss: VarBase):
+        if int(np.prod(loss.shape)) != 1:
+            raise ValueError("backward() needs a scalar loss")
+        grads: Dict[int, jnp.ndarray] = {
+            id(loss): jnp.ones_like(loss.value)}
+
+        for entry in reversed(self.tape):
+            out_slots = sorted(entry.outs)
+            in_slots = sorted(entry.ins)
+            out_gs = []
+            any_grad = False
+            for slot in out_slots:
+                for v in entry.outs[slot]:
+                    g = grads.get(id(v))
+                    if g is None:
+                        g = jnp.zeros_like(v.value)
+                    else:
+                        any_grad = True
+                    out_gs.append(g)
+            if not any_grad:
+                continue
+            # re-trace the forward emitter under vjp w.r.t. the diff inputs
+            spec = get_op(entry.op_type)
+            ctx = EmitContext(base_key=self._key, step_base_key=self._key,
+                              op_index=entry.op_index, is_test=False)
+            flat_in = [v for slot in in_slots for v in entry.ins[slot]]
+            diff_idx = [i for i, v in enumerate(flat_in)
+                        if not v.stop_gradient
+                        and jnp.issubdtype(v.value.dtype, jnp.inexact)]
+            if not diff_idx:
+                continue
+
+            def fwd(diff_vals):
+                vals = [v.value for v in flat_in]
+                for i, dv in zip(diff_idx, diff_vals):
+                    vals[i] = dv
+                it = iter(vals)
+                jin = {slot: [next(it) for _ in entry.ins[slot]]
+                       for slot in in_slots}
+                jout = spec.emit(ctx, jin, entry.attrs)
+                return tuple(a for slot in out_slots for a in jout[slot])
+
+            primal_in = tuple(flat_in[i].value for i in diff_idx)
+            _, vjp_fn = jax.vjp(fwd, primal_in)
+            # zero cotangents for non-float outputs
+            outs_flat = [v for slot in out_slots for v in entry.outs[slot]]
+            cts = tuple(g.astype(v.value.dtype)
+                        for g, v in zip(out_gs, outs_flat))
+            (d_in,) = vjp_fn(cts)
+            for i, g in zip(diff_idx, d_in):
+                v = flat_in[i]
+                prev = grads.get(id(v))
+                grads[id(v)] = g if prev is None else prev + g
+
+        # surface accumulated grads on every tape variable
+        for entry in self.tape:
+            for vs in entry.ins.values():
+                for v in vs:
+                    if id(v) in grads and not v.stop_gradient:
+                        v.grad = grads[id(v)]
+
+    def reset(self):
+        self.tape.clear()
+        self._op_index = 0
+
+
+_active_tracer: Optional[Tracer] = None
+
+
+def _tracer() -> Tracer:
+    if _active_tracer is None:
+        raise RuntimeError("no imperative guard active — use "
+                           "`with imperative.guard():` (reference: "
+                           "python/paddle/fluid/imperative/base.py guard)")
+    return _active_tracer
+
+
+def enabled() -> bool:
+    return _active_tracer is not None
+
+
+@contextlib.contextmanager
+def guard(seed: int = 0):
+    """reference: imperative/base.py to_variable/guard context."""
+    global _active_tracer
+    prev = _active_tracer
+    _active_tracer = Tracer(seed)
+    try:
+        yield _active_tracer
+    finally:
+        _active_tracer = prev
+
+
+def to_variable(value, stop_gradient=False) -> VarBase:
+    return VarBase(np.asarray(value), stop_gradient=stop_gradient)
+
+
+class Layer:
+    """Eager layer base with parameter tracking (reference:
+    python/paddle/fluid/imperative/layers.py Layer)."""
+
+    def __init__(self, name_scope: str = ""):
+        self._name = name_scope
+        self._params: Dict[str, VarBase] = {}
+        self._sublayers: Dict[str, "Layer"] = {}
+
+    def create_parameter(self, name, shape, dtype="float32",
+                         initializer=None, seed=0):
+        rng = np.random.RandomState(seed if seed else abs(hash(name)) %
+                                    (2 ** 31))
+        if initializer == "zeros":
+            val = np.zeros(shape, dtype)
+        else:
+            fan_in = int(np.prod(shape[:-1])) or 1
+            val = (rng.randn(*shape) / np.sqrt(fan_in)).astype(dtype)
+        p = VarBase(val, stop_gradient=False, name=f"{self._name}.{name}")
+        self._params[name] = p
+        return p
+
+    def __setattr__(self, k, v):
+        if isinstance(v, Layer):
+            self.__dict__.setdefault("_sublayers", {})[k] = v
+        super().__setattr__(k, v)
+
+    def parameters(self) -> List[VarBase]:
+        out = list(self._params.values())
+        for sub in self._sublayers.values():
+            out.extend(sub.parameters())
+        return out
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+# -- eager functional ops ---------------------------------------------------
+
+def _t(op_type, ins, attrs=None, out_slot="Out"):
+    return _tracer().trace(op_type, ins, attrs)[out_slot][0]
+
+
+class FC(Layer):
+    """reference: imperative/nn.py FC."""
+
+    def __init__(self, name_scope, size, input_dim, act=None):
+        super().__init__(name_scope)
+        self.w = self.create_parameter("w", [input_dim, size])
+        self.b = self.create_parameter("b", [size], initializer="zeros")
+        self.act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = _t("mul", {"X": [x], "Y": [self.w]})
+        y = _t("elementwise_add", {"X": [y], "Y": [self.b]})
+        if self.act:
+            y = _t(self.act, {"X": [y]})
+        return y

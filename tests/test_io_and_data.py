@@ -143,3 +143,36 @@ def test_dataset_zoo_readers():
     assert len(x) == 13
     ids, lab = next(iter(ds.imdb.train()()))
     assert len(ids) >= 10 and lab in (0, 1)
+
+
+def test_dataset_zoo_breadth():
+    """Every dataset module yields samples with the reference's tuple
+    shapes (reference: python/paddle/dataset/ — movielens, wmt14/16,
+    flowers, conll05, sentiment, voc2012)."""
+    import numpy as np
+    from paddle_tpu import dataset
+
+    row = next(dataset.movielens.train()())
+    assert len(row) == 8 and 1 <= row[-1] <= 5
+
+    src, trg, trg_next = next(dataset.wmt14.train(100)())
+    assert src[0] == dataset.wmt14.START and src[-1] == dataset.wmt14.END
+    assert trg[1:] == trg_next[:-1]
+
+    src16, _, _ = next(dataset.wmt16.train(100, 100)())
+    assert src16[0] == dataset.wmt14.START
+
+    img, lbl = next(dataset.flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= lbl < 102
+
+    srl = next(dataset.conll05.test()())
+    assert len(srl) == 9 and len(srl[0]) == len(srl[-1])
+    wd, vd, ld = dataset.conll05.get_dict()
+    assert len(ld) == dataset.conll05.LABEL_COUNT
+    assert dataset.conll05.get_embedding().shape[1] == 32
+
+    ids, y = next(dataset.sentiment.train()())
+    assert y in (0, 1) and len(ids) >= 1
+
+    img, mask = next(dataset.voc2012.train()())
+    assert img.shape == (3, 128, 128) and mask.shape == (128, 128)

@@ -1,0 +1,62 @@
+"""Debug tooling tests: graphviz dump, timeline export, nan/inf checker
+(reference: debugger.py draw_block_graphviz, tools/timeline.py,
+FLAGS_check_nan_inf operator.cc:978)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import debugger, profiler
+
+
+def _small_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 2, act="relu")
+        loss = fluid.layers.mean(y)
+    return main, startup, loss
+
+
+def test_draw_block_graphviz(tmp_path):
+    main, _, _ = _small_program()
+    path = str(tmp_path / "g.dot")
+    dot = debugger.draw_program(main, path=path)
+    assert dot.startswith("digraph")
+    assert "mul" in dot and "reduce" in dot.lower() or "mean" in dot
+    assert os.path.exists(path)
+
+
+def test_profiler_timeline_export(tmp_path):
+    main, startup, loss = _small_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    trace = str(tmp_path / "trace.json")
+    with profiler.profiler():
+        with profiler.record_event("train_step"):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss.name])
+        profiler.export_chrome_trace(trace)
+    data = json.load(open(trace))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "train_step" in names
+
+
+def test_check_nan_inf_flag(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "1")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)        # log of negative → nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(FloatingPointError, match="check_nan_inf"):
+        exe.run(main, feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                fetch_list=[y.name])
+    # clean input passes
+    out = exe.run(main, feed={"x": np.array([[1.0, 2.0]], np.float32)},
+                  fetch_list=[y.name])
+    assert np.isfinite(out[0]).all()

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Chrome-trace timeline exporter (reference: tools/timeline.py — converts
+the profiler proto to chrome://tracing JSON; here the host event spans
+recorded by paddle_tpu.fluid.profiler become trace events directly, and
+device-side traces come from jax.profiler's TensorBoard/Perfetto dump,
+which already IS a timeline — this tool covers the host half).
+
+Usage:
+    python tools/timeline.py --profile_path spans.csv --timeline_path out.json
+or programmatically: profiler.export_chrome_trace(path)."""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+
+from paddle_tpu.fluid.profiler import spans_to_chrome_trace
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True,
+                    help="span csv written by profiler.export_spans")
+    ap.add_argument("--timeline_path", required=True)
+    args = ap.parse_args()
+    with open(args.profile_path, newline="") as f:
+        spans = [row for row in csv.reader(f) if len(row) >= 3]
+    with open(args.timeline_path, "w") as f:
+        json.dump(spans_to_chrome_trace(spans), f)
+    print(f"wrote {args.timeline_path} ({len(spans)} events) — open in "
+          f"chrome://tracing or ui.perfetto.dev")
+
+
+if __name__ == "__main__":
+    main()
