@@ -1,0 +1,156 @@
+"""Final op-batch tests (reference OpTest files: test_mean_iou.py,
+test_average_accumulates_op.py (via ModelAverage tests),
+test_pool_max_op.py 3D, test_split_ids_op.py, test_merge_ids_op.py,
+test_split_selected_rows_op.py, test_generate_proposal_labels.py,
+test_save_load_op (book save/load tests), test_lstm_cudnn.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import run_single_op
+
+
+def _r(*shape, seed=0, lo=-0.5, hi=0.5):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def test_registry_closure_vs_reference():
+    """Every reference-registered forward op resolves here (SURVEY §2 #16:
+    the ~347-op corpus; 'op_type' is the macro-doc grep artifact)."""
+    import paddle_tpu
+    from paddle_tpu.core.registry import OPS
+    import os
+    ref_file = os.path.join(os.path.dirname(__file__),
+                            "data_reference_ops.txt")
+    ref = [l.strip() for l in open(ref_file)]
+    missing = [r for r in ref
+               if r not in OPS and not r.endswith("_grad")
+               and r != "op_type"]
+    assert not missing, missing
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2, 2, 2], np.int32)
+    lbl = np.array([0, 1, 2, 2, 2, 1], np.int32)
+    out = run_single_op("mean_iou",
+                        {"Predictions": {"p": pred}, "Labels": {"l": lbl}},
+                        attrs={"num_classes": 3},
+                        out_slots=("OutMeanIou", "OutWrong", "OutCorrect"))
+    # class0: 1/1; class1: tp=1, fp=1, fn=1 → 1/3; class2: tp=2, fp=1,
+    # fn=1 → 2/4
+    np.testing.assert_allclose(float(out["__out_OutMeanIou_0"]),
+                               (1.0 + 1 / 3 + 0.5) / 3, rtol=1e-5)
+
+
+def test_average_accumulates_window():
+    p = np.ones((4,), np.float32)
+    zeros = np.zeros((4,), np.float32)
+    state = {"s1": zeros, "s2": zeros, "s3": zeros}
+    nacc = np.array([0], np.int64)
+    oldn = np.array([0], np.int64)
+    nupd = np.array([0], np.int64)
+    out = run_single_op(
+        "average_accumulates",
+        {"param": {"p": p}, "in_sum_1": {"s1": state["s1"]},
+         "in_sum_2": {"s2": state["s2"]}, "in_sum_3": {"s3": state["s3"]},
+         "in_num_accumulates": {"na": nacc},
+         "in_old_num_accumulates": {"no": oldn},
+         "in_num_updates": {"nu": nupd}},
+        attrs={"average_window": 2.0, "max_average_window": 10,
+               "min_average_window": 1},
+        out_slots=("out_sum_1", "out_sum_2", "out_sum_3",
+                   "out_num_accumulates", "out_old_num_accumulates",
+                   "out_num_updates"))
+    # first update: num_acc=1 >= min_win 1 and >= min(10, 1*2)=2? no (1<2)
+    # → plain accumulate
+    np.testing.assert_allclose(out["__out_out_sum_1_0"], p)
+    assert int(out["__out_out_num_updates_0"][0]) == 1
+
+
+def test_max_pool3d_with_index():
+    x = _r(1, 1, 4, 4, 4, lo=-1.0)
+    out = run_single_op("max_pool3d_with_index", {"X": {"x": x}},
+                        attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2]},
+                        out_slots=("Out", "Mask"))
+    assert out["__out_Out_0"].shape == (1, 1, 2, 2, 2)
+    np.testing.assert_allclose(out["__out_Out_0"].max(), x.max(), rtol=1e-6)
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([0, 3, 4, 7, 2], np.int64)
+    sp = run_single_op("split_ids", {"Ids": {"i": ids}},
+                       attrs={"n_parts": 2}, n_out=2)
+    s0, s1 = sp["__out_Out_0"], sp["__out_Out_1"]
+    np.testing.assert_array_equal(s0, [0, -1, 4, -1, 2])
+    np.testing.assert_array_equal(s1, [-1, 3, -1, 7, -1])
+    # merge rows back: shard k provides rows where it owns the id
+    rows0 = np.tile((ids % 2 == 0)[:, None] * 10.0, (1, 3)).astype(np.float32)
+    rows1 = np.tile((ids % 2 == 1)[:, None] * 20.0, (1, 3)).astype(np.float32)
+    mg = run_single_op("merge_ids",
+                       {"Ids": {"i": ids}, "X": {"r0": rows0, "r1": rows1}})
+    expect = np.where((ids % 2 == 0)[:, None], 10.0, 20.0)
+    np.testing.assert_allclose(mg["__out_Out_0"],
+                               np.tile(expect, (1, 3)), rtol=1e-6)
+
+
+def test_split_selected_rows_sections():
+    x = _r(6, 3)
+    out = run_single_op("split_selected_rows", {"X": {"x": x}},
+                        attrs={"height_sections": [2, 4]}, n_out=2)
+    np.testing.assert_allclose(out["__out_Out_0"], x[:2], rtol=1e-6)
+    np.testing.assert_allclose(out["__out_Out_1"], x[2:], rtol=1e-6)
+
+
+def test_conditional_block_alias():
+    from paddle_tpu.core.registry import has_op
+    assert has_op("conditional_block") and has_op("cudnn_lstm")
+
+
+def test_cudnn_lstm_packed():
+    t, b, d, h, layers = 3, 2, 4, 3, 2
+    rng = np.random.RandomState(0)
+    sizes = []
+    for layer in range(layers):
+        din = d if layer == 0 else h
+        sizes += [din * 4 * h, h * 4 * h, 4 * h]
+    w = (rng.rand(sum(sizes)) * 0.2 - 0.1).astype(np.float32)
+    x = _r(t, b, d)
+    out = run_single_op("cudnn_lstm",
+                        {"Input": {"x": x}, "W": {"w": w}},
+                        attrs={"hidden_size": h, "num_layers": layers},
+                        out_slots=("Out", "last_h", "last_c"))
+    assert out["__out_Out_0"].shape == (t, b, h)
+    assert np.isfinite(out["__out_Out_0"]).all()
+
+
+def test_generate_proposal_labels_sampling():
+    rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30], [0, 0, 9, 9],
+                      [50, 50, 60, 60]]], np.float32)
+    gt = np.array([[[0, 0, 10, 10]]], np.float32)
+    gtc = np.array([[3]], np.int32)
+    out = run_single_op("generate_proposal_labels",
+                        {"RpnRois": {"r": rois}, "GtBoxes": {"g": gt},
+                         "GtClasses": {"c": gtc}},
+                        attrs={"batch_size_per_im": 4, "fg_fraction": 0.5,
+                               "fg_thresh": 0.5},
+                        out_slots=("Rois", "LabelsInt32", "BboxTargets",
+                                   "BboxInsideWeights",
+                                   "BboxOutsideWeights"))
+    labels = out["__out_LabelsInt32_0"][0]
+    assert labels[0] == 3            # IoU 1.0 roi gets the gt class
+    assert (labels >= -1).all()
+
+
+def test_save_load_op_roundtrip(tmp_path):
+    x = _r(3, 4)
+    path = str(tmp_path / "t.npy")
+    run_single_op("save", {"X": {"x": x}}, attrs={"file_path": path})
+    out = run_single_op("load", {}, attrs={"file_path": path})
+    np.testing.assert_allclose(out["__out_Out_0"], x, rtol=1e-6)
+
+
+def test_redirect_ops_raise_helpfully():
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="paddle_tpu.parallel"):
+        run_single_op("send", {"X": {"x": _r(2, 2)}})
