@@ -29,3 +29,5 @@ def batch(reader, batch_size, drop_last=False):
         if b and not drop_last:
             yield b
     return batch_reader
+
+from paddle_tpu import dataset, imperative, reader, trainer  # noqa: F401,E402

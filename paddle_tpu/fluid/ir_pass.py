@@ -1,0 +1,272 @@
+"""Graph IR + pass system (reference: framework/ir/ — Graph/Node ir/graph.h
+ir/node.h, Pass/PassRegistry ir/pass.h, PassBuilder ir/pass_builder.cc,
+GraphPatternDetector ir/graph_pattern_detector.cc, and the fusion-pass
+family: fc_fuse_pass.cc, conv_bn_fuse_pass.cc, graph_viz_pass.cc,
+graph_to_program_pass.cc).
+
+TPU-native scope note: the reference needs ~25 fusion passes because its
+interpreter executes ops one kernel at a time — fusion is the only way two
+ops share registers. Under XLA the compiler fuses automatically, so passes
+here exist for (a) *semantic* rewrites XLA cannot do (BN folding uses
+trained statistics; fc fusion changes the op-level program the transpilers
+and serializers see) and (b) diagnostics (graphviz). The Graph is a live
+view over a BlockDesc: mutations write through and graph_to_program is the
+identity (the reference needs an explicit round-trip pass)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from paddle_tpu.core import ir
+
+
+class Node:
+    """reference: ir/node.h — either an op node or a var node."""
+
+    def __init__(self, kind: str, name: str, op: Optional[ir.OpDesc] = None):
+        self.kind = kind              # "op" | "var"
+        self.name = name
+        self.op = op
+        self.inputs: List["Node"] = []
+        self.outputs: List["Node"] = []
+
+    def is_op(self):
+        return self.kind == "op"
+
+    def __repr__(self):
+        return f"Node({self.kind}:{self.name})"
+
+
+class Graph:
+    """Dataflow view over a BlockDesc (reference: ir/graph.h — built from a
+    ProgramDesc; here mutations write through to the block)."""
+
+    def __init__(self, block: ir.BlockDesc):
+        self.block = block
+        self.rebuild()
+
+    def rebuild(self):
+        self.op_nodes: List[Node] = []
+        self.var_nodes: Dict[str, Node] = {}
+        for i, op in enumerate(self.block.ops):
+            onode = Node("op", f"{op.type}#{i}", op)
+            self.op_nodes.append(onode)
+            for names in op.inputs.values():
+                for n in names:
+                    vn = self.var_nodes.setdefault(n, Node("var", n))
+                    onode.inputs.append(vn)
+                    vn.outputs.append(onode)
+            for names in op.outputs.values():
+                for n in names:
+                    vn = self.var_nodes.setdefault(n, Node("var", n))
+                    onode.outputs.append(vn)
+                    vn.inputs.append(onode)
+
+    def producer(self, var_name: str) -> Optional[Node]:
+        vn = self.var_nodes.get(var_name)
+        return vn.inputs[-1] if vn and vn.inputs else None
+
+    def consumers(self, var_name: str) -> List[Node]:
+        vn = self.var_nodes.get(var_name)
+        return list(vn.outputs) if vn else []
+
+    def remove_ops(self, ops: List[ir.OpDesc]):
+        drop = {id(o) for o in ops}
+        self.block.ops[:] = [o for o in self.block.ops
+                             if id(o) not in drop]
+        self.rebuild()
+
+
+class PatternDetector:
+    """Linear-chain pattern matcher (the working core of the reference's
+    GraphPatternDetector, ir/graph_pattern_detector.cc — full DAG patterns
+    reduce to chains for every fusion pass shipped here)."""
+
+    def __init__(self, graph: Graph):
+        self.graph = graph
+
+    def match_chain(self, op_types: List[str], single_use: bool = True):
+        """Yield lists of OpDescs [op0, op1, ...] where op_{i}'s first
+        output feeds op_{i+1} and (optionally) has no other consumer."""
+        matches = []
+        for node in self.graph.op_nodes:
+            if node.op.type != op_types[0]:
+                continue
+            chain = [node]
+            ok = True
+            for want in op_types[1:]:
+                out_vars = [v for v in chain[-1].outputs]
+                nxt = None
+                for v in out_vars:
+                    cons = v.outputs
+                    if single_use and len(cons) != 1:
+                        continue
+                    if cons and cons[0].op.type == want:
+                        nxt = cons[0]
+                        break
+                if nxt is None:
+                    ok = False
+                    break
+                chain.append(nxt)
+            if ok:
+                matches.append([n.op for n in chain])
+        return matches
+
+
+class Pass:
+    """reference: ir/pass.h — apply(graph) -> graph, mutating in place."""
+
+    name = "pass"
+
+    def apply(self, graph: Graph) -> Graph:
+        raise NotImplementedError
+
+    def __call__(self, graph: Graph) -> Graph:
+        # passes mutate through the live block view; apply may return the
+        # same graph or None
+        return self.apply(graph) or graph
+
+
+_PASS_REGISTRY: Dict[str, Callable[[], Pass]] = {}
+
+
+def register_pass(name: str):
+    """reference: REGISTER_PASS (ir/pass.h)."""
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"no pass {name!r}; registered: "
+                       f"{sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name]()
+
+
+class PassBuilder:
+    """Ordered pass pipeline (reference: ir/pass_builder.cc; the
+    BuildStrategy::Apply pipeline in details/build_strategy.cc)."""
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self._names = list(passes or [])
+
+    def append_pass(self, name: str):
+        self._names.append(name)
+        return self
+
+    def insert_pass(self, idx: int, name: str):
+        self._names.insert(idx, name)
+        return self
+
+    def remove_pass(self, idx: int):
+        self._names.pop(idx)
+        return self
+
+    def all_passes(self):
+        return list(self._names)
+
+    def apply(self, program, scope=None, place=None):
+        graph = Graph(program.desc.global_block)
+        for name in self._names:
+            p = get_pass(name)
+            if hasattr(p, "scope"):
+                p.scope = scope
+            graph = p(graph)
+        program.desc.bump_version()
+        return graph
+
+
+@register_pass("fc_fuse_pass")
+class FcFusePass(Pass):
+    """mul + elementwise_add (+relu) → fc (reference: ir/fc_fuse_pass.cc).
+    A semantic rewrite at the program level; XLA fuses either form, so the
+    win is a smaller serialized program and fc-aware downstream passes."""
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        fused = []
+        for ops in (det.match_chain(["mul", "elementwise_add", "relu"])
+                    + det.match_chain(["mul", "elementwise_add"])):
+            mul, add = ops[0], ops[1]
+            if id(mul) in {id(o) for f in fused for o in f}:
+                continue
+            relu = ops[2] if len(ops) == 3 else None
+            if mul.attrs.get("y_num_col_dims", 1) != 1:
+                continue
+            # the fc pattern requires: mul's output is the add's X operand
+            # and the add's Y is a rank-1 (bias) var (fc_fuse_pass.cc
+            # pattern constraints) — anything else is not an fc bias add
+            mul_out = mul.outputs["Out"][0]
+            if add.inputs.get("X", [None])[0] != mul_out:
+                continue
+            bias_name = add.inputs.get("Y", [None])[0]
+            if bias_name is None:
+                continue
+            bvd = (graph.block.var(bias_name)
+                   if graph.block.has_var(bias_name) else None)
+            bshape = list(bvd.shape or []) if bvd is not None else []
+            if len([d for d in bshape if d != 1]) > 1:
+                continue
+            out = (relu or add).outputs["Out"][0]
+            fc = ir.OpDesc(
+                type="fc",
+                inputs={"Input": list(mul.inputs["X"]),
+                        "W": list(mul.inputs["Y"]),
+                        "Bias": list(add.inputs["Y"])},
+                outputs={"Out": [out]},
+                attrs={"in_num_col_dims": mul.attrs.get("x_num_col_dims", 1),
+                       "activation_type": "relu" if relu else ""})
+            idx = graph.block.ops.index(mul)
+            graph.block.ops[idx] = fc
+            graph.remove_ops([add] + ([relu] if relu else []))
+            fused.append(ops)
+        return graph
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBnFusePass(Pass):
+    """conv + batch_norm statistic folding (reference:
+    ir/conv_bn_fuse_pass.cc) — delegates to the inference transpiler's
+    numeric fold; requires a scope with trained statistics."""
+
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        from paddle_tpu.inference.transpiler import InferenceTranspiler
+
+        class _P:           # transpiler wants a .desc-bearing program
+            pass
+
+        prog = _P()
+        prog.desc = type("D", (), {"global_block": graph.block,
+                                   "bump_version": lambda self=None: None})()
+        InferenceTranspiler().transpile(prog, scope=self.scope)
+        graph.rebuild()
+        return graph
+
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """reference: ir/graph_viz_pass.cc + FLAGS_debug_graphviz_path."""
+
+    path: Optional[str] = None
+
+    def apply(self, graph: Graph) -> Graph:
+        import os
+        from paddle_tpu.fluid import debugger
+        path = self.path or os.environ.get("FLAGS_debug_graphviz_path")
+        if path:
+            debugger.draw_block_graphviz(graph.block, path=path)
+        return graph
+
+
+@register_pass("graph_to_program_pass")
+class GraphToProgramPass(Pass):
+    """reference: ir/graph_to_program_pass.cc — the Graph here IS a live
+    block view, so the round-trip is the identity."""
+
+    def apply(self, graph: Graph) -> Graph:
+        return graph

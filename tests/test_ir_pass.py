@@ -1,0 +1,149 @@
+"""Graph IR + pass system tests (reference: framework/ir/ pass tests —
+test_fc_fuse_pass, test_graph via pybind ir tests)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.ir_pass import (Graph, PassBuilder, PatternDetector,
+                                      get_pass)
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")     # mul + add + relu
+        y = fluid.layers.fc(h, 4)                  # mul + add
+        loss = fluid.layers.mean(y)
+    return main, startup, loss
+
+
+def test_graph_view_and_pattern_detector():
+    main, _, _ = _mlp_program()
+    g = Graph(main.desc.global_block)
+    det = PatternDetector(g)
+    chains = det.match_chain(["mul", "elementwise_add", "relu"])
+    assert len(chains) == 1
+    assert [o.type for o in chains[0]] == ["mul", "elementwise_add", "relu"]
+
+
+def test_fc_fuse_pass_preserves_semantics():
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.random.RandomState(0).rand(4, 8).astype(np.float32)}
+    (before,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+
+    g = Graph(main.desc.global_block)
+    get_pass("fc_fuse_pass")(g)
+    main.desc.bump_version()
+    types = [op.type for op in main.desc.global_block.ops]
+    assert types.count("fc") == 2
+    assert "mul" not in types and "elementwise_add" not in types
+
+    (after,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5)
+
+
+def test_pass_builder_pipeline(tmp_path):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    dot_path = str(tmp_path / "g.dot")
+    import os
+    os.environ["FLAGS_debug_graphviz_path"] = dot_path
+    try:
+        pb = PassBuilder(["fc_fuse_pass", "graph_viz_pass",
+                          "graph_to_program_pass"])
+        assert pb.all_passes()[0] == "fc_fuse_pass"
+        pb.apply(main)
+    finally:
+        del os.environ["FLAGS_debug_graphviz_path"]
+    assert os.path.exists(dot_path)
+    types = [op.type for op in main.desc.global_block.ops]
+    assert "fc" in types
+
+
+def test_conv_bn_fuse_pass_folds():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 6
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[2, 6, 6],
+                                dtype="float32")
+        c = fluid.layers.conv2d(img, 3, 3, padding=1)
+        bn = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.mean(bn)
+    main._is_test = True
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"img": np.random.RandomState(1).rand(2, 2, 6, 6)
+            .astype(np.float32)}
+    (before,) = exe.run(main, feed=feed, fetch_list=[out.name])
+
+    from paddle_tpu.core.scope import global_scope
+    g = Graph(main.desc.global_block)
+    p = get_pass("conv_bn_fuse_pass")
+    p.scope = global_scope()
+    p(g)
+    main.desc.bump_version()
+    types = [op.type for op in main.desc.global_block.ops]
+    assert "batch_norm" not in types
+    (after,) = exe.run(main, feed=feed, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fc_fuse_rejects_non_bias_add():
+    """mul output in the add's Y slot / non-bias addend must NOT fuse
+    (review repro: misfuse dropped the real addend)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core import ir as core_ir
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        a = fluid.layers.data(name="a", shape=[3], dtype="float32")
+        block = main.global_block()
+        w = block.create_var(name="w_nb", shape=[4, 3], dtype="float32")
+        m = block.create_var(name="m_nb", dtype="float32")
+        block.append_op("mul", inputs={"X": [x], "Y": ["w_nb"]},
+                        outputs={"Out": ["m_nb"]},
+                        attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+        o = block.create_var(name="o_nb", dtype="float32")
+        # mul output in the Y slot, batch-shaped addend in X → not fc
+        block.append_op("elementwise_add", inputs={"X": [a], "Y": ["m_nb"]},
+                        outputs={"Out": ["o_nb"]})
+    g = Graph(main.desc.global_block)
+    get_pass("fc_fuse_pass")(g)
+    types = [op.type for op in main.desc.global_block.ops]
+    assert "mul" in types and "elementwise_add" in types
+    assert "fc" not in types
+
+
+def test_trainer_test_does_not_mutate_params():
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import dataset, reader, trainer
+    from paddle_tpu.core.scope import global_scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    t = trainer.SGD(cost, main_program=main, startup_program=startup,
+                    place=fluid.CPUPlace())
+    br = reader.batch(dataset.uci_housing.train(), 32)
+    t.train(br, num_passes=1, feed_order=["x", "y"])
+    w_name = [v.name for v in main.global_block().vars.values()
+              if getattr(v, "persistable", False)
+              and "w" in v.name][0]
+    before = np.asarray(global_scope().find_var(w_name)).copy()
+    r1 = t.test(br, feed_order=["x", "y"])
+    r2 = t.test(br, feed_order=["x", "y"])
+    after = np.asarray(global_scope().find_var(w_name))
+    np.testing.assert_allclose(before, after)        # params untouched
+    assert abs(r1["mean_cost"] - r2["mean_cost"]) < 1e-6

@@ -159,3 +159,40 @@ def test_regularizer_and_grad_clip():
     (l0,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
     (l1,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
     assert np.isfinite(l1)
+
+
+def test_v2_style_event_trainer():
+    """Event-driven trainer loop capability (reference:
+    python/paddle/v2/trainer.py SGD + event.py; uci_housing regression is
+    the classic v2 quickstart)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import dataset, reader, trainer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 8
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+
+    events = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, trainer.EndPass):
+            events.append(("mean", e.metrics["mean_cost"]))
+
+    t = trainer.SGD(cost, main_program=main, startup_program=startup,
+                    place=fluid.CPUPlace())
+    batch_reader = reader.batch(dataset.uci_housing.train(), batch_size=32)
+    t.train(batch_reader, num_passes=2, event_handler=handler,
+            feed_order=["x", "y"])
+    assert "BeginPass" in events and "EndPass" in events
+    assert "EndIteration" in events
+    means = [v for k, v in [e for e in events if isinstance(e, tuple)]]
+    assert len(means) == 2 and means[1] < means[0]       # loss decreases
+    res = t.test(batch_reader, feed_order=["x", "y"])
+    assert np.isfinite(res["mean_cost"])
