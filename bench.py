@@ -42,7 +42,8 @@ def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None):
     return feeds
 
 
-def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
+def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
+              amp: bool = False):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -69,6 +70,9 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
     main.random_seed = 1
     with fluid.program_guard(main, startup):
         loss, _, feed_specs = build_fn(is_train=True, **kw)
+        if amp:
+            from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
+            rewrite_program_amp(main)
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
@@ -108,7 +112,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5):
     assert np.isfinite(lv), "loss went non-finite"
 
     return {
-        "metric": f"{model_name} train throughput (bs{batch_size}, 1 chip)",
+        "metric": f"{model_name} train throughput (bs{batch_size}"
+                  f"{', amp-bf16' if amp else ''}, 1 chip)",
         "value": round(float(value), 2),
         "unit": unit,
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
@@ -122,11 +127,14 @@ def main():
                              "stacked_dynamic_lstm"])
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--amp", dest="amp", action="store_true", default=True,
+                    help="bf16 MXU compute (fp32 master weights) — default")
+    ap.add_argument("--no-amp", dest="amp", action="store_false")
     args = ap.parse_args()
     bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
                              "transformer": 32, "mnist": 512,
                              "stacked_dynamic_lstm": 64}[args.model]
-    result = run_bench(args.model, bs, args.steps)
+    result = run_bench(args.model, bs, args.steps, amp=args.amp)
     print(json.dumps(result))
 
 

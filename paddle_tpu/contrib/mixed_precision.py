@@ -158,3 +158,36 @@ def _ge(a, b):
     """a >= b as a float-friendly bool tensor via the compare ops."""
     from paddle_tpu.fluid import layers
     return layers.greater_equal(a, b)
+
+
+AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
+                "conv2d_transpose", "fc")
+
+
+def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES):
+    """bf16 compute rewrite: tag every MXU op so its emitter casts float
+    inputs to bfloat16 and accumulates/returns fp32 (master weights stay
+    fp32 in the Scope — the later-fluid pure-bf16 AMP capability, done at
+    the op level so autodiff re-traces see the same cast).
+
+    bf16's fp32-equal exponent range makes loss scaling unnecessary
+    (module docstring), so this composes with — but does not require —
+    `decorate`."""
+    from paddle_tpu.fluid import framework
+    program = program or framework.default_main_program()
+    n = 0
+    for block in program.desc.blocks:        # sub-blocks too (while/cond)
+        for op in block.ops:
+            if op.type in op_types:
+                op.attrs["__amp_bf16__"] = True
+                n += 1
+            elif op.type == "__vjp__":
+                # backward ops re-trace a SNAPSHOT of the forward op
+                # (grad_ops.py fwd_op dict) — tag it too so rewrites after
+                # minimize() keep the backward in bf16
+                fwd = op.attrs.get("fwd_op", {})
+                if fwd.get("type") in op_types:
+                    fwd.setdefault("attrs", {})["__amp_bf16__"] = True
+                    n += 1
+    program.desc.bump_version()
+    return n

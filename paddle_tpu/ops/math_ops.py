@@ -33,7 +33,13 @@ def _mul(ctx, ins, attrs):
     yn = attrs.get("y_num_col_dims", 1)
     x2 = _flatten2d(x, xn)
     y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
-    out = x2 @ y2
+    amp = attrs.get("__amp_bf16__", False)
+    if amp:
+        x2 = x2.astype(jnp.bfloat16)
+        y2 = y2.astype(jnp.bfloat16)
+        out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32)
+    else:
+        out = x2 @ y2
     out_shape = x.shape[:xn] + y.shape[yn:]
     return single(out.reshape(out_shape))
 
@@ -46,7 +52,13 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if attrs.get("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    if attrs.get("__amp_bf16__"):
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
+        out = jnp.matmul(x, y,
+                         preferred_element_type=jnp.float32)
+    else:
+        out = jnp.matmul(x, y)
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -489,7 +489,12 @@ def _fc(ctx, ins, attrs):
     w = first(ins, "W")
     ncol = attrs.get("in_num_col_dims", 1)
     lead = int(np.prod(x.shape[:ncol]))
-    out = x.reshape(lead, -1) @ w
+    if attrs.get("__amp_bf16__"):
+        out = jnp.matmul(x.reshape(lead, -1).astype(jnp.bfloat16),
+                         w.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+    else:
+        out = x.reshape(lead, -1) @ w
     bias = first(ins, "Bias")
     if bias is not None:
         out = out + bias.reshape(1, -1)

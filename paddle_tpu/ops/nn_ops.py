@@ -30,10 +30,23 @@ def _pair(v, n=2):
     return (v,) * n
 
 
+def _amp_cast(attrs, *arrays):
+    """bf16-compute cast for MXU ops tagged by contrib.mixed_precision.
+    rewrite_program_amp; outputs stay fp32 via preferred_element_type/
+    post-cast (master weights untouched in the Scope)."""
+    if attrs.get("__amp_bf16__"):
+        return [a.astype(jnp.bfloat16)
+                if a is not None and jnp.issubdtype(a.dtype, jnp.floating)
+                else a for a in arrays]
+    return list(arrays)
+
+
 @register_op("conv2d", ref="operators/conv_op.cc:44 Conv2DOp; conv_cudnn_op.cu.cc")
 def _conv2d(ctx, ins, attrs):
     x = first(ins, "Input")          # NCHW
     w = first(ins, "Filter")         # OIHW
+    amp = attrs.get("__amp_bf16__", False)
+    x, w = _amp_cast(attrs, x, w)
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
@@ -46,7 +59,12 @@ def _conv2d(ctx, ins, attrs):
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
-    return {"Output": [out]}
+    # under AMP the conv runs fully in bf16 (XLA accumulates fp32 on the
+    # MXU internally) and the output returns to fp32 (master dtype);
+    # preferred_element_type is avoided because its conv transpose rule
+    # rejects mixed bf16-primal/f32-cotangent. Otherwise the output follows
+    # the input dtype (a bf16-transpiled program stays bf16).
+    return {"Output": [out.astype(jnp.float32) if amp else out]}
 
 
 @register_op("depthwise_conv2d", ref="operators/conv_op.cc (depthwise registered alias)")
@@ -83,18 +101,22 @@ def conv_transpose_nd(x, w, strides, pads, dilations, groups, nd):
 def _conv2d_transpose(ctx, ins, attrs):
     x = first(ins, "Input")
     w = first(ins, "Filter")         # IOHW in fluid's transpose conv
+    amp = attrs.get("__amp_bf16__", False)
+    x, w = _amp_cast(attrs, x, w)
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     out = conv_transpose_nd(x, w, strides, pads, dilations,
                             attrs.get("groups", 1), 2)
-    return {"Output": [out]}
+    return {"Output": [out.astype(jnp.float32) if amp else out]}
 
 
 @register_op("conv3d", ref="operators/conv_op.cc Conv3DOp")
 def _conv3d(ctx, ins, attrs):
     x = first(ins, "Input")          # NCDHW
     w = first(ins, "Filter")         # OIDHW
+    amp = attrs.get("__amp_bf16__", False)
+    x, w = _amp_cast(attrs, x, w)
     strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
     pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
     dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
@@ -106,7 +128,7 @@ def _conv3d(ctx, ins, attrs):
         feature_group_count=attrs.get("groups", 1),
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
     )
-    return {"Output": [out]}
+    return {"Output": [out.astype(jnp.float32) if amp else out]}
 
 
 # ---------------------------------------------------------------------------
