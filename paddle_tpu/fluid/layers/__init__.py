@@ -42,5 +42,6 @@ from paddle_tpu.fluid.layers import detection  # noqa: F401
 from paddle_tpu.fluid.layers.detection import (  # noqa: F401
     anchor_generator, bipartite_match, box_coder, density_prior_box,
     detection_map, detection_output, generate_proposals, iou_similarity,
-    mine_hard_examples, multiclass_nms, polygon_box_transform, prior_box,
+    mine_hard_examples, multi_box_head, multiclass_nms,
+    polygon_box_transform, prior_box,
     rpn_target_assign, ssd_loss, target_assign, yolov3_loss)

@@ -308,3 +308,104 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                                   L.fill_constant([1], "float32", 1.0))
         total = L.elementwise_div(total, denom)
     return total
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference: layers/detection.py:1259 multi_box_head — the SSD head:
+    per feature map, emit prior boxes plus conv loc/conf predictions, then
+    concatenate across maps. Returns (mbox_locs [B, M, 4],
+    mbox_confs [B, M, C], prior_boxes [M, 4], variances [M, 4])."""
+    from paddle_tpu.fluid import layers as L
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule (detection.py multi_box_head): spread
+        # min_ratio..max_ratio evenly over maps 2..N, with a fixed
+        # 10%/20% first-map entry
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes = []
+        max_sizes = []
+        step = (int((max_ratio - min_ratio) / (n_maps - 2))
+                if n_maps > 2 else (max_ratio - min_ratio))
+        for r in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = ([base_size * 0.10] + min_sizes)[:n_maps]
+        max_sizes = ([base_size * 0.20] + max_sizes)[:n_maps]
+        if len(min_sizes) < n_maps:
+            raise ValueError(
+                f"min_ratio..max_ratio schedule yields {len(min_sizes)} "
+                f"sizes for {n_maps} feature maps — pass explicit "
+                f"min_sizes/max_sizes")
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ars = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                             (list, tuple)) \
+            else [aspect_ratios[i]]
+        if steps:
+            st = steps[i]
+        else:
+            # step_w/step_h may be scalars or per-map lists (reference API)
+            sw = step_w[i] if isinstance(step_w, (list, tuple)) \
+                else (step_w or 0.0)
+            sh = step_h[i] if isinstance(step_h, (list, tuple)) \
+                else (step_h or 0.0)
+            st = [sw, sh]
+        if not isinstance(st, (list, tuple)):
+            st = [st, st]
+        box, var = prior_box(
+            inp, image,
+            min_sizes=[mins] if not isinstance(mins, (list, tuple))
+            else list(mins),
+            max_sizes=[maxs] if maxs and not isinstance(maxs, (list, tuple))
+            else (list(maxs) if maxs else None),
+            aspect_ratios=ars, variance=variance, flip=flip, clip=clip,
+            steps=st, offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # priors per cell from the emitted box tensor [H, W, P, 4]
+        p = box.shape[2] if box.shape and len(box.shape) == 4 else None
+        if p is None:
+            from paddle_tpu.ops.detection_ops import _expand_aspect_ratios
+            n_mins = len(mins) if isinstance(mins, (list, tuple)) else 1
+            n_maxs = (len(maxs) if isinstance(maxs, (list, tuple))
+                      else (1 if maxs else 0))
+            p = n_mins * len(_expand_aspect_ratios(ars, flip)) + n_maxs
+        loc = L.conv2d(inp, p * 4, kernel_size, stride=stride, padding=pad,
+                       bias_attr=None)
+        conf = L.conv2d(inp, p * num_classes, kernel_size, stride=stride,
+                        padding=pad, bias_attr=None)
+        # conv output spatial grid must match the prior grid (priors are
+        # emitted per input-map cell) — the reference's SSD heads use
+        # size-preserving convs; reject silent misalignment
+        oh = (int(inp.shape[2]) + 2 * pad - kernel_size) // stride + 1
+        ow = (int(inp.shape[3]) + 2 * pad - kernel_size) // stride + 1
+        if (oh, ow) != (int(inp.shape[2]), int(inp.shape[3])):
+            raise ValueError(
+                f"multi_box_head: loc/conf conv (k={kernel_size}, pad={pad}, "
+                f"stride={stride}) maps {inp.shape[2]}x{inp.shape[3]} -> "
+                f"{oh}x{ow}, misaligned with the per-cell prior grid — use "
+                f"a size-preserving conv (e.g. kernel_size=3, pad=1)")
+        # NCHW -> [B, H*W*P, 4|C]
+        loc = L.reshape(L.transpose(loc, [0, 2, 3, 1]),
+                        [-1, oh * ow * p, 4])
+        conf = L.reshape(L.transpose(conf, [0, 2, 3, 1]),
+                         [-1, oh * ow * p, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(L.reshape(box, [-1, 4]))
+        vars_all.append(L.reshape(var, [-1, 4]))
+
+    mbox_locs = L.concat(locs, axis=1)
+    mbox_confs = L.concat(confs, axis=1)
+    prior_boxes = L.concat(boxes_all, axis=0)
+    box_vars = L.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, prior_boxes, box_vars
+

@@ -310,3 +310,46 @@ def test_ssd_loss_layer_end_to_end():
         losses.append(float(np.asarray(lv).reshape(())))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_multi_box_head_ssd_end_to_end():
+    """multi_box_head (reference: detection.py:1259) over two feature maps
+    feeding ssd_loss — the full SSD training surface."""
+    b, g, c = 2, 2, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    with fluid.program_guard(main, startup):
+        image = fluid.layers.data(name="image", shape=[3, 32, 32],
+                                  dtype="float32")
+        f1 = fluid.layers.conv2d(image, 8, 3, stride=4, padding=1,
+                                 act="relu")            # [B, 8, 8, 8]
+        f2 = fluid.layers.conv2d(f1, 8, 3, stride=2, padding=1,
+                                 act="relu")            # [B, 8, 4, 4]
+        gt_box = fluid.layers.data(name="gt_box", shape=[g, 4],
+                                   dtype="float32")
+        gt_label = fluid.layers.data(name="gt_label", shape=[g, 1],
+                                     dtype="int64")
+        locs, confs, priors, pvars = fluid.layers.multi_box_head(
+            [f1, f2], image, base_size=32, num_classes=c,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True)
+        loss = fluid.layers.ssd_loss(locs, confs, gt_box, gt_label,
+                                     priors, pvars)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": rng.rand(b, 3, 32, 32).astype(np.float32),
+        "gt_box": np.tile(np.array(
+            [[[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8]]], np.float32),
+            (b, 1, 1)),
+        "gt_label": np.tile(np.array([[[1], [2]]], np.int64), (b, 1, 1)),
+    }
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+        losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
