@@ -10,12 +10,10 @@ MXU-bound attention at long sequence length (the whole point of ring
 attention's per-shard compute too — this kernel is the per-shard inner
 loop of paddle_tpu.parallel.ring_attention when shapes align).
 
-Backward: jax.custom_vjp. Residuals are only (q, k, v, o, lse) — O(T*D) —
-but the bwd body itself recomputes the FULL [B, H, Tq, Tk] score matrix in
-plain jnp, so *training* peak memory is O(T^2) exactly like the refer
-path; only the forward (inference / activation-recompute) path gets the
-O(T*D) flash memory profile. A blockwise Pallas bwd kernel is the known
-follow-up."""
+Backward: jax.custom_vjp over blockwise Pallas kernels. Residuals are
+(q, k, v, o, lse) — O(T*D) — and the bwd recomputes scores tile-by-tile in
+two kernels (dQ over k-blocks; dK/dV over q-blocks, the flash-attention-2
+schedule), so training peak memory is O(T*D) end to end."""
 
 from __future__ import annotations
 
@@ -26,6 +24,27 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG = -1e30
+
+
+def _block_visible(causal, kb, bk, q_last):
+    """A (q block, k block) tile contributes iff any key pos < q_last."""
+    if not causal:
+        return True
+    return (kb * bk) < q_last
+
+
+def _masked_scores(q, k, causal, qb, j, bq, bk, q_off):
+    """Scaled q·kᵀ with the causal iota mask — the single source of the
+    mask convention shared by the forward and both backward kernels
+    (forward/backward desync here would corrupt gradients silently)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        qpos = (q_off + qb * bq +
+                jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    return s
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -45,22 +64,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # causal: key blocks wholly above the diagonal contribute nothing
-    visible = True
-    if causal:
-        visible = (j * bk) < (q_off + (qb + 1) * bq)
+    visible = _block_visible(causal, j, bk, q_off + (qb + 1) * bq)
 
     @pl.when(visible)
     def _():
         q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
         k = k_ref[0].astype(jnp.float32)                  # [BK, D]
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            qpos = (q_off + qb * bq +
-                    jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
+        s = _masked_scores(q, k, causal, qb, j, bq, bk, q_off)
         m = m_scr[:]
         l = l_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -140,29 +151,140 @@ def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, bq, bk, nk, causal, scale, q_off):
+    """Grid (BH, Tq/bq, Tk/bk): accumulate dQ for one q block across k
+    blocks; ds = p * (dO·Vᵀ − delta), dQ = scale · ds·K."""
+    qb = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visible = _block_visible(causal, j, bk, q_off + (qb + 1) * bq)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = _masked_scores(q, k, causal, qb, j, bq, bk, q_off)
+        p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, bq, bk, nq, causal,
+                scale, q_off):
+    """Grid (BH, Tk/bk, Tq/bq): accumulate dK/dV for one k block across q
+    blocks; dV = pᵀ·dO, dK = scale · dsᵀ·Q."""
+    kb = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # q block i sees this k block iff its LAST query reaches it
+    visible = _block_visible(causal, kb, bk, q_off + (i + 1) * bq)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = _masked_scores(q, k, causal, i, kb, bq, bk, q_off)
+        p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # pᵀ·dO [BK, D]
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # dsᵀ·(scale·Q)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
+    from jax.experimental.pallas import tpu as pltpu
     q, k, v, o, lse = res
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf * scale, kf)
-    if causal:
-        tq, tk = s.shape[-2], s.shape[-1]
-        qp = jnp.arange(tq) + (tk - tq)
-        s = jnp.where((qp[:, None] >= jnp.arange(tk)[None, :])[None, None],
-                      s, _NEG)
-    p = jnp.exp(s - lse[..., None])                   # softmax via saved lse
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [B,H,Tq,1]
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nq, nk = tq // bq, tk // bk
+    q4 = q.reshape(b * h, tq, d)
+    k4 = k.reshape(b * h, tk, d)
+    v4 = v.reshape(b * h, tk, d)
+    g4 = g.reshape(b * h, tq, d)
+    lse4 = lse.reshape(b * h, tq, 1)
+    delta4 = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
+                     axis=-1).reshape(b * h, tq, 1)
+    q_off = tk - tq
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, nk=nk, causal=causal,
+                          scale=scale, q_off=q_off),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q4, k4, v4, g4, lse4, delta4)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=nq, causal=causal,
+                          scale=scale, q_off=q_off),
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q4, k4, v4, g4, lse4, delta4)
+
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
