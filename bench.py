@@ -56,6 +56,14 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "transformer": (models.transformer.build,
                         {"max_len": 64, "src_vocab": 32000,
                          "tgt_vocab": 32000}, "tokens/sec", None),
+        # long-context config: d_head 128 routes attention through the
+        # Pallas flash kernels (fwd + blockwise bwd)
+        "transformer_long": (models.transformer.build,
+                             {"max_len": 2048, "src_vocab": 8000,
+                              "tgt_vocab": 8000, "d_model": 1024,
+                              "d_inner": 2048, "n_head": 8, "n_layer": 2,
+                              "fused_attention": True},
+                             "tokens/sec", None),
         "stacked_dynamic_lstm": (models.stacked_dynamic_lstm.build,
                                  {"max_len": 100}, "words/sec", None),
     }
@@ -123,7 +131,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="alexnet",
-                    choices=["alexnet", "resnet50", "transformer", "mnist",
+                    choices=["alexnet", "resnet50", "transformer",
+                             "transformer_long", "mnist",
                              "stacked_dynamic_lstm"])
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20)
@@ -132,7 +141,8 @@ def main():
     ap.add_argument("--no-amp", dest="amp", action="store_false")
     args = ap.parse_args()
     bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
-                             "transformer": 32, "mnist": 512,
+                             "transformer": 32, "transformer_long": 2,
+                             "mnist": 512,
                              "stacked_dynamic_lstm": 64}[args.model]
     result = run_bench(args.model, bs, args.steps, amp=args.amp)
     print(json.dumps(result))

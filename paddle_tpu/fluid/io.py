@@ -34,29 +34,35 @@ def _persistable_names(program) -> List[str]:
     return sorted(set(names))
 
 
+def _write_snapshot_dir(dirname: str, snapshot) -> List[str]:
+    """Serialize {name: ndarray} to dirname with the manifest — the single
+    definition of the on-disk layout shared by save_vars and the async
+    checkpointer (load_vars reads this layout back)."""
+    os.makedirs(dirname, exist_ok=True)
+    for name, arr in snapshot.items():
+        np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
+                arr)
+    with open(os.path.join(dirname, _MANIFEST), "w") as f:
+        json.dump({"vars": sorted(snapshot)}, f)
+    return sorted(snapshot)
+
+
 def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = None,
               predicate=None, filename=None, scope=None):
     """reference: io.py:222 (scope: the fluid.scope_guard capability)."""
     main_program = main_program or framework.default_main_program()
     scope = scope or global_scope()
-    os.makedirs(dirname, exist_ok=True)
     if vars is None:
         vars = _persistable_names(main_program)
         if predicate is not None:
             vars = [v for v in vars
                     if predicate(main_program.global_block().var(v))]
-    saved = []
+    snapshot = {}
     for name in vars:
         val = scope.find_var(name)
-        if val is None:
-            continue
-        arr = np.asarray(val)
-        np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
-                arr)
-        saved.append(name)
-    with open(os.path.join(dirname, _MANIFEST), "w") as f:
-        json.dump({"vars": saved}, f)
-    return saved
+        if val is not None:
+            snapshot[name] = np.asarray(val)
+    return _write_snapshot_dir(dirname, snapshot)
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None,
@@ -184,3 +190,88 @@ def _all_steps(checkpoint_dir):
 def _latest_step(checkpoint_dir):
     steps = _all_steps(checkpoint_dir)
     return max(steps) if steps else -1
+
+
+class AsyncCheckpointer:
+    """Async checkpoint writer (SURVEY §5 checkpoint/resume: "orbax-style
+    sharded async save ... replaces (1)(3)"). `save()` snapshots device
+    arrays to host (the only step that must pause training — one D2H per
+    var) and hands serialization to a background thread; `wait()` joins.
+    Keeps at most `max_to_keep` serials like the reference's checkpoint
+    dir rotation (io.py save_checkpoint serial handling)."""
+
+    def __init__(self, root_dir: str, max_to_keep: int = 3):
+        import threading
+        self.root = root_dir
+        self.max_to_keep = max_to_keep
+        self._thread = None
+        self._error = None
+        self._threading = threading
+        os.makedirs(root_dir, exist_ok=True)
+
+    def _serial_dir(self, serial: int) -> str:
+        return os.path.join(self.root, f"checkpoint_{serial}")
+
+    def save(self, serial: int, main_program=None, scope=None,
+             vars: Optional[List[str]] = None):
+        """Snapshot now, write in background. Returns immediately after
+        the device→host copies."""
+        self.wait()                       # one in-flight save at a time
+        main_program = main_program or framework.default_main_program()
+        scope = scope or global_scope()
+        names = vars or _persistable_names(main_program)
+        snap = {}
+        for name in names:
+            v = scope.find_var(name)
+            if v is not None:
+                snap[name] = np.asarray(v)      # D2H copy happens here
+
+        def _write(snapshot=snap, serial=serial):
+            d = self._serial_dir(serial)
+            os.makedirs(d, exist_ok=True)
+            for name, arr in snapshot.items():
+                np.save(os.path.join(d, name.replace("/", "__") + ".npy"),
+                        arr)
+            with open(os.path.join(d, _MANIFEST), "w") as f:
+                json.dump({"vars": sorted(snapshot)}, f)
+            # mark complete LAST so partially-written dirs are never latest
+            with open(os.path.join(d, "_COMPLETE"), "w") as f:
+                f.write(str(serial))
+            self._gc()
+
+        self._thread = self._threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _gc(self):
+        serials = self.serials()
+        for s in serials[:-self.max_to_keep]:
+            import shutil
+            shutil.rmtree(self._serial_dir(s), ignore_errors=True)
+
+    def serials(self) -> List[int]:
+        out = []
+        if not os.path.isdir(self.root):
+            return out
+        for n in os.listdir(self.root):
+            d = os.path.join(self.root, n)
+            if n.startswith("checkpoint_") and \
+                    os.path.exists(os.path.join(d, "_COMPLETE")):
+                out.append(int(n.split("_")[-1]))
+        return sorted(out)
+
+    def restore(self, executor=None, serial: Optional[int] = None,
+                main_program=None, scope=None) -> int:
+        """Load the given (or latest complete) serial into the scope."""
+        self.wait()
+        serials = self.serials()
+        if not serials:
+            raise FileNotFoundError(f"no complete checkpoints in {self.root}")
+        serial = serial if serial is not None else serials[-1]
+        load_vars(executor, self._serial_dir(serial), main_program,
+                  scope=scope)
+        return serial
