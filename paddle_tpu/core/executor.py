@@ -114,9 +114,15 @@ class Executor:
 
         feeds = {}
         dist_mode = cb.dist is not None and cb.dist.mesh is not None
+        multi_host = dist_mode and jax.process_count() > 1
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
+            if isinstance(val, jax.Array) and multi_host:
+                # a host-local committed array can't be resharded onto a
+                # mesh spanning other hosts — round-trip through the host
+                # copy and take the global-array path below
+                val = np.asarray(val)
             if isinstance(val, jax.Array):
                 # already on device (e.g. a prefetched pipeline batch or a
                 # benchmark-resident tensor) — keep it device-side, but
@@ -134,8 +140,19 @@ class Executor:
             if want is not None and str(arr.dtype) != want:
                 arr = arr.astype(want)
             if dist_mode:
-                # jit's in_shardings places/shards the host array itself
-                feeds[name] = arr
+                if multi_host:
+                    # multi-host: jit refuses numpy with non-trivial
+                    # shardings — build the global jax.Array here. Every
+                    # process feeds the same global batch (the reference's
+                    # nccl2-mode convention: same program, rank-split
+                    # happens inside), so the callback slices the local
+                    # shard out of the host copy.
+                    sh = cb.feed_sharding(name)
+                    feeds[name] = jax.make_array_from_callback(
+                        arr.shape, sh, lambda idx, a=arr: a[idx])
+                else:
+                    # jit's in_shardings places/shards the host array itself
+                    feeds[name] = arr
             else:
                 feeds[name] = jax.device_put(arr, self.device)
 

@@ -1,0 +1,71 @@
+"""Worker script for the localhost multi-process distributed test — the
+reference's test_dist_base.py trick (§4: fork real localhost processes,
+each running the same model file with roles from env, pickle results over
+stdout). Each process owns 2 virtual CPU devices; jax.distributed unifies
+them into one 4-device global mesh and the dp training step all-reduces
+gradients across PROCESSES (DCN capability), not just local devices."""
+
+import json
+import os
+import sys
+
+# launched as `python tests/dist_worker.py` — sys.path[0] is tests/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2").strip()
+
+import jax                                     # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                             # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    from paddle_tpu import distributed
+    distributed.init_parallel_env(
+        coordinator_address=os.environ["PADDLE_COORDINATOR"],
+        num_processes=nprocs, process_id=rank)
+
+    assert jax.process_count() == nprocs
+    n_global = len(jax.devices())
+    assert n_global == 2 * nprocs, n_global
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel import DistributeConfig, make_mesh
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    mesh = make_mesh({"dp": n_global})
+    compiled = fluid.CompiledProgram(main_p).with_sharding(
+        DistributeConfig(mesh=mesh, data_axis="dp"))
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    # every process feeds the SAME global batch (jit with in_shardings
+    # splits it over the dp axis; each process computes its shard)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32) * 0.25
+    losses = []
+    for _ in range(12):
+        (lv,) = exe.run(compiled, feed={"x": xs, "y": ys},
+                        fetch_list=[loss.name])
+        losses.append(float(np.asarray(lv).reshape(())))
+    print("RESULT " + json.dumps({"rank": rank, "losses": losses}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

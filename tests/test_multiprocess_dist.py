@@ -1,0 +1,55 @@
+"""Localhost multi-process distributed training test — capability parity
+with the reference's test_dist_base.py (§4: "forks real localhost
+processes ... results pickled over stdout and compared"). Two OS processes
+× 2 virtual CPU devices join one jax.distributed coordination service (the
+gen_nccl_id replacement) and run a dp=4 training step whose gradient
+all-reduce crosses the process boundary."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_dp_training_matches():
+    nprocs = 2
+    port = _free_port()
+    workers = []
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith(("PADDLE_", "XLA_FLAGS", "JAX_"))}
+    for rank in range(nprocs):
+        env = dict(env_base)
+        env["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        workers.append(subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "dist_worker.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(__file__)), env=env,
+            text=True))
+    results = {}
+    for rank, w in enumerate(workers):
+        out, err = w.communicate(timeout=240)
+        assert w.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
+        results[rank] = json.loads(line[len("RESULT "):])
+
+    l0 = results[0]["losses"]
+    l1 = results[1]["losses"]
+    # both processes compute the same global loss (the all-reduce crossed
+    # the process boundary) and it decreases
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    assert l0[-1] < l0[0] * 0.7, l0
