@@ -119,8 +119,20 @@ class Executor:
             val = feed[name]
             want = cb.feed_dtype(name)
             if isinstance(val, jax.Array) and multi_host:
-                # a host-local committed array can't be resharded onto a
-                # mesh spanning other hosts — round-trip through the host
+                want_sh = cb.feed_sharding(name)
+                if val.sharding == want_sh:
+                    # already a correctly-sharded global array (prefetched
+                    # pipeline batch) — pass straight through
+                    feeds[name] = val
+                    continue
+                if not val.is_fully_addressable:
+                    raise ValueError(
+                        f"feed {name!r} is a global jax.Array with a "
+                        f"different sharding than the program expects "
+                        f"({val.sharding} vs {want_sh}); reshard it on the "
+                        f"producer side — cross-host resharding inside "
+                        f"exe.run is not supported")
+                # host-local committed array: round-trip through the host
                 # copy and take the global-array path below
                 val = np.asarray(val)
             if isinstance(val, jax.Array):

@@ -12,7 +12,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 
 def _free_port():
@@ -41,11 +40,18 @@ def test_two_process_dp_training_matches():
             cwd=os.path.dirname(os.path.dirname(__file__)), env=env,
             text=True))
     results = {}
-    for rank, w in enumerate(workers):
-        out, err = w.communicate(timeout=240)
-        assert w.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
-        line = [l for l in out.splitlines() if l.startswith("RESULT ")][-1]
-        results[rank] = json.loads(line[len("RESULT "):])
+    try:
+        for rank, w in enumerate(workers):
+            out, err = w.communicate(timeout=240)
+            assert w.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
+            line = [l for l in out.splitlines()
+                    if l.startswith("RESULT ")][-1]
+            results[rank] = json.loads(line[len("RESULT "):])
+    finally:
+        # never leave a worker blocked in the coordination barrier
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
 
     l0 = results[0]["losses"]
     l1 = results[1]["losses"]
