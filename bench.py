@@ -141,7 +141,7 @@ def main():
     ap.add_argument("--no-amp", dest="amp", action="store_false")
     args = ap.parse_args()
     bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
-                             "transformer": 32, "transformer_long": 2,
+                             "transformer": 128, "transformer_long": 2,
                              "mnist": 512,
                              "stacked_dynamic_lstm": 64}[args.model]
     result = run_bench(args.model, bs, args.steps, amp=args.amp)

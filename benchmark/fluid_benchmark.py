@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Benchmark CLI with the reference harness's shape (reference:
+benchmark/fluid/fluid_benchmark.py — args in args.py:25-117:
+--model {mnist,resnet,vgg,stacked_dynamic_lstm,machine_translation},
+--update_method {local,pserver,nccl2}, --gpus, --batch_size, --iterations;
+reports images/sec or words/sec averaged over steps, train_parallel :139).
+
+TPU mapping: --gpus ⇒ --chips (data-parallel mesh over local chips);
+--update_method local = single chip, nccl2 = dp mesh + XLA collectives
+(pserver maps to the same dense path — SURVEY §2 parallelism table).
+
+Run from the repo root:
+    python benchmark/fluid_benchmark.py --model resnet --chips 1
+Prints the same one-line JSON contract as bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# reference model names → bench.py configs
+_MODEL_MAP = {
+    "mnist": "mnist",
+    "resnet": "resnet50",
+    "vgg": "alexnet",                   # closest conv config in bench.py
+    "alexnet": "alexnet",
+    "stacked_dynamic_lstm": "stacked_dynamic_lstm",
+    "machine_translation": "transformer",
+    "transformer": "transformer",
+    "transformer_long": "transformer_long",
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet", choices=sorted(_MODEL_MAP))
+    ap.add_argument("--update_method", default="local",
+                    choices=["local", "pserver", "nccl2"])
+    ap.add_argument("--chips", "--gpus", type=int, default=1, dest="chips")
+    ap.add_argument("--batch_size", type=int, default=None)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--no-amp", dest="amp", action="store_false",
+                    default=True)
+    args = ap.parse_args()
+
+    import jax
+    n = len(jax.devices())
+    if args.chips > n:
+        raise SystemExit(f"--chips {args.chips} > visible devices {n}")
+    if args.update_method != "local" and args.chips > 1:
+        # dp mesh over the requested chips; XLA emits the collectives the
+        # reference got from NCCL (nccl2) / the pserver loop
+        from paddle_tpu.parallel import make_mesh, set_default_mesh
+        set_default_mesh(make_mesh({"dp": args.chips},
+                                   devices=jax.devices()[:args.chips]))
+
+    from bench import run_bench
+    model = _MODEL_MAP[args.model]
+    bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
+                             "transformer": 128, "transformer_long": 2,
+                             "mnist": 512,
+                             "stacked_dynamic_lstm": 64}[model]
+    result = run_bench(model, bs, args.iterations, amp=args.amp)
+    result["update_method"] = args.update_method
+    result["chips"] = args.chips
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

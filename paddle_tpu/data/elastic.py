@@ -15,6 +15,7 @@ semantics)."""
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, List, Optional
 
 from paddle_tpu.data.master import Master
@@ -55,12 +56,14 @@ class ElasticTrainer:
                                      scope=scope)
         return None
 
-    def run(self, train_chunk: Callable, executor=None, main_program=None,
-            scope=None):
+    def run(self, train_chunk: Callable, main_program=None, scope=None):
         """train_chunk(task) -> None; called once per leased task. The
-        master snapshot + model checkpoint are written after every
+        model checkpoint + master snapshot are written after every
         `checkpoint_every` finished tasks, checkpoint serialization off the
         training thread."""
+        stats = self.master.stats()
+        if stats["todo"] + stats["pending"] + stats["done"] == 0:
+            return        # nothing to train (empty task list) — not done-able
         done_since_ckpt = 0
         while not self.master.done:
             task = self.master.get_task()
@@ -70,7 +73,6 @@ class ElasticTrainer:
                 # if also nothing pending
                 if self.master.done:
                     break
-                import time
                 time.sleep(0.05)
                 continue
             try:
@@ -84,9 +86,11 @@ class ElasticTrainer:
                 self._serial += 1
                 self.ckpt.save(self._serial, main_program=main_program,
                                scope=scope)
-                # snapshot the queue AFTER the model snapshot is taken so a
-                # crash between them re-trains at most checkpoint_every
-                # chunks (never skips one)
+                # the queue snapshot must only become durable AFTER the
+                # model checkpoint it corresponds to: wait for the
+                # background write (and its _COMPLETE marker) first, else a
+                # crash in between loses finished chunks' weight updates
+                self.ckpt.wait()
                 self.master.snapshot(self._snap_path)
                 done_since_ckpt = 0
         self.ckpt.wait()

@@ -53,7 +53,7 @@ def test_elastic_crash_and_resume(tmp_path):
                         checkpoint_every=1)
     with pytest.raises(RuntimeError, match="simulated"):
         t1.run(make_runner(exe, main, loss, trained_first, crash_after=3),
-               exe, main_program=main)
+               main_program=main)
     t1.ckpt.wait()
     assert len(trained_first) == 3
     w_name = [n for n, v in main.desc.global_block.vars.items()
@@ -76,7 +76,7 @@ def test_elastic_crash_and_resume(tmp_path):
         np.asarray(global_scope().find_var(w_name)), w_after_crash)
 
     trained_second = []
-    t2.run(make_runner(exe2, main2, loss2, trained_second), exe2,
+    t2.run(make_runner(exe2, main2, loss2, trained_second),
            main_program=main2)
     assert t2.master.done
     # no finished chunk re-trained; every chunk trained exactly once
