@@ -24,6 +24,16 @@ import numpy as np
 
 
 ALEXNET_K40M_IMG_S = 425.0      # benchmark/README.md:33-38, bs256
+VGG19_XEON_IMG_S = 28.46        # IntelOptimizedPaddle.md:29-36, bs64
+                                # (our model is VGG16 — ~18% fewer FLOPs;
+                                # treat vs_baseline as indicative only)
+
+DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 64,
+                       "transformer": 128, "transformer_long": 2,
+                       "mnist": 512, "stacked_dynamic_lstm": 64,
+                       "vgg": 64, "se_resnext": 32,
+                       "machine_translation": 64,
+                       "deepfm": 512}
 RESNET50_XEON_IMG_S = 81.69     # IntelOptimizedPaddle.md:39-46, bs64
 
 
@@ -43,7 +53,7 @@ def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None):
 
 
 def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
-              amp: bool = False):
+              amp: bool = False, mesh=None):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -66,6 +76,12 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
                              "tokens/sec", None),
         "stacked_dynamic_lstm": (models.stacked_dynamic_lstm.build,
                                  {"max_len": 100}, "words/sec", None),
+        "vgg": (models.vgg.build, {}, "images/sec", VGG19_XEON_IMG_S),
+        "se_resnext": (models.se_resnext.build, {}, "images/sec", None),
+        "machine_translation": (models.machine_translation.build,
+                                {"src_vocab": 10000, "tgt_vocab": 10000,
+                                 "max_len": 32}, "words/sec", None),
+        "deepfm": (models.deepfm.build, {}, "examples/sec", None),
     }
     # valid ranges for integer feeds (labels in-class, seq_lens >= 1)
     int_ranges = {
@@ -82,6 +98,16 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
             from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
             rewrite_program_amp(main)
 
+    run_target = main
+    n_chips = 1
+    if mesh is not None:
+        # dp mesh over the requested chips — XLA emits the collectives the
+        # reference's nccl2/pserver update methods provided
+        from paddle_tpu.parallel import DistributeConfig
+        run_target = fluid.CompiledProgram(main).with_sharding(
+            DistributeConfig(mesh=mesh, data_axis="dp"))
+        n_chips = mesh.size
+
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     feeds = _device_batch(exe, feed_specs, batch_size, int_ranges=int_ranges)
@@ -91,10 +117,10 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
     # is a scalar D2H fetch of the loss (~0.1s, subtracted via fence_cost).
     def fence():
         return float(np.asarray(
-            exe.run(main, feed=feeds, fetch_list=[loss])[0]).reshape(()))
+            exe.run(run_target, feed=feeds, fetch_list=[loss])[0]).reshape(()))
 
     for _ in range(warmup):
-        exe.run(main, feed=feeds, fetch_list=[])
+        exe.run(run_target, feed=feeds, fetch_list=[])
     fence()
     t0 = time.time()
     fence_cost = 0.105  # measured tunnel D2H scalar latency
@@ -103,7 +129,7 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
 
     t0 = time.time()
     for _ in range(steps - 1):
-        exe.run(main, feed=feeds, fetch_list=[])
+        exe.run(run_target, feed=feeds, fetch_list=[])
     lv = fence()  # counts as the final step + fence
     dt = max(time.time() - t0 - fence_cost, 1e-6)
 
@@ -121,7 +147,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
 
     return {
         "metric": f"{model_name} train throughput (bs{batch_size}"
-                  f"{', amp-bf16' if amp else ''}, 1 chip)",
+                  f"{', amp-bf16' if amp else ''}, {n_chips} chip"
+                  f"{'s' if n_chips > 1 else ''})",
         "value": round(float(value), 2),
         "unit": unit,
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
@@ -133,17 +160,15 @@ def main():
     ap.add_argument("--model", default="alexnet",
                     choices=["alexnet", "resnet50", "transformer",
                              "transformer_long", "mnist",
-                             "stacked_dynamic_lstm"])
+                             "stacked_dynamic_lstm", "vgg", "se_resnext",
+                             "machine_translation", "deepfm"])
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--amp", dest="amp", action="store_true", default=True,
                     help="bf16 MXU compute (fp32 master weights) — default")
     ap.add_argument("--no-amp", dest="amp", action="store_false")
     args = ap.parse_args()
-    bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
-                             "transformer": 128, "transformer_long": 2,
-                             "mnist": 512,
-                             "stacked_dynamic_lstm": 64}[args.model]
+    bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
     result = run_bench(args.model, bs, args.steps, amp=args.amp)
     print(json.dumps(result))
 

@@ -27,10 +27,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _MODEL_MAP = {
     "mnist": "mnist",
     "resnet": "resnet50",
-    "vgg": "alexnet",                   # closest conv config in bench.py
+    "se_resnext": "se_resnext",
+    "deepfm": "deepfm",
+    "vgg": "vgg",
     "alexnet": "alexnet",
     "stacked_dynamic_lstm": "stacked_dynamic_lstm",
-    "machine_translation": "transformer",
+    "machine_translation": "machine_translation",
     "transformer": "transformer",
     "transformer_long": "transformer_long",
 }
@@ -52,20 +54,18 @@ def main():
     n = len(jax.devices())
     if args.chips > n:
         raise SystemExit(f"--chips {args.chips} > visible devices {n}")
+    mesh = None
     if args.update_method != "local" and args.chips > 1:
         # dp mesh over the requested chips; XLA emits the collectives the
         # reference got from NCCL (nccl2) / the pserver loop
-        from paddle_tpu.parallel import make_mesh, set_default_mesh
-        set_default_mesh(make_mesh({"dp": args.chips},
-                                   devices=jax.devices()[:args.chips]))
+        from paddle_tpu.parallel import make_mesh
+        mesh = make_mesh({"dp": args.chips},
+                         devices=jax.devices()[:args.chips])
 
-    from bench import run_bench
+    from bench import DEFAULT_BATCH_SIZES, run_bench
     model = _MODEL_MAP[args.model]
-    bs = args.batch_size or {"alexnet": 256, "resnet50": 64,
-                             "transformer": 128, "transformer_long": 2,
-                             "mnist": 512,
-                             "stacked_dynamic_lstm": 64}[model]
-    result = run_bench(model, bs, args.iterations, amp=args.amp)
+    bs = args.batch_size or DEFAULT_BATCH_SIZES[model]
+    result = run_bench(model, bs, args.iterations, amp=args.amp, mesh=mesh)
     result["update_method"] = args.update_method
     result["chips"] = args.chips
     print(json.dumps(result))

@@ -120,11 +120,13 @@ class Executor:
             want = cb.feed_dtype(name)
             if isinstance(val, jax.Array) and multi_host:
                 want_sh = cb.feed_sharding(name)
-                if (val.sharding == want_sh
-                        and (want is None or str(val.dtype) == want)):
-                    # already a correctly-sharded global array of the
-                    # declared dtype (prefetched pipeline batch) — pass
-                    # straight through
+                if want is not None and str(val.dtype) != want:
+                    # dtype-only mismatch: astype is sharding-preserving,
+                    # so fix it device-side even for global arrays
+                    val = val.astype(want)
+                if val.sharding == want_sh:
+                    # correctly-sharded global array (prefetched pipeline
+                    # batch) — pass straight through
                     feeds[name] = val
                     continue
                 if not val.is_fully_addressable:

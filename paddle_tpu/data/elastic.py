@@ -84,14 +84,22 @@ class ElasticTrainer:
             done_since_ckpt += 1
             if done_since_ckpt >= self.checkpoint_every:
                 self._serial += 1
-                self.ckpt.save(self._serial, main_program=main_program,
-                               scope=scope)
                 # the queue snapshot must only become durable AFTER the
-                # model checkpoint it corresponds to: wait for the
-                # background write (and its _COMPLETE marker) first, else a
-                # crash in between loses finished chunks' weight updates
-                self.ckpt.wait()
-                self.master.snapshot(self._snap_path)
+                # model checkpoint it corresponds to (else a crash between
+                # them marks chunks done whose weight updates were lost).
+                # Capture the queue state NOW to a temp file; the rename to
+                # the live path runs on the checkpointer's thread after the
+                # _COMPLETE marker — strict ordering with no training stall.
+                # per-serial temp file: the previous save's background
+                # thread may still be about to promote its own snapshot
+                tmp = f"{self._snap_path}.tmp{self._serial}"
+                self.master.snapshot(tmp)
+
+                def _promote(tmp=tmp):
+                    os.replace(tmp, self._snap_path)
+
+                self.ckpt.save(self._serial, main_program=main_program,
+                               scope=scope, on_complete=_promote)
                 done_since_ckpt = 0
         self.ckpt.wait()
         self.master.snapshot(self._snap_path)

@@ -213,31 +213,36 @@ class AsyncCheckpointer:
         return os.path.join(self.root, f"checkpoint_{serial}")
 
     def save(self, serial: int, main_program=None, scope=None,
-             vars: Optional[List[str]] = None):
+             vars: Optional[List[str]] = None, on_complete=None):
         """Snapshot now, write in background. Returns immediately after
-        the device→host copies."""
+        the device→host copies. `on_complete` (if given) runs on the
+        background thread after the _COMPLETE marker is durable — the hook
+        for ordering dependent state (e.g. the elastic trainer's queue
+        snapshot) behind the checkpoint without blocking training. A prior
+        save's failure is raised here or in wait() — never swallowed."""
         self.wait()                       # one in-flight save at a time
         main_program = main_program or framework.default_main_program()
         scope = scope or global_scope()
-        names = vars or _persistable_names(main_program)
+        names = vars if vars is not None else _persistable_names(main_program)
         snap = {}
         for name in names:
             v = scope.find_var(name)
             if v is not None:
                 snap[name] = np.asarray(v)      # D2H copy happens here
 
-        def _write(snapshot=snap, serial=serial):
-            d = self._serial_dir(serial)
-            os.makedirs(d, exist_ok=True)
-            for name, arr in snapshot.items():
-                np.save(os.path.join(d, name.replace("/", "__") + ".npy"),
-                        arr)
-            with open(os.path.join(d, _MANIFEST), "w") as f:
-                json.dump({"vars": sorted(snapshot)}, f)
-            # mark complete LAST so partially-written dirs are never latest
-            with open(os.path.join(d, "_COMPLETE"), "w") as f:
-                f.write(str(serial))
-            self._gc()
+        def _write(snapshot=snap, serial=serial,
+                   on_complete=on_complete):
+            try:
+                d = self._serial_dir(serial)
+                _write_snapshot_dir(d, snapshot)
+                # mark complete LAST so partial dirs are never latest
+                with open(os.path.join(d, "_COMPLETE"), "w") as f:
+                    f.write(str(serial))
+                if on_complete is not None:
+                    on_complete()
+                self._gc()
+            except BaseException as e:   # surfaced by wait()/next save()
+                self._error = e
 
         self._thread = self._threading.Thread(target=_write, daemon=True)
         self._thread.start()
@@ -246,6 +251,9 @@ class AsyncCheckpointer:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
 
     def _gc(self):
         serials = self.serials()
