@@ -28,7 +28,7 @@ VGG19_XEON_IMG_S = 28.46        # IntelOptimizedPaddle.md:29-36, bs64
                                 # (our model is VGG16 — ~18% fewer FLOPs;
                                 # treat vs_baseline as indicative only)
 
-DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 64,
+DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 128,
                        "transformer": 128, "transformer_long": 2,
                        "mnist": 512, "stacked_dynamic_lstm": 64,
                        "vgg": 64, "se_resnext": 32,

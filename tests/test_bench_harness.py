@@ -1,0 +1,27 @@
+"""Benchmark harness tests: run_bench with a dp mesh on the virtual CPU
+devices (the fluid_benchmark --update_method nccl2 path) and the JSON
+contract (reference: benchmark/fluid/fluid_benchmark.py train_parallel)."""
+
+import sys
+
+import numpy as np
+
+
+def test_run_bench_local_json_contract():
+    sys.path.insert(0, ".")
+    from bench import run_bench
+    res = run_bench("mnist", batch_size=64, steps=3, warmup=1)
+    assert set(res) >= {"metric", "value", "unit", "vs_baseline"}
+    assert res["unit"] == "images/sec" and res["value"] > 0
+    assert "1 chip" in res["metric"]
+
+
+def test_run_bench_dp_mesh():
+    sys.path.insert(0, ".")
+    import jax
+    from bench import run_bench
+    from paddle_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    res = run_bench("mnist", batch_size=64, steps=3, warmup=1, mesh=mesh)
+    assert res["value"] > 0 and np.isfinite(res["value"])
+    assert "2 chips" in res["metric"]
