@@ -2,13 +2,15 @@
 devices (the fluid_benchmark --update_method nccl2 path) and the JSON
 contract (reference: benchmark/fluid/fluid_benchmark.py train_parallel)."""
 
+import os
 import sys
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def test_run_bench_local_json_contract():
-    sys.path.insert(0, ".")
     from bench import run_bench
     res = run_bench("mnist", batch_size=64, steps=3, warmup=1)
     assert set(res) >= {"metric", "value", "unit", "vs_baseline"}
@@ -17,7 +19,6 @@ def test_run_bench_local_json_contract():
 
 
 def test_run_bench_dp_mesh():
-    sys.path.insert(0, ".")
     import jax
     from bench import run_bench
     from paddle_tpu.parallel import make_mesh

@@ -10,7 +10,7 @@ test_ssd_loss.py / test_detection.py)."""
 import numpy as np
 import pytest
 
-from op_test import run_single_op
+from op_test import check_grad, run_single_op
 
 import paddle_tpu.fluid as fluid
 
@@ -353,3 +353,32 @@ def test_multi_box_head_ssd_end_to_end():
         losses.append(float(np.asarray(lv).reshape(())))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_grad_yolov3_loss():
+    b, a, c, h, w = 1, 2, 3, 4, 4
+    rng = np.random.RandomState(3)
+    x = (rng.rand(b, a * (5 + c), h, w).astype(np.float32) - 0.5)
+    gt_box = np.array([[[0.5, 0.5, 0.25, 0.25], [0.2, 0.8, 0.1, 0.1]]],
+                      np.float32)
+    gt_label = np.array([[1, 2]], np.int32)
+    check_grad("yolov3_loss",
+               {"X": {"x": x}, "GTBox": {"g": gt_box},
+                "GTLabel": {"l": gt_label}},
+               attrs={"anchors": [32.0, 32.0, 64.0, 64.0], "class_num": c,
+                      "downsample_ratio": 32, "ignore_thresh": 0.7},
+               out_slot="Loss", grad_vars=["x"], rtol=2e-2, atol=1e-3)
+
+
+def test_grad_box_coder_decode():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]],
+                     np.float32)
+    pvar = np.full((2, 4), 0.1, np.float32)
+    deltas = (np.random.RandomState(0).rand(3, 2, 4).astype(np.float32)
+              - 0.5)
+    check_grad("box_coder",
+               {"PriorBox": {"p": prior}, "PriorBoxVar": {"v": pvar},
+                "TargetBox": {"t": deltas}},
+               attrs={"code_type": "decode_center_size"},
+               out_slot="OutputBox", grad_vars=["t"], delta=1e-2,
+               rtol=5e-2, atol=2e-3)
