@@ -1,0 +1,44 @@
+"""Data-parallel training over every local chip (reference: the
+ParallelExecutor/CompiledProgram.with_data_parallel book usage)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import paddle_tpu.fluid as fluid
+from paddle_tpu.parallel import DistributeConfig, make_mesh
+
+
+def main():
+    n = len(jax.devices())
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, 200, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, 10), label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    mesh = make_mesh({"dp": n})
+    compiled = fluid.CompiledProgram(main_p).with_sharding(
+        DistributeConfig(mesh=mesh, data_axis="dp"))
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    W = rng.rand(784, 10)
+    bs = 64 * n
+    for step in range(60):
+        xs = rng.rand(bs, 784).astype(np.float32)
+        ys = np.argmax(xs @ W, axis=1).astype(np.int64).reshape(-1, 1)
+        (lv,) = exe.run(compiled, feed={"img": xs, "label": ys},
+                        fetch_list=[loss.name])
+        if step % 20 == 0:
+            print(f"step {step}: loss {float(np.asarray(lv)):.4f} "
+                  f"({n} chip(s), global bs {bs})")
+
+
+if __name__ == "__main__":
+    main()

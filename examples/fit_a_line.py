@@ -1,0 +1,49 @@
+"""Linear regression on uci_housing (reference: book test_fit_a_line.py)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    reader = paddle_tpu.batch(dataset.uci_housing.train(), batch_size=32)
+    for epoch in range(5):
+        costs = []
+        for batch in reader():
+            xs = np.asarray([b[0] for b in batch], np.float32)
+            ys = np.asarray([b[1] for b in batch], np.float32).reshape(-1, 1)
+            (c,) = exe.run(main_p, feed={"x": xs, "y": ys},
+                           fetch_list=[loss.name])
+            costs.append(float(np.asarray(c).reshape(())))
+        print(f"epoch {epoch}: cost {np.mean(costs):.4f}")
+
+    # save → load → infer round trip (reference: save_inference_model /
+    # load_inference_model book pattern)
+    fluid.io.save_inference_model("/tmp/fit_a_line_model", ["x"], [pred],
+                                  exe, main_program=main_p)
+    scope = fluid.Scope()
+    infer_prog, feed_names, fetch_names = fluid.io.load_inference_model(
+        "/tmp/fit_a_line_model", exe, scope=scope)
+    sample = np.asarray(next(dataset.uci_housing.test()())[0],
+                        np.float32).reshape(1, 13)
+    (out,) = exe.run(infer_prog, feed={feed_names[0]: sample},
+                     fetch_list=fetch_names, scope=scope)
+    print(f"reloaded model prediction: {float(np.asarray(out).reshape(())):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""LeNet-ish conv net on mnist (reference: book test_recognize_digits.py)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c1 = fluid.layers.conv2d(img, 16, 5, padding=2, act="relu")
+        p1 = fluid.layers.pool2d(c1, 2, pool_stride=2)
+        c2 = fluid.layers.conv2d(p1, 32, 5, padding=2, act="relu")
+        p2 = fluid.layers.pool2d(c2, 2, pool_stride=2)
+        logits = fluid.layers.fc(fluid.layers.flatten(p2), 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    reader = paddle_tpu.batch(dataset.mnist.train(), batch_size=128)
+    for epoch in range(2):
+        accs = []
+        for batch in reader():
+            xs = np.asarray([b[0] for b in batch],
+                            np.float32).reshape(-1, 1, 28, 28)
+            ys = np.asarray([b[1] for b in batch],
+                            np.int64).reshape(-1, 1)
+            _, a = exe.run(main_p, feed={"img": xs, "label": ys},
+                           fetch_list=[loss.name, acc.name])
+            accs.append(float(np.asarray(a).reshape(())))
+        print(f"epoch {epoch}: acc {np.mean(accs):.3f}")
+
+
+if __name__ == "__main__":
+    main()
