@@ -443,7 +443,30 @@ def _label_smooth(ctx, ins, attrs):
 
 @register_op("im2sequence", ref="operators/im2sequence_op.cc")
 def _im2sequence(ctx, ins, attrs):
-    raise NotImplementedError("im2sequence: use conv patches via segment ids")
+    """Image → patch sequence: X [N, C, H, W] → Out [N, OH*OW, C*kh*kw]
+    (the padded-batch form of the reference's LoD output, one sequence per
+    image with OH*OW steps; per-step feature layout is the reference's
+    kOCF [C, kh, kw]). Lowers to ONE conv-patches extraction on the MXU
+    path (lax.conv_general_dilated_patches), not per-window gathers."""
+    if first(ins, "Y") is not None or "out_stride" in attrs:
+        # the reference's dispensable per-image real-size input
+        # (im2sequence_op.h: batch>1 + Y + out_stride computes per-image
+        # output sizes) is a dynamic-shape path with no XLA analogue
+        raise NotImplementedError(
+            "im2sequence: per-image real-size (Y/out_stride) is not "
+            "supported on TPU (static shapes) — pre-pad to a common size")
+    x = first(ins, "X")
+    kh, kw = [int(v) for v in attrs.get("kernels", [1, 1])]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pu, pl, pd, pr = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    n, c = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pu, pd), (pl, pr)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, OH, OW] with feature layout [C, kh, kw] (kOCF)
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c * kh * kw, oh * ow)
+    return single(jnp.swapaxes(patches, 1, 2))
 
 
 @register_op("pad", ref="operators/pad_op.cc")

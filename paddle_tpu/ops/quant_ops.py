@@ -35,7 +35,11 @@ def _fake_quantize_abs_max(ctx, ins, attrs):
     bits = attrs.get("bit_length", 8)
     qmax = float(2 ** (bits - 1) - 1)
     scale = jnp.max(jnp.abs(x))
-    safe = jnp.maximum(scale, 1e-8)
+    # the scale is a statistic, not a differentiable path: without the
+    # stop_gradient the arg-max element would receive an extra (wrong)
+    # gradient through d(scale)/dx (the reference's grad kernel is a pure
+    # pass-through)
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
     q = _ste_round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
     return {"Out": [q], "OutScale": [scale.reshape(1)]}
 
@@ -61,7 +65,7 @@ def _fake_quantize_range_abs_max(ctx, ins, attrs):
         out_scales = scales.at[pos].set(cur)
         scale = jnp.max(out_scales)
         new_it = it + 1
-    safe = jnp.maximum(scale, 1e-8)
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
     q = _ste_round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
     outs = {"Out": [q], "OutScale": [scale.reshape(1)]}
     if out_scales is not None:
