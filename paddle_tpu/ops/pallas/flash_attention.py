@@ -151,10 +151,17 @@ def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, bq, bk, nk, causal, scale, q_off):
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
+               bq, bk, nk, causal, scale, q_off, has_glse):
+    if has_glse:
+        glse_ref, dq_ref, dq_scr = refs
+    else:
+        glse_ref = None
+        dq_ref, dq_scr = refs
     """Grid (BH, Tq/bq, Tk/bk): accumulate dQ for one q block across k
-    blocks; ds = p * (dO·Vᵀ − delta), dQ = scale · ds·K."""
+    blocks; ds = p * (dO·Vᵀ − delta + dLSE) — the dLSE term carries the
+    cotangent of the exposed log-sum-exp (∂lse/∂s_ij = p_ij), used by
+    ring attention's block-merge; zero for plain attention."""
     qb = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -174,7 +181,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
+        corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
+        ds = p * (dp - corr)
         dq_scr[:] = dq_scr[:] + jnp.dot(
             ds, k, preferred_element_type=jnp.float32) * scale
 
@@ -183,9 +191,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, bq, bk, nq, causal,
-                scale, q_off):
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
+                bq, bk, nq, causal, scale, q_off, has_glse):
+    if has_glse:
+        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        glse_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = refs
     """Grid (BH, Tk/bk, Tq/bq): accumulate dK/dV for one k block across q
     blocks; dV = pᵀ·dO, dK = scale · dsᵀ·Q."""
     kb = pl.program_id(1)
@@ -212,7 +224,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)           # pᵀ·dO [BK, D]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
+        corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
+        ds = p * (dp - corr)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # dsᵀ·(scale·Q)
@@ -223,7 +236,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
+def _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse):
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, o, lse = res
     if scale is None:
@@ -238,11 +251,16 @@ def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
     lse4 = lse.reshape(b * h, tq, 1)
     delta4 = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32),
                      axis=-1).reshape(b * h, tq, 1)
+    has_glse = glse is not None
+    glse4 = (glse.astype(jnp.float32).reshape(b * h, tq, 1)
+             if has_glse else None)
     q_off = tk - tq
+    glse_in = ([glse4], [pl.BlockSpec((1, bq, 1),
+                                      lambda bh, i, j: (bh, i, 0))])         if has_glse else ([], [])
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, nk=nk, causal=causal,
-                          scale=scale, q_off=q_off),
+                          scale=scale, q_off=q_off, has_glse=has_glse),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
@@ -251,16 +269,18 @@ def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
-        ],
+        ] + glse_in[1],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q4, k4, v4, g4, lse4, delta4)
+    )(q4, k4, v4, g4, lse4, delta4, *glse_in[0])
 
+    glse_in_kv = ([glse4], [pl.BlockSpec((1, bq, 1),
+                                         lambda bh, j, i: (bh, i, 0))])         if has_glse else ([], [])
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=nq, causal=causal,
-                          scale=scale, q_off=q_off),
+                          scale=scale, q_off=q_off, has_glse=has_glse),
         grid=(b * h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
@@ -269,7 +289,7 @@ def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
-        ],
+        ] + glse_in_kv[1],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -281,10 +301,41 @@ def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(q4, k4, v4, g4, lse4, delta4)
+    )(q4, k4, v4, g4, lse4, delta4, *glse_in_kv[0])
 
     return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
             dv.reshape(b, h, tk, d))
 
 
+def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
+    return _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, None)
+
+
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(q, k, v, causal=False, scale=None, bq=128, bk=128,
+                        interpret=False):
+    """Like flash_attention but also returns the per-query log-sum-exp —
+    the interface ring attention needs to merge per-block results
+    (o_total = Σ_j o_j·exp(lse_j − lse_total)). Differentiable in both
+    outputs: the bwd kernels carry the lse cotangent via the dLSE term."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    return _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+
+
+def _lse_vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _lse_vjp_bwd(causal, scale, bq, bk, interpret, res, gs):
+    g, glse = gs
+    return _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse)
+
+
+flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)

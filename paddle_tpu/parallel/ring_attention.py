@@ -33,10 +33,86 @@ from jax import lax
 _NEG = -1e30
 
 
+def _use_flash_blocks(tq, tk, d):
+    """Route the per-shard block compute through the Pallas flash kernel
+    when it can tile (TPU + lane-aligned head dim), or when forced for
+    interpret-mode testing."""
+    import os
+    from paddle_tpu.ops import pallas as pk
+    if (os.environ.get("PADDLE_TPU_FORCE_PALLAS", "0") == "1"
+            and pk.interpret_mode()):
+        # test-only override: interpret mode has no tiling constraints;
+        # on real TPU the alignment gate below always applies
+        return tq % 8 == 0 and tk % 8 == 0
+    return (pk.kernel_enabled(128, d) and tq % 128 == 0 and tk % 128 == 0)
+
+
+def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
+                                scale: float):
+    """Flash-kernel variant: each ring step computes its [Tq_loc, Tk_loc]
+    block with the Pallas flash kernel (O(T·D) VMEM) returning (o_j, lse_j)
+    and merges blocks by log-sum-exp — compounding sp sharding with flash
+    tiling. Block visibility under causal masking: kv from an earlier rank
+    is fully visible, the diagonal block is causally masked, later ranks
+    are skipped (lse = -inf)."""
+    import functools as _ft
+    from paddle_tpu.ops import pallas as pk
+
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    dtype = q.dtype
+    interpret = pk.interpret_mode()
+    bq, bk = pk.pick_blocks(Tq, Tk)
+    if interpret:               # tiny test shapes: no tiling constraints
+        bq = bq or next(s for s in (8,) if Tq % s == 0)
+        bk = bk or next(s for s in (8,) if Tk % s == 0)
+    flash = _ft.partial(pk.flash_attention_lse, scale=scale, bq=bq, bk=bk,
+                        interpret=interpret)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o, lse, oj, lsej):
+        lse_new = jnp.logaddexp(lse, lsej)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + oj.astype(jnp.float32)
+             * jnp.exp(lsej - lse_new)[..., None])
+        return o, lse_new
+
+    # step 0 is ALWAYS the diagonal block (kv starts as this rank's own
+    # shard), so the causal flag is static per phase — no double compute
+    o, lse = flash(q, k, v, causal=causal)
+    o = o.astype(jnp.float32)
+    lse = lse.astype(jnp.float32)
+    kj = lax.ppermute(k, axis_name, perm=perm)
+    vj = lax.ppermute(v, axis_name, perm=perm)
+
+    def step(carry, j):
+        o, lse, kj, vj = carry
+        kv_rank = (rank - j) % n
+        oj, lsej = flash(q, kj, vj, causal=False)
+        if causal:
+            # off-diagonal: earlier ranks fully visible, later ranks masked
+            visible = kv_rank < rank
+            lsej = jnp.where(visible, lsej, _NEG)
+            oj = jnp.where(visible, oj, 0.0)
+        o, lse = merge(o, lse, oj, lsej)
+        kj = lax.ppermute(kj, axis_name, perm=perm)
+        vj = lax.ppermute(vj, axis_name, perm=perm)
+        return (o, lse, kj, vj), None
+
+    (o, lse, _, _), _ = lax.scan(step, (o, lse, kj, vj),
+                                 jnp.arange(1, n))
+    return o.astype(dtype)
+
+
 def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
                           scale: float):
     """Per-shard ring attention. q/k/v: [B, H, T_local, D] (this rank's
     sequence shard); returns [B, H, T_local, D]."""
+    if _use_flash_blocks(q.shape[2], k.shape[2], q.shape[3]):
+        return _ring_attention_shard_flash(q, k, v, axis_name, causal,
+                                           scale)
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
@@ -140,7 +216,8 @@ def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
     spec = P(b_ax, h_ax, sp_axis, None)
     mapped = shard_map(
         partial(fn, axis_name=sp_axis, causal=causal, scale=float(scale)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)   # pallas_call outputs carry no vma annotation
     return mapped(q, k, v)
 
 
