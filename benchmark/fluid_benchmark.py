@@ -35,6 +35,8 @@ _MODEL_MAP = {
     "machine_translation": "machine_translation",
     "transformer": "transformer",
     "transformer_long": "transformer_long",
+    "googlenet": "googlenet",
+    "smallnet": "smallnet",
 }
 
 

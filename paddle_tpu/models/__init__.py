@@ -7,9 +7,11 @@ the fluid-compatible API, so the same graphs run single-chip or sharded over
 a mesh.
 """
 
-from paddle_tpu.models import (alexnet, deepfm, machine_translation, mnist,
-                               resnet, se_resnext, stacked_dynamic_lstm,
+from paddle_tpu.models import (alexnet, deepfm, googlenet,
+                               machine_translation, mnist, resnet,
+                               se_resnext, smallnet, stacked_dynamic_lstm,
                                transformer, vgg)
 
-__all__ = ["alexnet", "deepfm", "machine_translation", "mnist", "resnet",
-           "se_resnext", "stacked_dynamic_lstm", "transformer", "vgg"]
+__all__ = ["alexnet", "deepfm", "googlenet", "machine_translation", "mnist",
+           "resnet", "se_resnext", "smallnet", "stacked_dynamic_lstm",
+           "transformer", "vgg"]

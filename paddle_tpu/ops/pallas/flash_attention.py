@@ -153,15 +153,15 @@ def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
                bq, bk, nk, causal, scale, q_off, has_glse):
+    """Grid (BH, Tq/bq, Tk/bk): accumulate dQ for one q block across k
+    blocks; ds = p * (dO·Vᵀ − delta + dLSE) — the dLSE term carries the
+    cotangent of the exposed log-sum-exp (∂lse/∂s_ij = p_ij), used by
+    ring attention's block-merge; zero for plain attention."""
     if has_glse:
         glse_ref, dq_ref, dq_scr = refs
     else:
         glse_ref = None
         dq_ref, dq_scr = refs
-    """Grid (BH, Tq/bq, Tk/bk): accumulate dQ for one q block across k
-    blocks; ds = p * (dO·Vᵀ − delta + dLSE) — the dLSE term carries the
-    cotangent of the exposed log-sum-exp (∂lse/∂s_ij = p_ij), used by
-    ring attention's block-merge; zero for plain attention."""
     qb = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -193,13 +193,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
                 bq, bk, nq, causal, scale, q_off, has_glse):
+    """Grid (BH, Tk/bk, Tq/bq): accumulate dK/dV for one k block across q
+    blocks; dV = pᵀ·dO, dK = scale · dsᵀ·Q."""
     if has_glse:
         glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     else:
         glse_ref = None
         dk_ref, dv_ref, dk_scr, dv_scr = refs
-    """Grid (BH, Tk/bk, Tq/bq): accumulate dK/dV for one k block across q
-    blocks; dV = pᵀ·dO, dK = scale · dsᵀ·Q."""
     kb = pl.program_id(1)
     i = pl.program_id(2)
 

@@ -66,8 +66,8 @@ def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
     interpret = pk.interpret_mode()
     bq, bk = pk.pick_blocks(Tq, Tk)
     if interpret:               # tiny test shapes: no tiling constraints
-        bq = bq or next(s for s in (8,) if Tq % s == 0)
-        bk = bk or next(s for s in (8,) if Tk % s == 0)
+        bq = bq or 8            # _use_flash_blocks guarantees Tq % 8 == 0
+        bk = bk or 8
     flash = _ft.partial(pk.flash_attention_lse, scale=scale, bq=bq, bk=bk,
                         interpret=interpret)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -199,8 +199,10 @@ def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map            # jax >= 0.8
+        _relax_kw = "check_vma"
     except ImportError:                      # pragma: no cover
         from jax.experimental.shard_map import shard_map
+        _relax_kw = "check_rep"              # pre-0.8 name of the checker
 
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
@@ -214,10 +216,17 @@ def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
     b_ax = batch_axis if ok(batch_axis, q.shape[0]) else None
     h_ax = head_axis if ok(head_axis, q.shape[1]) else None
     spec = P(b_ax, h_ax, sp_axis, None)
+    # pallas_call outputs carry no vma/replication annotation, so the
+    # checker must be off when the ring shard routes through the flash
+    # kernels; keep it on for the pure-jnp paths where it still catches
+    # missing collectives.
+    sp_size = mesh.shape[sp_axis]
+    uses_flash = impl == "ring" and _use_flash_blocks(
+        q.shape[2] // sp_size, k.shape[2] // sp_size, q.shape[3])
+    kwargs = {_relax_kw: False} if uses_flash else {}
     mapped = shard_map(
         partial(fn, axis_name=sp_axis, causal=causal, scale=float(scale)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)   # pallas_call outputs carry no vma annotation
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     return mapped(q, k, v)
 
 

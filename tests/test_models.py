@@ -55,6 +55,21 @@ def test_se_resnext50_small():
     _one_step(models.se_resnext.build, feeds, class_dim=10, image_size=32)
 
 
+def test_googlenet_small():
+    rng = np.random.RandomState(0)
+    # 128px keeps the aux-head 5x5/3 pooling non-degenerate (4a map 8x8)
+    feeds = {"data": rng.rand(2, 3, 128, 128).astype(np.float32),
+             "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+    _one_step(models.googlenet.build, feeds, class_dim=10, image_size=128)
+
+
+def test_smallnet_cifar():
+    rng = np.random.RandomState(0)
+    feeds = {"data": rng.rand(4, 3, 32, 32).astype(np.float32),
+             "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    _one_step(models.smallnet.build, feeds)
+
+
 def test_transformer_tiny_trains():
     rng = np.random.RandomState(0)
     L = 16
