@@ -18,6 +18,14 @@ def test_run_bench_local_json_contract():
     assert "1 chip" in res["metric"]
 
 
+def test_run_infer_bench_contract():
+    from bench import run_infer_bench
+    res = run_infer_bench("resnet50", batch_size=1, steps=2, warmup=1)
+    assert res["unit"] == "images/sec" and res["value"] > 0
+    assert "infer" in res["metric"]
+    assert res["vs_baseline"] is not None
+
+
 def test_run_bench_dp_mesh():
     import jax
     from bench import run_bench
