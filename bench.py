@@ -50,6 +50,7 @@ def _timed_window(run_steps, fence, steps, cap=4096):
 
     `fence()` must run ONE step with a D2H fetch (block_until_ready is a
     no-op on the axon platform, so a small fetch is the only fence)."""
+    steps = max(1, steps)   # steps=0 would otherwise never reach the cap
     fence()
     t0 = time.time()
     fence_cost = 0.105  # measured tunnel D2H scalar latency

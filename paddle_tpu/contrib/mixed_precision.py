@@ -164,23 +164,39 @@ AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
                 "conv2d_transpose", "fc")
 
 
-def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES):
+def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=True):
     """bf16 compute rewrite: tag every MXU op so its emitter casts float
-    inputs to bfloat16 and accumulates/returns fp32 (master weights stay
-    fp32 in the Scope — the later-fluid pure-bf16 AMP capability, done at
-    the op level so autodiff re-traces see the same cast).
+    inputs to bfloat16 (master weights stay fp32 in the Scope — the
+    later-fluid pure-bf16 AMP capability, done at the op level so autodiff
+    re-traces see the same cast).
+
+    pure=True (default) additionally keeps the tagged ops' OUTPUTS bf16,
+    so activations stay half-width through the whole elementwise/norm tail
+    between MXU ops (batch/layer norm compute fp32 statistics and
+    bias-adds cast parameters down rather than promoting — see
+    ops/nn_ops.py, ops/basic.py); the loss boundary
+    (softmax_with_cross_entropy) upcasts to fp32. pure=False restores
+    fp32 at every op edge (the conservative per-op mode).
 
     bf16's fp32-equal exponent range makes loss scaling unnecessary
     (module docstring), so this composes with — but does not require —
     `decorate`."""
     from paddle_tpu.fluid import framework
     program = program or framework.default_main_program()
+    elementwise = ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                   "elementwise_div", "elementwise_max", "elementwise_min")
     n = 0
     for block in program.desc.blocks:        # sub-blocks too (while/cond)
         for op in block.ops:
             if op.type in op_types:
                 op.attrs["__amp_bf16__"] = True
+                if pure:
+                    op.attrs["__amp_keep_bf16__"] = True
                 n += 1
+            elif pure and op.type in elementwise:
+                # bias/scale adds after tagged ops: cast the fp32 param
+                # operand down instead of promoting the bf16 activation up
+                op.attrs["__amp_match_dtype__"] = True
             elif op.type == "__vjp__":
                 # backward ops re-trace a SNAPSHOT of the forward op
                 # (grad_ops.py fwd_op dict) — tag it too so rewrites after
@@ -188,6 +204,10 @@ def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES):
                 fwd = op.attrs.get("fwd_op", {})
                 if fwd.get("type") in op_types:
                     fwd.setdefault("attrs", {})["__amp_bf16__"] = True
+                    if pure:
+                        fwd["attrs"]["__amp_keep_bf16__"] = True
                     n += 1
+                elif pure and fwd.get("type") in elementwise:
+                    fwd.setdefault("attrs", {})["__amp_match_dtype__"] = True
     program.desc.bump_version()
     return n

@@ -132,11 +132,31 @@ def _broadcast_y(x, y, axis):
     return y.reshape(new_shape)
 
 
+def _match_low_precision(x, y):
+    """When one side is a low-precision activation (bf16/fp16) and the
+    other a smaller fp32 broadcast operand (a bias/scale parameter), cast
+    the parameter down instead of letting promotion lift the whole
+    activation to fp32 — keeps pure-bf16 AMP programs bf16 through
+    bias-adds (HBM bandwidth is the bottleneck, SURVEY §2 #16 TPU note).
+    Only applied to ops tagged __amp_match_dtype__ by rewrite_program_amp
+    (pure mode): a non-AMP program's deliberate fp32 promotion is kept."""
+    lowp = (jnp.bfloat16, jnp.float16)
+    if (x.dtype in lowp and y.dtype == jnp.float32 and y.size < x.size):
+        y = y.astype(x.dtype)
+    elif (y.dtype in lowp and x.dtype == jnp.float32 and x.size < y.size):
+        x = x.astype(y.dtype)
+    return x, y
+
+
 def _register_elementwise(name, fn):
     @register_op(name, ref="operators/elementwise/" + name + "_op.cc")
     def _emit(ctx, ins, attrs, _fn=fn):
         x = first(ins, "X")
         y = _broadcast_y(x, first(ins, "Y"), attrs.get("axis", -1))
+        if attrs.get("__amp_match_dtype__") \
+                and jnp.issubdtype(x.dtype, jnp.floating) \
+                and jnp.issubdtype(y.dtype, jnp.floating):
+            x, y = _match_low_precision(x, y)
         return single(_fn(x, y))
 
 

@@ -37,7 +37,11 @@ def _mul(ctx, ins, attrs):
     if amp:
         x2 = x2.astype(jnp.bfloat16)
         y2 = y2.astype(jnp.bfloat16)
+        # fp32 MXU accumulation either way; pure mode rounds the result
+        # back to bf16 so the activation edge stays half-width
         out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32)
+        if attrs.get("__amp_keep_bf16__"):
+            out = out.astype(jnp.bfloat16)
     else:
         out = x2 @ y2
     out_shape = x.shape[:xn] + y.shape[yn:]
@@ -57,6 +61,8 @@ def _matmul(ctx, ins, attrs):
         y = y.astype(jnp.bfloat16)
         out = jnp.matmul(x, y,
                          preferred_element_type=jnp.float32)
+        if attrs.get("__amp_keep_bf16__"):
+            out = out.astype(jnp.bfloat16)
     else:
         out = jnp.matmul(x, y)
     alpha = attrs.get("alpha", 1.0)

@@ -493,11 +493,13 @@ def _fc(ctx, ins, attrs):
         out = jnp.matmul(x.reshape(lead, -1).astype(jnp.bfloat16),
                          w.astype(jnp.bfloat16),
                          preferred_element_type=jnp.float32)
+        if attrs.get("__amp_keep_bf16__"):
+            out = out.astype(jnp.bfloat16)
     else:
         out = x.reshape(lead, -1) @ w
     bias = first(ins, "Bias")
     if bias is not None:
-        out = out + bias.reshape(1, -1)
+        out = out + bias.reshape(1, -1).astype(out.dtype)
     if attrs.get("activation_type", "") == "relu":
         out = jnp.maximum(out, 0.0)
     return single(out.reshape(x.shape[:ncol] + (w.shape[-1],)))

@@ -41,6 +41,15 @@ def _amp_cast(attrs, *arrays):
     return list(arrays)
 
 
+def _amp_out(out, attrs):
+    """Output dtype under AMP: pure mode (__amp_keep_bf16__) keeps the
+    activation bf16 — downstream elementwise/norm ops run at half the HBM
+    traffic — while conservative mode restores fp32 at every op edge."""
+    if attrs.get("__amp_keep_bf16__"):
+        return out
+    return out.astype(jnp.float32)
+
+
 @register_op("conv2d", ref="operators/conv_op.cc:44 Conv2DOp; conv_cudnn_op.cu.cc")
 def _conv2d(ctx, ins, attrs):
     x = first(ins, "Input")          # NCHW
@@ -64,7 +73,7 @@ def _conv2d(ctx, ins, attrs):
     # preferred_element_type is avoided because its conv transpose rule
     # rejects mixed bf16-primal/f32-cotangent. Otherwise the output follows
     # the input dtype (a bf16-transpiled program stays bf16).
-    return {"Output": [out.astype(jnp.float32) if amp else out]}
+    return {"Output": [_amp_out(out, attrs) if amp else out]}
 
 
 @register_op("depthwise_conv2d", ref="operators/conv_op.cc (depthwise registered alias)")
@@ -108,7 +117,7 @@ def _conv2d_transpose(ctx, ins, attrs):
     dilations = _pair(attrs.get("dilations", [1, 1]))
     out = conv_transpose_nd(x, w, strides, pads, dilations,
                             attrs.get("groups", 1), 2)
-    return {"Output": [out.astype(jnp.float32) if amp else out]}
+    return {"Output": [_amp_out(out, attrs) if amp else out]}
 
 
 @register_op("conv3d", ref="operators/conv_op.cc Conv3DOp")
@@ -128,7 +137,7 @@ def _conv3d(ctx, ins, attrs):
         feature_group_count=attrs.get("groups", 1),
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
     )
-    return {"Output": [out.astype(jnp.float32) if amp else out]}
+    return {"Output": [_amp_out(out, attrs) if amp else out]}
 
 
 # ---------------------------------------------------------------------------
@@ -185,6 +194,67 @@ def _pool3d(ctx, ins, attrs):
 # normalization
 # ---------------------------------------------------------------------------
 
+def _bn_fold_normalize(x, mean, var, scale, bias, eps):
+    """Per-channel k/b fold: y = x·k + b in the activation dtype (one
+    fused multiply-add off half-width reads; the k/b arithmetic is fp32)."""
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = jax.lax.rsqrt(var + eps)
+    k = (inv * scale).astype(x.dtype)
+    b = (bias - mean * inv * scale).astype(x.dtype)
+    return x * k.reshape(bshape) + b.reshape(bshape), inv
+
+
+def _bn_lowp_impl(x, scale, bias, eps):
+    """Folded train-mode batch norm for bf16/fp16 activations: fp32
+    statistics off half-width reads, folded normalize."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    var = jnp.var(x, axis=axes, dtype=jnp.float32)
+    y, inv = _bn_fold_normalize(x, mean, var, scale, bias, eps)
+    return y, mean, var, inv
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_train_lowp(x, scale, bias, eps):
+    y, mean, var, _ = _bn_lowp_impl(x, scale, bias, eps)
+    return y, mean, var
+
+
+def _bn_train_lowp_fwd(x, scale, bias, eps):
+    y, mean, var, inv = _bn_lowp_impl(x, scale, bias, eps)
+    return (y, mean, var), (x, scale, mean, inv)
+
+
+def _bn_train_lowp_bwd(eps, res, cts):
+    """Hand-written BN backward: jax.vjp of the fp32-statistics forward
+    materializes fp32 copies of the activation for the variance chain;
+    here every elementwise term stays in the activation dtype and only
+    the two channel reductions accumulate fp32 — the bandwidth-optimal
+    form (dx = k·(dy − mean(dy) − x̂·mean(dy·x̂)))."""
+    dy, _dmean, _dvar = cts          # mean/var are state outputs: their
+    x, scale, mean, inv = res        # EMA consumers sit behind
+    xdt = x.dtype                    # stop_gradient in the emitter
+    axes = (0,) + tuple(range(2, x.ndim))
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    n = x.size // x.shape[1]
+    dyl = dy.astype(xdt)
+    xhat = (x - mean.astype(xdt).reshape(bshape)) \
+        * inv.astype(xdt).reshape(bshape)
+    sum_dy = jnp.sum(dyl, axis=axes, dtype=jnp.float32)
+    sum_dy_xhat = jnp.sum(dyl * xhat, axis=axes, dtype=jnp.float32)
+    k = (scale * inv).astype(xdt).reshape(bshape)
+    m1 = (sum_dy / n).astype(xdt).reshape(bshape)
+    m2 = (sum_dy_xhat / n).astype(xdt).reshape(bshape)
+    dx = k * (dyl - m1 - xhat * m2)
+    return dx, sum_dy_xhat, sum_dy   # dscale = Σdy·x̂, dbias = Σdy
+
+
+_bn_train_lowp.defvjp(_bn_train_lowp_fwd, _bn_train_lowp_bwd)
+
+
 @register_op("batch_norm", ref="operators/batch_norm_op.cc:40")
 def _batch_norm(ctx, ins, attrs):
     """Train mode: batch statistics + EMA update of Mean/Variance (the
@@ -200,14 +270,35 @@ def _batch_norm(ctx, ins, attrs):
     momentum = attrs.get("momentum", 0.9)
     is_test = attrs.get("is_test", False) or ctx.is_test
     axes = (0,) + tuple(range(2, x.ndim))
+    # bf16/fp16 activations (pure AMP): statistics accumulate in fp32
+    # (XLA's convert+reduce fusion reads the half-width bytes), the
+    # normalize runs in the activation dtype via folded per-channel
+    # scale/shift — halves the HBM traffic of the bandwidth-bound step
+    lowp = x.dtype in (jnp.bfloat16, jnp.float16)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
     if is_test or attrs.get("use_global_stats", False):
         use_mean, use_var = mean, var
         saved_mean = mean
         saved_var = var
         mean_out, var_out = mean, var
+        if lowp:
+            y, _ = _bn_fold_normalize(x, use_mean, use_var, scale, bias,
+                                      eps)
+        else:
+            inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
+            y = (x - use_mean.reshape(bshape)) * inv \
+                * scale.reshape(bshape) + bias.reshape(bshape)
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        if lowp:
+            # custom-vjp path: fp32 statistics, activation-dtype compute
+            # in BOTH directions (see _bn_train_lowp_bwd)
+            y, use_mean, use_var = _bn_train_lowp(x, scale, bias, eps)
+        else:
+            use_mean = jnp.mean(x, axis=axes)
+            use_var = jnp.var(x, axis=axes)
+            inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
+            y = (x - use_mean.reshape(bshape)) * inv \
+                * scale.reshape(bshape) + bias.reshape(bshape)
         # EMA update is state maintenance, not on the loss path
         use_mean_s = jax.lax.stop_gradient(use_mean)
         use_var_s = jax.lax.stop_gradient(use_var)
@@ -215,9 +306,6 @@ def _batch_norm(ctx, ins, attrs):
         var_out = var * momentum + use_var_s * (1.0 - momentum)
         saved_mean = use_mean
         saved_var = use_var
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
-    inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
-    y = (x - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
     return {
         "Y": [y],
         "MeanOut": [mean_out],
@@ -235,14 +323,22 @@ def _layer_norm(ctx, ins, attrs):
     begin = attrs.get("begin_norm_axis", 1)
     eps = attrs.get("epsilon", 1e-5)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # same lowp treatment as batch_norm: fp32 statistics, activation-dtype
+    # normalize
+    lowp = x.dtype in (jnp.bfloat16, jnp.float16)
+    stat_kw = {"dtype": jnp.float32} if lowp else {}
+    mean = jnp.mean(x, axis=axes, keepdims=True, **stat_kw)
+    var = jnp.var(x, axis=axes, keepdims=True, **stat_kw)
+    inv = jax.lax.rsqrt(var + eps)
+    if lowp:
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    else:
+        y = (x - mean) * inv
     norm_shape = x.shape[begin:]
     if scale is not None:
-        y = y * scale.reshape(norm_shape)
+        y = y * scale.reshape(norm_shape).astype(y.dtype)
     if bias is not None:
-        y = y + bias.reshape(norm_shape)
+        y = y + bias.reshape(norm_shape).astype(y.dtype)
     return {
         "Y": [y],
         "Mean": [mean.reshape(x.shape[:begin])],
@@ -352,6 +448,10 @@ def _gather_label_prob(prob, label):
 def _cross_entropy(ctx, ins, attrs):
     x = first(ins, "X")              # probabilities [N, D]
     label = first(ins, "Label")
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        # loss boundary: log(p) and its 1/p gradient need fp32 (same
+        # rationale as softmax_with_cross_entropy below)
+        x = x.astype(jnp.float32)
     eps = 1e-9
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
@@ -368,6 +468,10 @@ def _cross_entropy(ctx, ins, attrs):
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits = first(ins, "Logits")
     label = first(ins, "Label")
+    if logits.dtype in (jnp.bfloat16, jnp.float16):
+        # loss boundary: log-softmax needs fp32 (bf16 has ~3 decimal
+        # digits; exp/log cancellation destroys the loss signal)
+        logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
@@ -386,6 +490,8 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
 def _sigmoid_ce(ctx, ins, attrs):
     x = first(ins, "X")
     label = first(ins, "Label")
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        x = x.astype(jnp.float32)    # loss boundary (see _cross_entropy)
     loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
     ignore = attrs.get("ignore_index", -100)
     loss = jnp.where(label == ignore, 0.0, loss)
