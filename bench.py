@@ -85,7 +85,7 @@ def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None):
 
 
 def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
-              amp: bool = False, mesh=None):
+              amp: bool = False, mesh=None, nhwc: bool = True):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -133,6 +133,9 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         if amp:
             from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
             rewrite_program_amp(main)
+        if nhwc:
+            from paddle_tpu.contrib.layout import rewrite_program_nhwc
+            rewrite_program_nhwc(main)
 
     run_target = main
     n_chips = 1
@@ -291,6 +294,9 @@ def main():
     ap.add_argument("--amp", dest="amp", action="store_true", default=True,
                     help="bf16 MXU compute (fp32 master weights) — default")
     ap.add_argument("--no-amp", dest="amp", action="store_false")
+    ap.add_argument("--no-nhwc", dest="nhwc", action="store_false",
+                    default=True, help="disable the channels-last layout "
+                    "rewrite (contrib.layout)")
     args = ap.parse_args()
     if args.infer:
         infer_bs = {"resnet50": 16, "vgg": 1, "googlenet": 16}
@@ -301,7 +307,8 @@ def main():
         result = run_infer_bench(args.model, bs, args.steps, amp=args.amp)
     else:
         bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
-        result = run_bench(args.model, bs, args.steps, amp=args.amp)
+        result = run_bench(args.model, bs, args.steps, amp=args.amp,
+                           nhwc=args.nhwc)
     print(json.dumps(result))
 
 

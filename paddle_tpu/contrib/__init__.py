@@ -4,10 +4,11 @@ precision utilities). bfloat16 replaces float16 throughout: it is the
 MXU-native reduced precision and needs no loss-scaling tricks for
 inference."""
 
+from paddle_tpu.contrib import layout  # noqa: F401
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
 
 from paddle_tpu.contrib.quantize_transpiler import QuantizeTranspiler  # noqa: F401
 
 __all__ = ["BF16Transpiler", "Float16Transpiler", "QuantizeTranspiler",
-           "mixed_precision"]
+           "layout", "mixed_precision"]

@@ -183,8 +183,7 @@ def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=True):
     `decorate`."""
     from paddle_tpu.fluid import framework
     program = program or framework.default_main_program()
-    elementwise = ("elementwise_add", "elementwise_sub", "elementwise_mul",
-                   "elementwise_div", "elementwise_max", "elementwise_min")
+    from paddle_tpu.ops.basic import ELEMENTWISE_OPS as elementwise
     n = 0
     for block in program.desc.blocks:        # sub-blocks too (while/cond)
         for op in block.ops:

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import ir
@@ -173,7 +174,15 @@ def build_block_fn(program: ir.ProgramDesc, block_idx: int,
         step_base = base_key
         emit_op_seq(program, block, sig.live_ops, env, base_key, step_base,
                     is_test, dist=dist)
-        fetches = [env[n] for n in sig.fetch_names]
+        fetches = []
+        for n in sig.fetch_names:
+            v = env[n]
+            # contrib.layout NHWC-resident intermediates come back to the
+            # user in the declared NCHW layout
+            if (getattr(v, "ndim", 0) == 4 and block.has_var(n)
+                    and block.var(n).attrs.get("__nhwc__")):
+                v = jnp.transpose(v, (0, 3, 1, 2))
+            fetches.append(v)
         new_state = {n: env[n] for n in sig.state_names if n in env}
         for n in sig.created_persistable:
             if n in env:

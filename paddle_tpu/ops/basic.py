@@ -148,11 +148,23 @@ def _match_low_precision(x, y):
     return x, y
 
 
+# float elementwise binaries (shared by contrib.mixed_precision dtype
+# matching and contrib.layout broadcast analysis)
+ELEMENTWISE_OPS = ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                   "elementwise_div", "elementwise_max", "elementwise_min")
+
+
 def _register_elementwise(name, fn):
     @register_op(name, ref="operators/elementwise/" + name + "_op.cc")
     def _emit(ctx, ins, attrs, _fn=fn):
         x = first(ins, "X")
-        y = _broadcast_y(x, first(ins, "Y"), attrs.get("axis", -1))
+        y = first(ins, "Y")
+        if attrs.get("__nhwc_bcast__") and y.ndim == 1:
+            # contrib.layout NHWC region: the channel (axis=1) broadcast
+            # re-aims at the physical last axis
+            y = y.reshape((1,) * (x.ndim - 1) + (-1,))
+        else:
+            y = _broadcast_y(x, y, attrs.get("axis", -1))
         if attrs.get("__amp_match_dtype__") \
                 and jnp.issubdtype(x.dtype, jnp.floating) \
                 and jnp.issubdtype(y.dtype, jnp.floating):

@@ -50,6 +50,22 @@ def _amp_out(out, attrs):
     return out.astype(jnp.float32)
 
 
+def _nhwc_in(x, attrs):
+    """contrib.layout region entry: transpose NCHW→NHWC unless the graph
+    var is already NHWC-resident (producer kept it)."""
+    if attrs.get("__nhwc__") and not attrs.get("__nhwc_in_ready__"):
+        return jnp.transpose(x, (0, 2, 3, 1))
+    return x
+
+
+def _nhwc_out(out, attrs):
+    """contrib.layout region exit: keep NHWC when every consumer handles
+    it, else restore NCHW."""
+    if attrs.get("__nhwc__") and not attrs.get("__nhwc_out_keep__"):
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
 @register_op("conv2d", ref="operators/conv_op.cc:44 Conv2DOp; conv_cudnn_op.cu.cc")
 def _conv2d(ctx, ins, attrs):
     x = first(ins, "Input")          # NCHW
@@ -60,14 +76,18 @@ def _conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1)
+    x = _nhwc_in(x, attrs)
+    dn = ("NHWC", "OIHW", "NHWC") if attrs.get("__nhwc__") \
+        else ("NCHW", "OIHW", "NCHW")
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
     )
+    out = _nhwc_out(out, attrs)
     # under AMP the conv runs fully in bf16 (XLA accumulates fp32 on the
     # MXU internally) and the output returns to fp32 (master dtype);
     # preferred_element_type is avoided because its conv transpose rule
@@ -80,7 +100,9 @@ def _conv2d(ctx, ins, attrs):
 def _depthwise_conv2d(ctx, ins, attrs):
     x = first(ins, "Input")
     attrs = dict(attrs)
-    attrs["groups"] = x.shape[1]
+    # channel dim position depends on the residency of the graph var
+    nhwc_resident = attrs.get("__nhwc__") and attrs.get("__nhwc_in_ready__")
+    attrs["groups"] = x.shape[3] if nhwc_resident else x.shape[1]
     return _conv2d(ctx, ins, attrs)
 
 
@@ -146,18 +168,26 @@ def _conv3d(ctx, ins, attrs):
 
 @register_op("pool2d", ref="operators/pool_op.cc")
 def _pool2d(ctx, ins, attrs):
-    x = first(ins, "X")              # NCHW
+    x = first(ins, "X")              # NCHW (NHWC inside a layout region)
+    x = _nhwc_in(x, attrs)
+    nhwc = attrs.get("__nhwc__", False)
+    sp = (1, 2) if nhwc else (2, 3)  # spatial dim positions
     ptype = attrs.get("pooling_type", "max")
     ksize = _pair(attrs.get("ksize", [2, 2]))
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     if attrs.get("global_pooling", False):
-        ksize = x.shape[2:]
+        ksize = tuple(x.shape[d] for d in sp)
         pads = (0, 0)
         strides = (1, 1)
-    window = (1, 1) + tuple(ksize)
-    strides4 = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    window = [1, 1, 1, 1]
+    strides4 = [1, 1, 1, 1]
+    padding = [(0, 0)] * 4
+    for i, d in enumerate(sp):
+        window[d] = ksize[i]
+        strides4[d] = strides[i]
+        padding[d] = (pads[i], pads[i])
+    window, strides4, padding = tuple(window), tuple(strides4), tuple(padding)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
@@ -169,7 +199,7 @@ def _pool2d(ctx, ins, attrs):
             out = summed / counts
         else:
             out = summed / float(ksize[0] * ksize[1])
-    return single(out)
+    return single(_nhwc_out(out, attrs))
 
 
 @register_op("pool3d", ref="operators/pool_op.cc Pool3D")
@@ -194,41 +224,53 @@ def _pool3d(ctx, ins, attrs):
 # normalization
 # ---------------------------------------------------------------------------
 
-def _bn_fold_normalize(x, mean, var, scale, bias, eps):
+def _bn_axes(x, caxis):
+    """(reduction axes, broadcast shape) for channel axis `caxis`."""
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = tuple(-1 if i == caxis else 1 for i in range(x.ndim))
+    return axes, bshape
+
+
+def _bn_fold_normalize(x, mean, var, scale, bias, eps, caxis=1):
     """Per-channel k/b fold: y = x·k + b in the activation dtype (one
     fused multiply-add off half-width reads; the k/b arithmetic is fp32)."""
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    _, bshape = _bn_axes(x, caxis)
     inv = jax.lax.rsqrt(var + eps)
     k = (inv * scale).astype(x.dtype)
     b = (bias - mean * inv * scale).astype(x.dtype)
     return x * k.reshape(bshape) + b.reshape(bshape), inv
 
 
-def _bn_lowp_impl(x, scale, bias, eps):
+def _bn_lowp_impl(x, scale, bias, eps, caxis):
     """Folded train-mode batch norm for bf16/fp16 activations: fp32
-    statistics off half-width reads, folded normalize."""
-    axes = (0,) + tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-    var = jnp.var(x, axis=axes, dtype=jnp.float32)
-    y, inv = _bn_fold_normalize(x, mean, var, scale, bias, eps)
+    statistics off half-width reads, folded normalize. One-pass moments:
+    jnp.var's two-pass (mean, then (x−mean)²) reads the activation twice;
+    E[x²]−E[x]² lets XLA fuse both channel reductions into a single read
+    (the fp32 accumulate keeps the cancellation benign for BN's use)."""
+    axes, _ = _bn_axes(x, caxis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    msq = jnp.mean(xf * xf, axis=axes)
+    var = jnp.maximum(msq - mean * mean, 0.0)
+    y, inv = _bn_fold_normalize(x, mean, var, scale, bias, eps, caxis)
     return y, mean, var, inv
 
 
 from functools import partial as _partial
 
 
-@_partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _bn_train_lowp(x, scale, bias, eps):
-    y, mean, var, _ = _bn_lowp_impl(x, scale, bias, eps)
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_lowp(x, scale, bias, eps, caxis=1):
+    y, mean, var, _ = _bn_lowp_impl(x, scale, bias, eps, caxis)
     return y, mean, var
 
 
-def _bn_train_lowp_fwd(x, scale, bias, eps):
-    y, mean, var, inv = _bn_lowp_impl(x, scale, bias, eps)
+def _bn_train_lowp_fwd(x, scale, bias, eps, caxis):
+    y, mean, var, inv = _bn_lowp_impl(x, scale, bias, eps, caxis)
     return (y, mean, var), (x, scale, mean, inv)
 
 
-def _bn_train_lowp_bwd(eps, res, cts):
+def _bn_train_lowp_bwd(eps, caxis, res, cts):
     """Hand-written BN backward: jax.vjp of the fp32-statistics forward
     materializes fp32 copies of the activation for the variance chain;
     here every elementwise term stays in the activation dtype and only
@@ -237,9 +279,8 @@ def _bn_train_lowp_bwd(eps, res, cts):
     dy, _dmean, _dvar = cts          # mean/var are state outputs: their
     x, scale, mean, inv = res        # EMA consumers sit behind
     xdt = x.dtype                    # stop_gradient in the emitter
-    axes = (0,) + tuple(range(2, x.ndim))
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
-    n = x.size // x.shape[1]
+    axes, bshape = _bn_axes(x, caxis)
+    n = x.size // x.shape[caxis]
     dyl = dy.astype(xdt)
     xhat = (x - mean.astype(xdt).reshape(bshape)) \
         * inv.astype(xdt).reshape(bshape)
@@ -269,13 +310,14 @@ def _batch_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     momentum = attrs.get("momentum", 0.9)
     is_test = attrs.get("is_test", False) or ctx.is_test
-    axes = (0,) + tuple(range(2, x.ndim))
+    x = _nhwc_in(x, attrs)
+    caxis = (x.ndim - 1) if attrs.get("__nhwc__") else 1
+    axes, bshape = _bn_axes(x, caxis)
     # bf16/fp16 activations (pure AMP): statistics accumulate in fp32
     # (XLA's convert+reduce fusion reads the half-width bytes), the
     # normalize runs in the activation dtype via folded per-channel
     # scale/shift — halves the HBM traffic of the bandwidth-bound step
     lowp = x.dtype in (jnp.bfloat16, jnp.float16)
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
     if is_test or attrs.get("use_global_stats", False):
         use_mean, use_var = mean, var
         saved_mean = mean
@@ -283,7 +325,7 @@ def _batch_norm(ctx, ins, attrs):
         mean_out, var_out = mean, var
         if lowp:
             y, _ = _bn_fold_normalize(x, use_mean, use_var, scale, bias,
-                                      eps)
+                                      eps, caxis)
         else:
             inv = jax.lax.rsqrt(use_var.reshape(bshape) + eps)
             y = (x - use_mean.reshape(bshape)) * inv \
@@ -292,7 +334,8 @@ def _batch_norm(ctx, ins, attrs):
         if lowp:
             # custom-vjp path: fp32 statistics, activation-dtype compute
             # in BOTH directions (see _bn_train_lowp_bwd)
-            y, use_mean, use_var = _bn_train_lowp(x, scale, bias, eps)
+            y, use_mean, use_var = _bn_train_lowp(x, scale, bias, eps,
+                                                  caxis)
         else:
             use_mean = jnp.mean(x, axis=axes)
             use_var = jnp.var(x, axis=axes)
@@ -306,6 +349,7 @@ def _batch_norm(ctx, ins, attrs):
         var_out = var * momentum + use_var_s * (1.0 - momentum)
         saved_mean = use_mean
         saved_var = use_var
+    y = _nhwc_out(y, attrs)
     return {
         "Y": [y],
         "MeanOut": [mean_out],

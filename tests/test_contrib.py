@@ -272,3 +272,78 @@ def test_bf16_transpiled_interior_stays_bf16():
     w = jnp.ones((3, 4), jnp.bfloat16)
     out = get_op("mul").emit(ctx, {"X": [x], "Y": [w]}, {})["Out"][0]
     assert out.dtype == jnp.bfloat16
+
+
+def test_nhwc_layout_rewrite_exact_parity():
+    """contrib.layout NHWC rewrite: one full train step (fwd + backward +
+    momentum update) is bit-identical to the NCHW program in fp32 — the
+    rewrite is attr-only, transposes live inside the tagged emitters and
+    gradients mirror the forward layout via the __vjp__ re-trace."""
+    import numpy as np
+    from paddle_tpu.contrib.layout import rewrite_program_nhwc
+
+    def run_once(rewrite):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        startup.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 16, 16],
+                              dtype="float32")
+            lbl = layers.data(name="lbl", shape=[1], dtype="int64")
+            c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)
+            b = layers.batch_norm(c, act="relu")
+            c2 = layers.conv2d(b, num_filters=8, filter_size=3, padding=1)
+            res = layers.elementwise_add(c2, c)          # residual
+            p = layers.pool2d(res, pool_type="avg", global_pooling=True)
+            logits = layers.fc(p, size=4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.Momentum(learning_rate=0.01,
+                                     momentum=0.9).minimize(loss)
+            if rewrite:
+                n = rewrite_program_nhwc(main)
+                assert n >= 4, n   # conv x2 + bn + pool tagged
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(3)
+            feeds = {"img": rng.rand(4, 3, 16, 16).astype(np.float32),
+                     "lbl": rng.randint(0, 4, (4, 1)).astype(np.int64)}
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss], scope=scope)
+            wname = next(op.inputs["Filter"][0]
+                         for op in main.desc.global_block.ops
+                         if op.type == "conv2d")
+            w = np.asarray(scope.find_var(wname))
+        return float(np.asarray(lv).reshape(())), w
+
+    l_nchw, w_nchw = run_once(False)
+    l_nhwc, w_nhwc = run_once(True)
+    assert l_nchw == l_nhwc
+    np.testing.assert_array_equal(w_nchw, w_nhwc)
+
+
+def test_nhwc_layout_untracked_and_fetch_boundaries():
+    """Review regressions: (1) an agnostic op on the raw feed must not
+    mark downstream convs in-ready (feed vars are fixed NCHW); (2) a
+    trailing-axis broadcast the emitter cannot re-aim forces NCHW; (3)
+    fetching an NHWC-resident intermediate returns declared-NCHW data."""
+    import numpy as np
+    from paddle_tpu.contrib.layout import rewrite_program_nhwc
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        s = layers.scale(img, scale=2.0)                 # (1)
+        c = layers.conv2d(s, num_filters=4, filter_size=3, padding=1)
+        wvec = layers.fill_constant([8], "float32", 0.5)
+        a = layers.elementwise_add(c, wvec, axis=-1)     # (2)
+        c2 = layers.conv2d(a, num_filters=4, filter_size=3, padding=1)
+        p = layers.pool2d(c2, pool_type="avg", global_pooling=True)
+        loss = layers.mean(p)
+    rewrite_program_nhwc(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeds = {"img": np.ones((2, 3, 8, 8), np.float32)}
+    lv, cv = exe.run(main, feed=feeds, fetch_list=[loss, c2])  # (3)
+    assert np.isfinite(float(np.asarray(lv).reshape(())))
+    assert np.asarray(cv).shape == (2, 4, 8, 8)
