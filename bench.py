@@ -193,7 +193,7 @@ VGG19_XEON_INFER_IMG_S = 75.07      # IntelOptimizedPaddle.md:71-78, bs1
 
 
 def run_infer_bench(model_name: str, batch_size: int, steps: int,
-                    warmup: int = 5, amp: bool = True):
+                    warmup: int = 5, amp: bool = True, nhwc: bool = True):
     """Inference throughput through the deployment path: build is_test
     graph -> save_inference_model -> AnalysisPredictor load (+BN-fold IR
     rewrite) -> timed forward passes (reference capability:
@@ -241,6 +241,9 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
     if amp:
         from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
         rewrite_program_amp(program)
+    if nhwc:
+        from paddle_tpu.contrib.layout import rewrite_program_nhwc
+        rewrite_program_nhwc(program)
     pexe, scope = predictor._exe, predictor._scope
     rng = np.random.RandomState(0)
     x = jax.device_put(
@@ -304,7 +307,8 @@ def main():
             ap.error(f"--infer supports {sorted(infer_bs)}; "
                      f"{args.model!r} has no deployment-path benchmark")
         bs = args.batch_size or infer_bs[args.model]
-        result = run_infer_bench(args.model, bs, args.steps, amp=args.amp)
+        result = run_infer_bench(args.model, bs, args.steps, amp=args.amp,
+                                 nhwc=args.nhwc)
     else:
         bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
         result = run_bench(args.model, bs, args.steps, amp=args.amp,

@@ -102,6 +102,15 @@ def rewrite_program_nhwc(program=None):
             outs = [n for names in op.outputs.values() for n in names]
             if t in AGNOSTIC:
                 changed |= group_all_or_none(ins + outs)
+            elif t == "concat":
+                if op.attrs.get("axis", 0) == 1:
+                    # channel concat: transparent, emitter re-aims axis
+                    changed |= group_all_or_none(ins + outs)
+                else:
+                    for n in ins + outs:
+                        if nhwc.get(n):
+                            nhwc[n] = False
+                            changed = True
             elif t in ELEMENTWISE:
                 x = (op.inputs.get("X") or [None])[0]
                 y = (op.inputs.get("Y") or [None])[0]
@@ -159,11 +168,22 @@ def rewrite_program_nhwc(program=None):
                     and len(yv.shape) == 1 and yv.shape[0] != 1
                     and op.attrs.get("axis", -1) == 1):
                 tags[oi] = {"__nhwc_bcast__": True}
+        elif t == "concat":
+            first_in = (op.inputs.get("X") or [None])[0]
+            if nhwc.get(first_in) and op.attrs.get("axis", 0) == 1:
+                tags[oi] = {"__nhwc_concat__": True}
     for oi, attrs in tags.items():
         ops[oi].attrs.update(attrs)
         n_tagged += 1
     # stamp residency on the var descs: the executor transposes fetched
-    # NHWC-resident vars back to the declared NCHW layout (lowering.py)
+    # NHWC-resident vars back to the declared NCHW layout (lowering.py).
+    # Gradient vars are produced by __vjp__ re-traces, whose cotangents
+    # mirror the FORWARD var's physical layout (jax.vjp), so their
+    # residency is the forward var's — the fixpoint (which skips __vjp__)
+    # never constrained them.
+    for n in list(nhwc):
+        if "@GRAD" in n:
+            nhwc[n] = bool(nhwc.get(n.split("@GRAD")[0]))
     for n, resident in nhwc.items():
         if resident:
             blk.var(n).attrs["__nhwc__"] = True

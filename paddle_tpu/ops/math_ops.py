@@ -192,7 +192,13 @@ def _transpose2(ctx, ins, attrs):
 
 @register_op("concat", ref="operators/concat_op.cc")
 def _concat(ctx, ins, attrs):
-    return single(jnp.concatenate(ins.get("X", []), axis=attrs.get("axis", 0)))
+    axis = attrs.get("axis", 0)
+    xs = ins.get("X", [])
+    if attrs.get("__nhwc_concat__"):
+        # contrib.layout NHWC region: the channel concat (axis=1) re-aims
+        # at the physical last axis
+        axis = xs[0].ndim - 1
+    return single(jnp.concatenate(xs, axis=axis))
 
 
 @register_op("split", ref="operators/split_op.cc")

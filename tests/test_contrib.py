@@ -347,3 +347,44 @@ def test_nhwc_layout_untracked_and_fetch_boundaries():
     lv, cv = exe.run(main, feed=feeds, fetch_list=[loss, c2])  # (3)
     assert np.isfinite(float(np.asarray(lv).reshape(())))
     assert np.asarray(cv).shape == (2, 4, 8, 8)
+
+
+def test_nhwc_layout_concat_channel_axis():
+    """Inception-style channel concat (axis=1) stays inside the NHWC
+    region: the emitter re-aims the concat at the physical last axis and
+    results match NCHW."""
+    import numpy as np
+    from paddle_tpu.contrib.layout import rewrite_program_nhwc
+
+    def run_once(rewrite):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        startup.random_seed = 11
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 8, 8],
+                              dtype="float32")
+            b1 = layers.conv2d(img, num_filters=4, filter_size=1)
+            b2 = layers.conv2d(img, num_filters=4, filter_size=3,
+                               padding=1)
+            cat = layers.concat([b1, b2], axis=1)
+            c = layers.conv2d(cat, num_filters=4, filter_size=1)
+            p = layers.pool2d(c, pool_type="avg", global_pooling=True)
+            loss = layers.mean(p)
+            if rewrite:
+                n = rewrite_program_nhwc(main)
+                assert n >= 5, n     # 3 convs + concat + pool
+                cat_ops = [op for op in main.desc.global_block.ops
+                           if op.type == "concat"]
+                assert cat_ops[0].attrs.get("__nhwc_concat__"), \
+                    "concat not kept inside the NHWC region"
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            feeds = {"img": np.random.RandomState(1)
+                     .rand(2, 3, 8, 8).astype(np.float32)}
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss],
+                          scope=scope)
+        return float(np.asarray(lv).reshape(()))
+
+    a, b = run_once(False), run_once(True)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
