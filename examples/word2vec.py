@@ -1,0 +1,63 @@
+"""N-gram word embedding model on imikolov (reference: book
+test_word2vec.py — 4 context embeddings with a shared table -> fc ->
+softmax cross-entropy)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+
+N = 5           # 4 context words predict the 5th
+EMB = 32
+BATCH = 64
+
+
+def main():
+    word_dict = dataset.imikolov.build_dict()
+    vocab = len(word_dict)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        words = [fluid.layers.data(name=f"w{i}", shape=[1], dtype="int64")
+                 for i in range(N - 1)]
+        target = fluid.layers.data(name="target", shape=[1], dtype="int64")
+        embs = [fluid.layers.embedding(
+                    w, size=[vocab, EMB],
+                    param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words]
+        hidden = fluid.layers.fc(fluid.layers.concat(embs, axis=1),
+                                 size=128, act="relu")
+        pred = fluid.layers.fc(hidden, size=vocab, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=target))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    reader = paddle_tpu.batch(
+        dataset.imikolov.train(word_dict, N), batch_size=BATCH,
+        drop_last=True)
+    for epoch in range(2):
+        costs = []
+        for batch in reader():
+            grams = np.asarray(batch, np.int64)      # [B, 5]
+            feed = {f"w{i}": grams[:, i:i + 1] for i in range(N - 1)}
+            feed["target"] = grams[:, N - 1:N]
+            (c,) = exe.run(main_p, feed=feed, fetch_list=[loss.name])
+            costs.append(float(np.asarray(c).reshape(())))
+        print(f"epoch {epoch}: ce {np.mean(costs):.4f}")
+
+    # nearest neighbours in the learned embedding space
+    emb_table = np.asarray(fluid.global_scope().find_var("shared_emb"))
+    q = emb_table[1]
+    sims = emb_table @ q / (np.linalg.norm(emb_table, axis=1)
+                            * np.linalg.norm(q) + 1e-9)
+    print("nearest to token 1:", np.argsort(-sims)[:5].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -164,19 +164,30 @@ AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
                 "conv2d_transpose", "fc")
 
 
-def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=True):
+RECURRENT_OPS = ("dynamic_lstm", "dynamic_gru", "dynamic_lstmp", "while",
+                 "gru_unit", "lstm_unit")
+
+
+def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=None):
     """bf16 compute rewrite: tag every MXU op so its emitter casts float
     inputs to bfloat16 (master weights stay fp32 in the Scope — the
     later-fluid pure-bf16 AMP capability, done at the op level so autodiff
     re-traces see the same cast).
 
-    pure=True (default) additionally keeps the tagged ops' OUTPUTS bf16,
-    so activations stay half-width through the whole elementwise/norm tail
+    pure=True additionally keeps the tagged ops' OUTPUTS bf16, so
+    activations stay half-width through the whole elementwise/norm tail
     between MXU ops (batch/layer norm compute fp32 statistics and
     bias-adds cast parameters down rather than promoting — see
     ops/nn_ops.py, ops/basic.py); the loss boundary
     (softmax_with_cross_entropy) upcasts to fp32. pure=False restores
     fp32 at every op edge (the conservative per-op mode).
+
+    pure=None (default) auto-selects: pure bf16 unless the program
+    contains recurrent-scan ops (RECURRENT_OPS) — scan steps are small
+    and latency-bound, where bf16 activation edges add per-step converts
+    instead of saving bandwidth (measured: machine_translation GRU 772k
+    words/s conservative vs 650k pure on v5e; ResNet-50 the reverse,
+    2530 pure vs 1890 conservative img/s).
 
     bf16's fp32-equal exponent range makes loss scaling unnecessary
     (module docstring), so this composes with — but does not require —
@@ -184,6 +195,10 @@ def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=True):
     from paddle_tpu.fluid import framework
     program = program or framework.default_main_program()
     from paddle_tpu.ops.basic import ELEMENTWISE_OPS as elementwise
+    if pure is None:
+        pure = not any(op.type in RECURRENT_OPS
+                       for block in program.desc.blocks
+                       for op in block.ops)
     n = 0
     for block in program.desc.blocks:        # sub-blocks too (while/cond)
         for op in block.ops:

@@ -7,9 +7,10 @@ environments they fall back to deterministic synthetic data with the real
 shapes/vocab sizes so training pipelines and benchmarks run unchanged.
 """
 
-from paddle_tpu.dataset import (cifar, conll05, flowers, imdb, mnist,
-                                movielens, sentiment, uci_housing, voc2012,
-                                wmt14, wmt16)
+from paddle_tpu.dataset import (cifar, conll05, flowers, imdb, imikolov,
+                                mnist, movielens, mq2007, sentiment,
+                                uci_housing, voc2012, wmt14, wmt16)
 
-__all__ = ["cifar", "conll05", "flowers", "imdb", "mnist", "movielens",
-           "sentiment", "uci_housing", "voc2012", "wmt14", "wmt16"]
+__all__ = ["cifar", "conll05", "flowers", "imdb", "imikolov", "mnist",
+           "movielens", "mq2007", "sentiment", "uci_housing", "voc2012",
+           "wmt14", "wmt16"]

@@ -1,0 +1,83 @@
+"""imikolov (PTB) language-model reader (reference:
+python/paddle/dataset/imikolov.py — build_dict + n-gram / sequence
+readers; the word2vec book chapter's dataset). Synthetic-corpus fallback
+when no cached data exists, per the zoo convention (dataset/common.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+
+class DataType(object):
+    """reference: imikolov.py:35."""
+    NGRAM = 1
+    SEQ = 2
+
+
+_VOCAB = 2000
+_N_TRAIN = 2000
+_N_TEST = 200
+
+
+def _sentences(split: str, n: int, seed: int):
+    data = common.cached_npz(f"imikolov_{split}")
+    if data is not None:
+        for row in data["sents"]:
+            yield [int(w) for w in row if w >= 0]
+        return
+    # synthetic Zipf-ish corpus: deterministic, vocabulary-stable
+    rng = np.random.RandomState(seed)
+    probs = 1.0 / np.arange(1, _VOCAB + 1)
+    probs /= probs.sum()
+    for _ in range(n):
+        length = int(rng.randint(5, 25))
+        yield rng.choice(_VOCAB, size=length, p=probs).tolist()
+
+
+def build_dict(min_word_freq=50):
+    """reference: imikolov.py:53 — word -> contiguous index, '<unk>' last.
+    The synthetic corpus is already integer-coded; the dict maps token ids
+    (as strings, mirroring the word->idx contract) plus '<unk>'/'<e>'."""
+    word_idx = {str(i): i for i in range(_VOCAB)}
+    word_idx["<e>"] = len(word_idx)
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def reader_creator(split, word_idx, n, data_type=DataType.NGRAM,
+                   n_sents=_N_TRAIN, seed=101):
+    """reference: imikolov.py:83 — NGRAM yields n-word sliding windows,
+    SEQ yields (input_seq, shifted target_seq)."""
+    end = word_idx["<e>"]
+
+    def reader():
+        for sent in _sentences(split, n_sents, seed):
+            if data_type == DataType.NGRAM:
+                assert n > -1, "Invalid gram length"
+                s = sent + [end]
+                if len(s) >= n:
+                    for i in range(n, len(s) + 1):
+                        yield tuple(s[i - n:i])
+            elif data_type == DataType.SEQ:
+                s = sent + [end]
+                yield s[:-1], s[1:]
+            else:
+                raise ValueError(f"Unknown data type {data_type}")
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    """reference: imikolov.py:113."""
+    return reader_creator("train", word_idx, n, data_type, _N_TRAIN, 101)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    """reference: imikolov.py:133."""
+    return reader_creator("test", word_idx, n, data_type, _N_TEST, 102)
+
+
+def fetch():
+    """reference: imikolov.py:153 — download hook; no egress here."""
+    return None

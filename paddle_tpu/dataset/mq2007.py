@@ -1,0 +1,83 @@
+"""MQ2007 learning-to-rank reader (reference:
+python/paddle/dataset/mq2007.py — LETOR 4.0 query/document relevance with
+pointwise/pairwise/listwise generators). Synthetic query groups stand in
+when no cached data exists (zoo convention, dataset/common.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.dataset import common
+
+FEATURE_DIM = 46          # LETOR 4.0 feature vector width
+_N_QUERIES_TRAIN = 120
+_N_QUERIES_TEST = 30
+
+
+def _query_groups(split: str, n_queries: int, seed: int):
+    """Yield (labels [D], features [D, 46]) per query."""
+    data = common.cached_npz(f"mq2007_{split}")
+    if data is not None:
+        for labels, feats in zip(data["labels"], data["features"]):
+            yield np.asarray(labels), np.asarray(feats)
+        return
+    rng = np.random.RandomState(seed)
+    w = np.random.RandomState(7).rand(FEATURE_DIM)
+    for _ in range(n_queries):
+        ndocs = int(rng.randint(5, 20))
+        feats = rng.rand(ndocs, FEATURE_DIM).astype(np.float32)
+        scores = feats @ w + 0.3 * rng.rand(ndocs)
+        labels = np.digitize(scores, np.quantile(scores, [0.5, 0.8]))
+        yield labels.astype(np.float32), feats
+
+
+def gen_point(group):
+    """reference: mq2007.py:169 — (relevance, feature_vector) per doc."""
+    labels, feats = group
+    for lab, f in zip(labels, feats):
+        yield float(lab), np.asarray(f)
+
+
+def gen_pair(group, partial_order="full"):
+    """reference: mq2007.py:188 — ([1], better_doc, worse_doc) pairs."""
+    labels, feats = group
+    n = len(labels)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if labels[i] > labels[j]:
+                yield np.array([1]), np.asarray(feats[i]), np.asarray(feats[j])
+            elif labels[i] < labels[j]:
+                yield np.array([1]), np.asarray(feats[j]), np.asarray(feats[i])
+
+
+def gen_list(group):
+    """reference: mq2007.py:231 — whole ranked list per query."""
+    labels, feats = group
+    yield np.asarray(labels), np.asarray(feats)
+
+
+_GENS = {"pointwise": gen_point, "pairwise": gen_pair, "listwise": gen_list}
+
+
+def _reader(split, fmt, n_queries, seed):
+    gen = _GENS[fmt]
+
+    def reader():
+        for group in _query_groups(split, n_queries, seed):
+            yield from gen(group)
+    return reader
+
+
+def train(format="pairwise"):
+    """reference: mq2007.py train reader (format: pointwise / pairwise /
+    listwise)."""
+    return _reader("train", format, _N_QUERIES_TRAIN, 201)
+
+
+def test(format="pairwise"):
+    return _reader("test", format, _N_QUERIES_TEST, 202)
+
+
+def fetch():
+    """download hook; no egress here."""
+    return None

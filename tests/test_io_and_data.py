@@ -216,3 +216,25 @@ def test_async_checkpointer_roundtrip(tmp_path):
     assert got == 3
     np.testing.assert_allclose(
         np.asarray(global_scope().find_var(w_name)), snaps[3])
+
+
+def test_dataset_imikolov_and_mq2007():
+    """New zoo members (reference: python/paddle/dataset/imikolov.py,
+    mq2007.py): n-gram windows / SEQ pairs, and the three LTR formats."""
+    import numpy as np
+    from paddle_tpu import dataset
+
+    wd = dataset.imikolov.build_dict()
+    assert "<unk>" in wd and "<e>" in wd
+    gram = next(dataset.imikolov.train(wd, 5)())
+    assert len(gram) == 5 and all(0 <= w < len(wd) for w in gram)
+    seq_in, seq_out = next(dataset.imikolov.train(
+        wd, -1, dataset.imikolov.DataType.SEQ)())
+    assert seq_in[1:] == seq_out[:-1]
+
+    lab, left, right = next(dataset.mq2007.train(format="pairwise")())
+    assert lab.shape == (1,) and left.shape == (dataset.mq2007.FEATURE_DIM,)
+    rel, feat = next(dataset.mq2007.train(format="pointwise")())
+    assert feat.shape == (dataset.mq2007.FEATURE_DIM,)
+    labels, feats = next(dataset.mq2007.test(format="listwise")())
+    assert feats.shape == (len(labels), dataset.mq2007.FEATURE_DIM)
