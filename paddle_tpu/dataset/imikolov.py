@@ -36,11 +36,25 @@ def _sentences(split: str, n: int, seed: int):
         yield rng.choice(_VOCAB, size=length, p=probs).tolist()
 
 
+def _vocab_size():
+    """Vocabulary of whichever corpus _sentences will actually serve:
+    cached data determines its own vocab (max token id + 1); the
+    synthetic fallback uses _VOCAB. Keeps build_dict and the readers
+    consistent so embeddings sized from len(word_dict) never see
+    out-of-range ids."""
+    for split in ("train", "test"):
+        data = common.cached_npz(f"imikolov_{split}")
+        if data is not None:
+            return int(data["sents"].max()) + 1
+    return _VOCAB
+
+
 def build_dict(min_word_freq=50):
     """reference: imikolov.py:53 — word -> contiguous index, '<unk>' last.
-    The synthetic corpus is already integer-coded; the dict maps token ids
-    (as strings, mirroring the word->idx contract) plus '<unk>'/'<e>'."""
-    word_idx = {str(i): i for i in range(_VOCAB)}
+    The corpus is integer-coded; the dict maps token ids (as strings,
+    mirroring the word->idx contract) plus '<unk>'/'<e>' above them."""
+    vocab = _vocab_size()
+    word_idx = {str(i): i for i in range(vocab)}
     word_idx["<e>"] = len(word_idx)
     word_idx["<unk>"] = len(word_idx)
     return word_idx

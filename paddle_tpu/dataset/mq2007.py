@@ -39,15 +39,24 @@ def gen_point(group):
 
 
 def gen_pair(group, partial_order="full"):
-    """reference: mq2007.py:188 — ([1], better_doc, worse_doc) pairs."""
+    """reference: mq2007.py:188 — ([1], better_doc, worse_doc) pairs.
+    partial_order='full' emits every ordered combination; 'neighbour'
+    only adjacent items in relevance ranking (the reference's redundancy
+    reduction)."""
     labels, feats = group
+    order = np.argsort(-np.asarray(labels))      # best first
+    labels = np.asarray(labels)[order]
+    feats = np.asarray(feats)[order]
     n = len(labels)
-    for i in range(n):
-        for j in range(i + 1, n):
-            if labels[i] > labels[j]:
-                yield np.array([1]), np.asarray(feats[i]), np.asarray(feats[j])
-            elif labels[i] < labels[j]:
-                yield np.array([1]), np.asarray(feats[j]), np.asarray(feats[i])
+    if partial_order == "neighbour":
+        pairs = ((i, i + 1) for i in range(n - 1))
+    elif partial_order == "full":
+        pairs = ((i, j) for i in range(n) for j in range(i + 1, n))
+    else:
+        raise ValueError(f"unknown partial_order {partial_order!r}")
+    for i, j in pairs:
+        if labels[i] > labels[j]:
+            yield np.array([1]), np.asarray(feats[i]), np.asarray(feats[j])
 
 
 def gen_list(group):

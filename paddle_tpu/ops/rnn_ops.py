@@ -59,9 +59,10 @@ def _dynamic_lstm(ctx, ins, attrs):
     if x.dtype in (jnp.bfloat16, jnp.float16):
         # recurrent-scan boundary: per-step tensors are small and
         # latency-bound, so bf16 buys no bandwidth but adds per-step
-        # converts against the fp32 recurrent weight (measured 43% slower
-        # on the machine_translation GRU under pure-bf16 AMP) — upcast
-        # once at entry instead
+        # converts against the fp32 recurrent weight (machine_translation
+        # GRU: 650k words/s with this upcast vs 772k fully-conservative —
+        # see contrib/mixed_precision.py RECURRENT_OPS auto-select) —
+        # upcast once at entry
         x = x.astype(jnp.float32)
     B, T, H4 = x.shape
     H = H4 // 4
@@ -166,6 +167,21 @@ def _dynamic_gru(ctx, ins, attrs):
     h0 = first(ins, "H0")
     h = h0 if h0 is not None else jnp.zeros((B, H), dtype=x.dtype)
     xt_seq = jnp.swapaxes(x, 0, 1)
+
+    # Pallas tier (ops/pallas/fused_rnn.py): whole-sequence kernel with h
+    # resident in VMEM — plain cell only (default activations, no
+    # masking/reverse), hardware-aligned dims (same gating as
+    # _dynamic_lstm's fused path)
+    if (ctx.is_test and not is_reverse and seq_lens is None
+            and attrs.get("gate_activation", "sigmoid") == "sigmoid"
+            and attrs.get("activation", "tanh") == "tanh"):
+        from paddle_tpu.ops import pallas as pk
+        vmem_bytes = (H * 3 * H + 2 * B * 3 * H + 2 * B * H) * 4
+        if (pk.kernel_enabled(128, H) and B % 8 == 0
+                and vmem_bytes <= 8 * 1024 * 1024):
+            hid_tm = pk.fused_gru_sequence(xt_seq, w, h, False)
+            hidden = jnp.swapaxes(hid_tm, 0, 1)
+            return {"Hidden": [hidden], "LastHidden": [hidden[:, -1]]}
 
     def step(carry, xt_t):
         h_prev, t = carry

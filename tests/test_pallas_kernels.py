@@ -180,3 +180,26 @@ def test_flash_attention_blockwise_bwd_cross_len():
     for a, b_ in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_fused_gru_matches_dynamic_gru():
+    """GRU jit-tier parity (reference: operators/jit gru microkernels vs
+    math/gru_compute.cc refer)."""
+    from paddle_tpu.ops.pallas import fused_gru_sequence
+    from paddle_tpu.core.registry import get_op, EmitContext
+    t, b, hd = 5, 3, 4
+    xproj = _r(t, b, 3 * hd, scale=0.5)
+    w = _r(hd, 3 * hd, seed=1, scale=0.3)
+    h0 = np.zeros((b, hd), np.float32)
+    hid = fused_gru_sequence(jnp.asarray(xproj), jnp.asarray(w),
+                             jnp.asarray(h0), interpret=True)
+    ctx = EmitContext(base_key=jax.random.PRNGKey(0))
+    ref = get_op("dynamic_gru").emit(
+        ctx, {"Input": [jnp.asarray(xproj.transpose(1, 0, 2))],
+              "Weight": [jnp.asarray(w)]}, {})
+    np.testing.assert_allclose(np.asarray(hid).transpose(1, 0, 2),
+                               np.asarray(ref["Hidden"][0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hid)[-1],
+                               np.asarray(ref["LastHidden"][0]),
+                               rtol=1e-4, atol=1e-5)
