@@ -37,16 +37,21 @@ def _sentences(split: str, n: int, seed: int):
 
 
 def _vocab_size():
-    """Vocabulary of whichever corpus _sentences will actually serve:
-    cached data determines its own vocab (max token id + 1); the
-    synthetic fallback uses _VOCAB. Keeps build_dict and the readers
-    consistent so embeddings sized from len(word_dict) never see
-    out-of-range ids."""
+    """Vocabulary of whichever corpora _sentences will actually serve:
+    the max over every cached split's token ids, and _VOCAB whenever any
+    split falls back to synthetic — so embeddings sized from
+    len(word_dict) never see out-of-range ids from either reader."""
+    vocab = 0
+    any_missing = False
     for split in ("train", "test"):
         data = common.cached_npz(f"imikolov_{split}")
         if data is not None:
-            return int(data["sents"].max()) + 1
-    return _VOCAB
+            vocab = max(vocab, int(data["sents"].max()) + 1)
+        else:
+            any_missing = True
+    if any_missing:
+        vocab = max(vocab, _VOCAB)
+    return vocab
 
 
 def build_dict(min_word_freq=50):

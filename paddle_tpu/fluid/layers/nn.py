@@ -233,7 +233,15 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
-                               return_softmax=False):
+                               return_softmax=False, label_smoothing=0.0):
+    """label_smoothing (extension beyond the reference op): uniform-prior
+    smoothing folded into the loss in closed form — equivalent to
+    one_hot + label_smooth + soft_label CE but without materializing the
+    [N, V] one-hot (several full-width passes at large V)."""
+    if soft_label and label_smoothing:
+        raise ValueError(
+            "label_smoothing applies to hard integer labels; for soft "
+            "labels smooth the distribution yourself (layers.label_smooth)")
     helper = LayerHelper("softmax_with_cross_entropy")
     loss = helper.create_variable_for_type_inference(logits.dtype)
     softmax = helper.create_variable_for_type_inference(logits.dtype)
@@ -241,7 +249,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                      inputs={"Logits": [logits], "Label": [label]},
                      outputs={"Loss": [loss], "Softmax": [softmax]},
                      attrs={"soft_label": soft_label,
-                            "ignore_index": ignore_index})
+                            "ignore_index": ignore_index,
+                            "label_smoothing": float(label_smoothing)})
     if return_softmax:
         return loss, softmax
     return loss

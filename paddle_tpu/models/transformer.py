@@ -177,10 +177,11 @@ def build(is_train: bool = True, src_vocab: int = 32000,
     flat_logits = layers.reshape(logits, shape=[-1, tgt_vocab])
     flat_label = layers.reshape(lbl, shape=[-1, 1])
     if label_smooth_eps and is_train:
-        smooth = layers.label_smooth(
-            layers.one_hot(flat_label, tgt_vocab), epsilon=label_smooth_eps)
+        # closed-form smoothing inside the CE op (no [N, V] one-hot
+        # materialization — at V=32k the one_hot+label_smooth+soft CE
+        # chain cost several full-width HBM passes)
         loss_vec = layers.softmax_with_cross_entropy(
-            flat_logits, smooth, soft_label=True)
+            flat_logits, flat_label, label_smoothing=label_smooth_eps)
     else:
         loss_vec = layers.softmax_with_cross_entropy(flat_logits, flat_label)
     loss = layers.mean(loss_vec)

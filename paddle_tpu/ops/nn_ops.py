@@ -512,20 +512,45 @@ def _cross_entropy(ctx, ins, attrs):
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits = first(ins, "Logits")
     label = first(ins, "Label")
-    if logits.dtype in (jnp.bfloat16, jnp.float16):
-        # loss boundary: log-softmax needs fp32 (bf16 has ~3 decimal
-        # digits; exp/log cancellation destroys the loss signal)
-        logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    if attrs.get("soft_label", False):
-        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
-    else:
-        # rank-general: label [..., 1] (or [...]) indexes the last logits dim
-        lab = label.astype(jnp.int32).reshape(logp.shape[:-1] + (1,))
-        picked = jnp.take_along_axis(logp, lab, axis=-1)
-        loss = -picked
+    lowp = logits.dtype in (jnp.bfloat16, jnp.float16)
+    # uniform-prior label smoothing folded into the loss in closed form:
+    # with q = (1-eps)*onehot + eps/V,  -SUM q*logp
+    #   = lse - (1-eps)*picked - eps*mean(logits)
+    # — no [N, V] one_hot / label_smooth materialization (the graph-level
+    # one_hot+label_smooth+soft_label chain costs several full-width
+    # passes at V=32k)
+    eps = float(attrs.get("label_smoothing", 0.0))
+    if not attrs.get("soft_label", False):
+        # streaming form: an fp32 astype of the whole [N, V] logits would
+        # materialize it at full width (4 GB at bs512xT64xV32k); the
+        # convert+sub+exp chain instead fuses into the fp32-accumulating
+        # reduces, so HBM sees only the native-width reads. max is exact
+        # in bf16 (comparison, not arithmetic).
+        m = jax.lax.stop_gradient(
+            jnp.max(logits, axis=-1, keepdims=True).astype(jnp.float32))
+        sumexp = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m),
+                         axis=-1, keepdims=True)
+        lse = m + jnp.log(sumexp)                       # [..., 1] fp32
+        lab = label.astype(jnp.int32).reshape(logits.shape[:-1] + (1,))
+        picked = jnp.take_along_axis(logits, lab, axis=-1) \
+                    .astype(jnp.float32)
+        loss = lse - picked
+        if eps:
+            mean_logits = jnp.mean(logits.astype(jnp.float32),
+                                   axis=-1, keepdims=True)
+            loss = loss + eps * (picked - mean_logits)
         ignore = attrs.get("ignore_index", -100)
         loss = jnp.where(lab == ignore, 0.0, loss)
+        # native-dtype softmax output (DCE'd when unused)
+        softmax = jnp.exp(logits.astype(jnp.float32) - lse) \
+            .astype(logits.dtype)
+        return {"Loss": [loss], "Softmax": [softmax]}
+    if lowp:
+        # soft-label path: upcast (bf16 exp/log cancellation destroys the
+        # loss signal)
+        logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
 
 

@@ -217,3 +217,42 @@ def test_grad_sum_fanin():
     xv = np.ones((2, 2), np.float32)
     (gx,) = exe.run(main, feed={"x": xv}, fetch_list=[gmap["x"]])
     np.testing.assert_allclose(gx, np.full((2, 2), 5.0), rtol=1e-6)
+
+
+def test_softmax_ce_label_smoothing_closed_form():
+    """label_smoothing attr == explicit one_hot + uniform smoothing +
+    soft-label CE, in value AND gradient (the closed form replaces the
+    [N, V] one-hot materialization in the transformer loss)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op, EmitContext
+
+    ctx = EmitContext(base_key=jax.random.PRNGKey(0))
+    rng = np.random.RandomState(3)
+    V, eps = 17, 0.1
+    x = (rng.rand(5, V) * 4 - 2).astype(np.float32)
+    lab = rng.randint(0, V, (5, 1)).astype(np.int64)
+    onehot = np.eye(V, dtype=np.float32)[lab[:, 0]]
+    q = (1 - eps) * onehot + eps / V
+    spec = get_op("softmax_with_cross_entropy")
+
+    def closed(xx):
+        out = spec.emit(ctx, {"Logits": [xx], "Label": [jnp.asarray(lab)]},
+                        {"label_smoothing": eps})
+        return jnp.sum(out["Loss"][0])
+
+    def explicit(xx):
+        out = spec.emit(ctx, {"Logits": [xx], "Label": [jnp.asarray(q)]},
+                        {"soft_label": True})
+        return jnp.sum(out["Loss"][0])
+
+    xj = jnp.asarray(x)
+    np.testing.assert_allclose(float(closed(xj)), float(explicit(xj)),
+                               rtol=1e-6)
+    g1 = np.asarray(jax.grad(closed)(xj))
+    g2 = np.asarray(jax.grad(explicit)(xj))
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+    # analytic gradient: softmax - (1-eps)*onehot - eps/V
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    np.testing.assert_allclose(g1, p - q, rtol=1e-4, atol=1e-5)
