@@ -1,0 +1,136 @@
+"""Grad sweep 3: numeric-gradient coverage for differentiable ops no
+other suite names directly (reference OpTest files: test_activation_op.py
+for the activation grid, test_reduce_op.py max/min/prod,
+test_elementwise_min_op.py / _mod, test_squeeze/unsqueeze/transpose/
+reshape2, test_sequence_expand_as, test_fusion_seqconv_eltadd_relu,
+test_fused_embedding_fc_lstm, test_fusion_conv_inception)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(*shape, seed=0, lo=-0.9, hi=0.9):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+# -- activation grid (reference: test_activation_op.py one class per op) --
+@pytest.mark.parametrize("op,attrs,lo,hi", [
+    ("hard_sigmoid", {}, -0.8, 0.8),
+    ("leaky_relu", {"alpha": 0.1}, -0.9, 0.9),
+    ("logsigmoid", {}, -2.0, 2.0),
+    ("reciprocal", {}, 0.3, 1.5),        # away from the pole
+    ("relu6", {"threshold": 6.0}, -2.0, 5.0),
+    ("softsign", {}, -2.0, 2.0),
+    ("swish", {"beta": 1.0}, -2.0, 2.0),
+    ("tanh_shrink", {}, -2.0, 2.0),
+])
+def test_activation_grads(op, attrs, lo, hi):
+    x = _r(3, 7, lo=lo, hi=hi, seed=hash(op) % 1000)
+    # keep clear of the kink points where central differences lie
+    if op == "relu6":
+        x = x[(np.abs(x) > 1e-2) & (np.abs(x - 6.0) > 1e-2)].reshape(-1, 1)
+    if op in ("leaky_relu", "hard_sigmoid"):
+        x = np.where(np.abs(x) < 5e-2, 0.2, x)
+    check_grad(op, {"X": {"x": x}}, attrs=attrs)
+
+
+# -- reductions (reference: test_reduce_op.py) ---------------------------
+@pytest.mark.parametrize("op", ["reduce_max", "reduce_min", "reduce_prod"])
+def test_reduce_grads(op):
+    rng = np.random.RandomState(5)
+    # distinct magnitudes so max/min choices are stable under the delta
+    x = (rng.permutation(24).reshape(4, 6).astype(np.float32) + 1.0) * 0.1
+    check_grad(op, {"X": {"x": x}}, attrs={"dim": [1]})
+
+
+def test_elementwise_min_grad():
+    x = _r(4, 5, seed=1)
+    y = _r(4, 5, seed=2)
+    # separate the operands so min() choices are stable
+    y = np.where(np.abs(x - y) < 5e-2, y + 0.2, y)
+    check_grad("elementwise_min", {"X": {"x": x}, "Y": {"y": y}})
+
+
+def test_elementwise_mod_int():
+    x = np.array([[7, -7, 5], [9, 4, 11]], np.int64)
+    y = np.array([[3, 3, 4], [4, 5, 4]], np.int64)
+    out = run_single_op("elementwise_mod", {"X": {"x": x}, "Y": {"y": y}})
+    np.testing.assert_array_equal(out["__out_Out_0"], x % y)
+
+
+# -- shape ops (reference: test_squeeze_op.py etc.; grads are reshapes) --
+def test_shape_op_grads():
+    x = _r(2, 1, 3, seed=3)
+    check_grad("squeeze2", {"X": {"x": x}}, attrs={"axes": [1]})
+    check_grad("unsqueeze", {"X": {"x": _r(2, 3, seed=4)}},
+               attrs={"axes": [1]})
+    check_grad("unsqueeze2", {"X": {"x": _r(2, 3, seed=5)}},
+               attrs={"axes": [0]})
+    check_grad("transpose2", {"X": {"x": _r(2, 3, 4, seed=6)}},
+               attrs={"axis": [2, 0, 1]})
+    check_grad("reshape2", {"X": {"x": _r(2, 6, seed=7)}},
+               attrs={"shape": [3, 4]})
+
+
+def test_sequence_expand_as_grad():
+    x = _r(3, 4, seed=8)
+    y = _r(3, 5, 2, seed=9)              # provides the target time extent
+    lens = np.array([2, 5, 1], np.int32)
+    check_grad("sequence_expand_as",
+               {"X": {"x": x}, "Y": {"y": y}, "SeqLens": {"l": lens}})
+
+
+# -- fused ops (reference: operators/fused/) -----------------------------
+def test_fusion_seqconv_eltadd_relu_grad():
+    x = _r(2, 5, 3, seed=9, lo=0.1, hi=0.9)   # positive: relu-smooth
+    f = _r(9, 4, seed=10, lo=0.05, hi=0.5)
+    b = _r(4, seed=11, lo=0.3, hi=0.8)
+    lens = np.array([5, 4], np.int32)
+    check_grad("fusion_seqconv_eltadd_relu",
+               {"X": {"x": x}, "Filter": {"f": f}, "Bias": {"b": b},
+                "SeqLens": {"l": lens}},
+               attrs={"contextLength": 3, "contextStart": -1})
+
+
+def test_conv2d_inception_fusion_forward():
+    """Four 1x1 branches vs hand-built conv+relu+concat."""
+    x = _r(2, 3, 5, 5, seed=12)
+    ws = [_r(2, 3, 1, 1, seed=13 + i, lo=-0.5, hi=0.5) for i in range(4)]
+    bs = [_r(2, seed=20 + i, lo=-0.2, hi=0.2) for i in range(4)]
+    out = run_single_op(
+        "conv2d_inception_fusion",
+        {"Input": {"x": x},
+         "Filter": {f"w{i}": ws[i] for i in range(4)},
+         "Bias": {f"b{i}": bs[i] for i in range(4)}},
+        out_slots=("Output",))["__out_Output_0"]
+    expect = []
+    for w, b in zip(ws, bs):
+        o = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0]) \
+            + b.reshape(1, -1, 1, 1)
+        expect.append(np.maximum(o, 0.0))
+    np.testing.assert_allclose(out, np.concatenate(expect, axis=1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_embedding_fc_lstm_matches_compose():
+    """fused op == embedding-projected input through dynamic_lstm."""
+    V, D, B, T = 11, 4, 2, 3
+    table = _r(V, 4 * D, seed=30, lo=-0.3, hi=0.3)
+    ids = np.random.RandomState(31).randint(0, V, (B, T, 1)).astype(np.int64)
+    wh = _r(D, 4 * D, seed=32, lo=-0.3, hi=0.3)
+    out = run_single_op(
+        "fused_embedding_fc_lstm",
+        {"Embeddings": {"e": table}, "Ids": {"i": ids},
+         "WeightH": {"w": wh}},
+        out_slots=("Hidden", "Cell"))
+    proj = table[ids[..., 0]]
+    ref = run_single_op(
+        "dynamic_lstm", {"Input": {"x": proj}, "Weight": {"w": wh}},
+        out_slots=("Hidden", "Cell"))
+    np.testing.assert_allclose(out["__out_Hidden_0"],
+                               ref["__out_Hidden_0"], rtol=1e-5)
+    np.testing.assert_allclose(out["__out_Cell_0"],
+                               ref["__out_Cell_0"], rtol=1e-5)
