@@ -1,10 +1,14 @@
 """Reader composition toolkit (reference: python/paddle/reader/decorator.py
 — map_readers, shuffle, batch, compose, chain, buffered, xmap_readers,
-cache, firstn)."""
+cache, firstn) plus the TPU-first variable-length utilities
+bucket_by_length / pad_batch (bounded feed-shape signatures — see
+docs/performance.md)."""
 
-from paddle_tpu.reader.decorator import (batch, buffered, cache, chain,
-                                         compose, firstn, map_readers,
-                                         shuffle, xmap_readers)
+from paddle_tpu.reader.decorator import (batch, bucket_by_length, buffered,
+                                         cache, chain, compose, firstn,
+                                         map_readers, pad_batch, shuffle,
+                                         xmap_readers)
 
-__all__ = ["batch", "buffered", "cache", "chain", "compose", "firstn",
-           "map_readers", "shuffle", "xmap_readers"]
+__all__ = ["batch", "bucket_by_length", "buffered", "cache", "chain",
+           "compose", "firstn", "map_readers", "pad_batch", "shuffle",
+           "xmap_readers"]

@@ -162,3 +162,54 @@ def firstn(reader, n: int):
     def firstn_reader():
         yield from itertools.islice(reader(), n)
     return firstn_reader
+
+
+def bucket_by_length(reader, len_fn: Callable, bucket_bounds: List[int],
+                    batch_size: int, drop_last: bool = False):
+    """Group samples into per-bucket batches by length (TPU-first utility
+    completing the LoD redesign, SURVEY hard-part: XLA compiles one
+    executable per feed-shape signature, so free-length batches cause a
+    recompile storm; bucketing bounds the signature set to
+    len(bucket_bounds) shapes — pad each batch to its bucket bound with
+    `pad_batch` below or your own collate).
+
+    len_fn(sample) -> int; bucket_bounds ascending (e.g. [16, 32, 64,
+    128]). Samples longer than the last bound go to the last bucket
+    (caller truncates or the pad helper raises). Yields (bound, [samples])
+    batches as each bucket fills; tail batches flush at the end unless
+    drop_last."""
+    bounds = sorted(bucket_bounds)
+
+    def bucketed():
+        pools = {b: [] for b in bounds}
+        for sample in reader():
+            n = len_fn(sample)
+            bound = next((b for b in bounds if n <= b), bounds[-1])
+            pools[bound].append(sample)
+            if len(pools[bound]) == batch_size:
+                yield bound, pools[bound]
+                pools[bound] = []
+        if not drop_last:
+            for b in bounds:
+                if pools[b]:
+                    yield b, pools[b]
+    return bucketed
+
+
+def pad_batch(samples, length: int, pad_value=0):
+    """Collate variable-length samples (time on their FIRST axis) to
+    `[len(samples), length, ...]` + SeqLens — the feed pair the sequence
+    ops consume (ops/sequence_ops.py: padded [B, T, ...] + SeqLens
+    replaces LoD)."""
+    import numpy as np
+    lens = np.asarray([np.shape(s)[0] for s in samples], np.int32)
+    if lens.max() > length:
+        raise ValueError(f"sample length {int(lens.max())} exceeds the "
+                         f"bucket bound {length}; truncate upstream")
+    first = np.asarray(samples[0])
+    out_shape = (len(samples), length) + first.shape[1:]
+    out = np.full(out_shape, pad_value, dtype=first.dtype)
+    for i, s in enumerate(samples):
+        s = np.asarray(s)
+        out[i, :s.shape[0]] = s
+    return out, lens

@@ -28,7 +28,7 @@ def _r(*shape, seed=0, lo=-0.9, hi=0.9):
     ("tanh_shrink", {}, -2.0, 2.0),
 ])
 def test_activation_grads(op, attrs, lo, hi):
-    x = _r(3, 7, lo=lo, hi=hi, seed=hash(op) % 1000)
+    x = _r(3, 7, lo=lo, hi=hi, seed=sum(map(ord, op)) % 1000)
     # keep clear of the kink points where central differences lie
     if op == "relu6":
         x = x[(np.abs(x) > 1e-2) & (np.abs(x - 6.0) > 1e-2)].reshape(-1, 1)

@@ -238,3 +238,43 @@ def test_dataset_imikolov_and_mq2007():
     assert feat.shape == (dataset.mq2007.FEATURE_DIM,)
     labels, feats = next(dataset.mq2007.test(format="listwise")())
     assert feats.shape == (len(labels), dataset.mq2007.FEATURE_DIM)
+
+
+def test_bucket_by_length_and_pad():
+    """Bucketing bounds the feed-shape signature set (compile-cache
+    management, SURVEY hard-part 6); pad_batch produces the padded+SeqLens
+    pair the sequence ops consume."""
+    import numpy as np
+    from paddle_tpu import reader as rdr
+
+    rng = np.random.RandomState(0)
+    samples = [np.arange(n, dtype=np.float32)
+               for n in rng.randint(1, 50, 200)]
+
+    def src():
+        return iter(samples)
+
+    seen = 0
+    shapes = set()
+    for bound, batch in rdr.bucket_by_length(
+            src, len, [8, 16, 32, 64], batch_size=16)():
+        assert all(len(s) <= bound for s in batch)
+        padded, lens = rdr.pad_batch(batch, bound)
+        assert padded.shape == (len(batch), bound)
+        np.testing.assert_array_equal(lens,
+                                      [len(s) for s in batch])
+        # padding is zero beyond each row's length
+        for row, n in zip(padded, lens):
+            assert (row[n:] == 0).all()
+        shapes.add(bound)
+        seen += len(batch)
+    assert seen == len(samples)          # nothing dropped
+    assert shapes <= {8, 16, 32, 64}
+
+    # drop_last drops only the partial tails
+    kept = sum(len(b) for _, b in rdr.bucket_by_length(
+        src, len, [8, 16, 32, 64], batch_size=16, drop_last=True)())
+    assert kept % 16 == 0 and kept <= len(samples)
+
+    with np.testing.assert_raises(ValueError):
+        rdr.pad_batch([np.arange(10)], 8)
