@@ -1,0 +1,42 @@
+"""Recompute / gradient-checkpointing rewrite (TPU-first addition; the
+reference era's closest capability is the gradient-accumulation
+multi_batch_merge_pass — ir/multi_batch_merge_pass.cc — which trades
+throughput for memory at the batch level. Here the trade is per op:
+`jax.checkpoint` on tagged ops makes the backward re-run their forward
+instead of keeping their internals as residuals, so e.g. attention
+probability matrices [B, H, T, T] or wide FFN activations never persist
+between the forward and backward passes — the standard long-context
+memory lever on TPU).
+
+Attr-only, like contrib.mixed_precision / contrib.layout: tagging sets
+`__remat__` on forward ops AND their `__vjp__` snapshots; the `__vjp__`
+emitter (ops/grad_ops.py) wraps the re-traced forward in jax.checkpoint.
+"""
+
+from __future__ import annotations
+
+# memory-heavy ops whose internals dominate activation footprints
+# ("attention" is the fused scaled_dot_product_attention op)
+DEFAULT_REMAT_OPS = ("attention", "softmax", "matmul", "fc", "mul")
+
+
+def rewrite_program_recompute(program=None, op_types=DEFAULT_REMAT_OPS):
+    """Tag `op_types` for backward rematerialization. Apply after
+    minimize() (the `__vjp__` snapshots must exist) or before (forward
+    tags propagate when backward is appended later). Returns #ops
+    tagged."""
+    from paddle_tpu.fluid import framework
+    program = program or framework.default_main_program()
+    n = 0
+    for block in program.desc.blocks:
+        for op in block.ops:
+            if op.type in op_types:
+                op.attrs["__remat__"] = True
+                n += 1
+            elif op.type == "__vjp__":
+                fwd = op.attrs.get("fwd_op", {})
+                if fwd.get("type") in op_types:
+                    fwd.setdefault("attrs", {})["__remat__"] = True
+                    n += 1
+    program.desc.bump_version()
+    return n

@@ -78,6 +78,13 @@ def _vjp_emit(ctx: EmitContext, ins, attrs):
         outs = forward_flat(diff_vals)
         return tuple(outs[k] for k in float_out)
 
+    if fwd_op.attrs.get("__remat__"):
+        # contrib.recompute: save only this op's INPUTS as residuals and
+        # re-run the forward inside the backward (jax.checkpoint) — trades
+        # FLOPs for activation memory (e.g. attention probs [B,H,T,T]
+        # never persist between fwd and bwd)
+        forward_float_only = jax.checkpoint(forward_float_only)
+
     primals, vjp_fn = jax.vjp(forward_float_only,
                               tuple(flat_in[i] for i in diff_idx))
     ograds = ins.get("OutGrad", [])

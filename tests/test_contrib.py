@@ -388,3 +388,56 @@ def test_nhwc_layout_concat_channel_axis():
 
     a, b = run_once(False), run_once(True)
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_recompute_rewrite_gradient_parity():
+    """contrib.recompute: tagged ops' backward re-runs their forward
+    (jax.checkpoint in the __vjp__ re-trace) — one full train step is
+    bit-identical with and without the rewrite; the memory effect is
+    checkpoint's contract (residuals = op inputs only)."""
+    import numpy as np
+    from paddle_tpu.contrib.recompute import rewrite_program_recompute
+
+    def build(remat):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 21
+        startup.random_seed = 21
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[64, 32], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="int64")
+            q = layers.fc(x, size=32, num_flatten_dims=2)
+            k = layers.fc(x, size=32, num_flatten_dims=2)
+            v = layers.fc(x, size=32, num_flatten_dims=2)
+            # [B, T, D] -> [B, 1, T, D] single-head for the fused op
+            att = layers.scaled_dot_product_attention(
+                layers.unsqueeze(q, axes=[1]),
+                layers.unsqueeze(k, axes=[1]),
+                layers.unsqueeze(v, axes=[1]))
+            pooled = layers.reduce_mean(layers.squeeze(att, axes=[1]),
+                                        dim=1)
+            logits = layers.fc(pooled, size=4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            if remat:
+                n = rewrite_program_recompute(main,
+                                              op_types=("attention",))
+                assert n >= 2          # fwd op + vjp snapshot
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(2)
+            feeds = {"x": rng.rand(2, 64, 32).astype(np.float32),
+                     "y": rng.randint(0, 4, (2, 1)).astype(np.int64)}
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss],
+                          scope=scope)
+            wname = next(op.inputs["Y"][0]
+                         for op in main.desc.global_block.ops
+                         if op.type == "mul")     # layers.fc weight
+            w = np.asarray(scope.find_var(wname))
+        return float(np.asarray(lv).reshape(())), w
+
+    l0, w0 = build(False)
+    l1, w1 = build(True)
+    assert l0 == l1
+    np.testing.assert_array_equal(w0, w1)
