@@ -89,15 +89,20 @@ def rewrite_program_nhwc(program=None):
             return changed
         return False
 
-    changed = True
-    while changed:
-        changed = False
-        for op in ops:
+    def run_fixpoint():
+        changed = True
+        while changed:
+            changed = False
+            for op in ops:
+                changed |= constrain_op(op)
+
+    def constrain_op(op):
+            changed = False
             t = op.type
             if t in CONVERT_SLOTS or t == "__vjp__":
                 # convertible ops accept either layout on their data slot;
                 # __vjp__ mirrors its forward op's tags
-                continue
+                return False
             ins = [n for names in op.inputs.values() for n in names]
             outs = [n for names in op.outputs.values() for n in names]
             if t in AGNOSTIC:
@@ -144,6 +149,27 @@ def rewrite_program_nhwc(program=None):
                     if nhwc.get(n):
                         nhwc[n] = False
                         changed = True
+            return changed
+
+    run_fixpoint()
+    # Gradient vars' PHYSICAL layout is dictated by the __vjp__ re-trace:
+    # cotangents mirror the forward var's layout (jax.vjp). If the
+    # fixpoint concluded a grad var must be NCHW (some unconvertible
+    # non-__vjp__ op consumes it) while its forward var is NHWC-resident,
+    # the layouts would disagree — falsify the FORWARD var and re-run
+    # until consistent (round-1 advisor finding: the old code
+    # unconditionally overrode the grad's residency with the forward's).
+    while True:
+        conflicted = False
+        for n in list(nhwc):
+            if "@GRAD" in n and not nhwc[n]:
+                fwd = n.split("@GRAD")[0]
+                if nhwc.get(fwd):
+                    nhwc[fwd] = False
+                    conflicted = True
+        if not conflicted:
+            break
+        run_fixpoint()
 
     # --- tagging ---
     tags = {}                       # fwd op index -> attr dict

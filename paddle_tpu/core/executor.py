@@ -97,14 +97,37 @@ class Executor:
             fetch_list: Optional[List[Any]] = None,
             feed_var_name: str = "feed", fetch_var_name: str = "fetch",
             scope: Optional[Scope] = None, return_numpy: bool = True,
-            use_program_cache: bool = True):
-        """reference: executor.py:447 — same signature contract."""
+            use_program_cache: bool = True, iterations: int = 1):
+        """reference: executor.py:447 — same signature contract.
+
+        iterations > 1 runs that many steps in ONE device-side loop
+        (lax.scan over donated state) — the amortized analogue of the
+        reference's C++ interpreter hot loop (executor.cc:448), which on
+        TPU removes the per-dispatch host/tunnel cost that otherwise
+        scales with the number of parameter buffers. `feed` is either one
+        batch dict (resident batch reused each step) or a list of
+        `iterations` batch dicts (stacked and scanned). Fetches come back
+        stacked with a leading [iterations] axis."""
         if program is None:
             from paddle_tpu.fluid import framework as fw
             program = fw.default_main_program()
         scope = scope or global_scope()
-        feed = feed or {}
         fetch_list = fetch_list or []
+
+        stacked = isinstance(feed, (list, tuple))
+        if stacked:
+            if len(feed) != iterations:
+                raise ValueError(
+                    f"feed list has {len(feed)} batches but iterations="
+                    f"{iterations}")
+            if iterations == 1:
+                # single-step with a 1-element feed list: unwrap, no
+                # stacking (the single-step executable takes plain batches)
+                feed, stacked = feed[0], False
+            else:
+                feed = {n: np.stack([np.asarray(b[n]) for b in feed])
+                        for n in feed[0]}
+        feed = feed or {}
 
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
         feed_names = sorted(feed)
@@ -115,6 +138,10 @@ class Executor:
         feeds = {}
         dist_mode = cb.dist is not None and cb.dist.mesh is not None
         multi_host = dist_mode and jax.process_count() > 1
+        if stacked and multi_host:
+            raise NotImplementedError(
+                "iterations>1 with a list of feeds is single-host only; "
+                "pre-shard stacked global arrays on the producer side")
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
@@ -147,7 +174,8 @@ class Executor:
                 # single-device array doesn't clash with in_shardings
                 if want is not None and str(val.dtype) != want:
                     val = val.astype(want)
-                sh = cb.feed_sharding(name) if dist_mode else None
+                sh = (cb.feed_sharding(name)
+                      if dist_mode and not stacked else None)
                 if sh is not None:
                     val = jax.device_put(val, sh)
                 feeds[name] = val
@@ -172,8 +200,14 @@ class Executor:
             else:
                 feeds[name] = jax.device_put(arr, self.device)
 
-        self._step += 1
-        outs = cb(scope, feeds, self._step)
+        if iterations > 1:
+            seed0 = self._step + 1
+            self._step += iterations
+            outs = cb.run_steps(scope, feeds, seed0, iterations,
+                                stacked=stacked)
+        else:
+            self._step += 1
+            outs = cb(scope, feeds, self._step)
         if _check_nan_inf_enabled():
             # FLAGS_check_nan_inf capability (reference: operator.cc:978-990
             # scans every op output per step). Here outputs are fused, so

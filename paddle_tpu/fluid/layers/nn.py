@@ -551,11 +551,14 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
 
 def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
                                  scale=None, sp="auto", sp_impl="ring",
-                                 name=None):
+                                 dropout_prob=0.0, name=None):
     """Fused attention over [B, H, T, D] tensors (TPU-native extension —
     the reference composes matmul+softmax+matmul; see ops.attention). With
     a mesh sp axis configured, computes ring attention / Ulysses over the
-    sequence shards (parallel/ring_attention.py)."""
+    sequence shards (parallel/ring_attention.py). dropout_prob applies
+    attention-weight dropout (upscale_in_train — the reference's composed
+    graph, dist_transformer.py:1044) inside the fused/flash kernels;
+    disabled automatically in test-mode programs."""
     helper = LayerHelper("attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     ins = {"Q": [q], "K": [k], "V": [v]}
@@ -563,7 +566,8 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
         ins["Bias"] = [bias]
     helper.append_op("attention", inputs=ins, outputs={"Out": [out]},
                      attrs={"causal": causal, "scale": scale, "sp": sp,
-                            "sp_impl": sp_impl})
+                            "sp_impl": sp_impl,
+                            "dropout_prob": float(dropout_prob)})
     return out
 
 

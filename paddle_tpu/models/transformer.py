@@ -57,16 +57,12 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     if fused:
         # fused (and, with an sp mesh axis, ring/Ulysses sequence-parallel)
-        # attention. NOTE semantics change: attention-WEIGHT dropout does
-        # not exist in this path (the [Tq, Tk] probability matrix is never
-        # materialized); regularization differs from the unfused graph.
-        if dropout:
-            import warnings
-            warnings.warn(
-                "fused attention drops attention-weight dropout "
-                f"(dropout={dropout}); residual/ffn dropout still applies",
-                stacklevel=2)
-        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal)
+        # attention; attention-weight dropout runs INSIDE the fused/flash
+        # kernels (hash-derived keep mask regenerated in the backward —
+        # ops/pallas/flash_attention.py), matching the unfused graph's
+        # softmax→dropout→matmul semantics in expectation
+        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal,
+                                                  dropout_prob=dropout)
     else:
         q = layers.scale(q, scale=d_k ** -0.5)
         logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]

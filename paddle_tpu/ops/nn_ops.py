@@ -290,7 +290,11 @@ def _bn_train_lowp_bwd(eps, caxis, res, cts):
     m1 = (sum_dy / n).astype(xdt).reshape(bshape)
     m2 = (sum_dy_xhat / n).astype(xdt).reshape(bshape)
     dx = k * (dyl - m1 - xhat * m2)
-    return dx, sum_dy_xhat, sum_dy   # dscale = Σdy·x̂, dbias = Σdy
+    # cotangents must match the primal dtypes: scale/bias may themselves
+    # be bf16 (e.g. a BF16Transpiler-converted program in train mode) and
+    # custom_vjp rejects fp32 cotangents for bf16 primals
+    return (dx, sum_dy_xhat.astype(scale.dtype),
+            sum_dy.astype(scale.dtype))   # dscale = Σdy·x̂, dbias = Σdy
 
 
 _bn_train_lowp.defvjp(_bn_train_lowp_fwd, _bn_train_lowp_bwd)
@@ -671,6 +675,17 @@ def _attention(ctx, ins, attrs):
     bias = first(ins, "Bias")
     causal = bool(attrs.get("causal", False))
     scale = attrs.get("scale") or float(q.shape[-1]) ** -0.5
+    # attention-weight dropout (upscale_in_train, matching the composed
+    # softmax→dropout→matmul graph — reference dist_transformer.py:1044);
+    # the keep mask derives from a per-step int32 seed so the flash
+    # kernels regenerate it in their backward (ops/pallas/flash_attention)
+    dropout_p = float(attrs.get("dropout_prob") or 0.0)
+    if ctx.is_test or attrs.get("is_test"):
+        dropout_p = 0.0
+    seed = None
+    if dropout_p > 0:
+        seed = jax.random.randint(ctx.step_key(), (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
 
     sp = attrs.get("sp", "auto")
     mesh = ctx.mesh
@@ -691,8 +706,9 @@ def _attention(ctx, ins, attrs):
                               batch_axis=getattr(ctx.dist, "data_axis",
                                                  None),
                               head_axis=getattr(ctx.dist, "model_axis",
-                                                None))
+                                                None),
+                              dropout_p=dropout_p, seed=seed)
     else:
         out = ra.full_attention(q, k, v, causal=causal, scale=scale,
-                                bias=bias)
+                                bias=bias, dropout_p=dropout_p, seed=seed)
     return single(out)

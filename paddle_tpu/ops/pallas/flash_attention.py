@@ -47,13 +47,57 @@ def _masked_scores(q, k, causal, qb, j, bq, bk, q_off):
     return s
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, bq, bk, nk, causal, scale, q_off):
+def hash_keep_mask(seed, bh, qpos, kpos, dropout_p):
+    """Attention-weight dropout keep mask, upscale_in_train convention:
+    keep/(1-p) as float32. Counter-based: a murmur3-finalizer mix of
+    (seed, batch*head index, query position, key position) in uint32
+    arithmetic — pure jnp, so the SAME function runs inside the Pallas
+    kernels (TPU and interpret mode both) and in the jnp fallback paths,
+    and the backward kernels regenerate the forward's mask bit-exactly
+    from the same coordinates (reference semantics: dropout on the
+    softmax weights, dist_transformer.py:1044)."""
+    x = (qpos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         ^ kpos.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    x = x ^ (jnp.asarray(seed).astype(jnp.uint32)
+             + jnp.asarray(bh).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(int(dropout_p * 2.0 ** 32), 2 ** 32 - 1))
+    keep = (x >= thresh).astype(jnp.float32)
+    return keep * (1.0 / (1.0 - dropout_p))
+
+
+def _block_keep_mask(seed, bh, qb, j, bq, bk, q_off, dropout_p):
+    """hash_keep_mask over one [bq, bk] tile — coordinates derived exactly
+    like the causal mask in _masked_scores, so fwd/dq/dkv agree."""
+    qpos = (q_off + qb * bq +
+            jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return hash_keep_mask(seed, bh, qpos, kpos, dropout_p)
+
+
+def _fwd_kernel(*args, bq, bk, nk, causal, scale, q_off, dropout_p):
     """Grid (BH, Tq/bq, Tk/bk): the innermost k dimension streams [bk, D]
     key/value tiles from HBM while (m, l, acc) persist in VMEM scratch —
     TPU grid steps run sequentially, so the scratch carries the online-
     softmax state across k blocks; VMEM use is O(bq*d + bk*d), independent
-    of sequence length."""
+    of sequence length.
+
+    dropout_p > 0 applies attention-weight dropout (upscale_in_train):
+    the keep mask multiplies the numerator accumulator only — the
+    denominator stays the full softmax sum, matching the composed
+    softmax→dropout→matmul graph the reference trains
+    (dist_transformer.py:1044). The seed rides scalar prefetch."""
+    if dropout_p > 0:
+        seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = args
+    else:
+        seed_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = args
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -79,8 +123,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         alpha = jnp.exp(m - m_new)
         m_scr[:] = m_new
         l_scr[:] = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = p
+        if dropout_p > 0:
+            pv = p * _block_keep_mask(seed_ref[0], bh, qb, j, bq, bk,
+                                      q_off, dropout_p)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            pv, v, preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -89,8 +137,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m_scr[:] + jnp.log(safe_l)           # [BQ, 1]
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
+def _grid_spec(grid, in_specs, out_specs, scratch_shapes, seed):
+    """pallas_call kwargs: plain grid without dropout, scalar-prefetch
+    grid (seed in SMEM, index maps gain the leading scalar ref) with."""
     from jax.experimental.pallas import tpu as pltpu
+    if seed is None:
+        return dict(grid=grid, in_specs=in_specs, out_specs=out_specs,
+                    scratch_shapes=scratch_shapes)
+
+    def lift(spec):
+        im = spec.index_map
+
+        def index_map(*args):
+            # with num_scalar_prefetch=1 the scalar ref arrives as the
+            # TRAILING argument after the grid indices — drop it
+            return im(*args[:-1])
+        return pl.BlockSpec(spec.block_shape, index_map)
+
+    in_specs = [lift(s) for s in in_specs]
+    out_specs = (lift(out_specs) if isinstance(out_specs, pl.BlockSpec)
+                 else [lift(s) for s in out_specs])
+    return dict(grid_spec=pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+        out_specs=out_specs, scratch_shapes=scratch_shapes))
+
+
+def _seed_args(seed):
+    if seed is None:
+        return ()
+    return (jnp.asarray(seed, jnp.int32).reshape(1),)
+
+
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret,
+               dropout_p=0.0, seed=None):
+    from jax.experimental.pallas import tpu as pltpu
+    if dropout_p <= 0:
+        seed = None
     b, h, tq, d = q.shape
     tk = k.shape[2]
     q4 = q.reshape(b * h, tq, d)
@@ -99,30 +181,33 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
     nk = tk // bk
     grid = (b * h, tq // bq, nk)
     kern = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk, causal=causal,
-                             scale=scale, q_off=tk - tq)
+                             scale=scale, q_off=tk - tq,
+                             dropout_p=dropout_p if seed is not None else 0.0)
     out, lse = pl.pallas_call(
         kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
-        ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
-        ],
         interpret=interpret,
-    )(q4, k4, v4)
+        **_grid_spec(
+            grid,
+            [
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            ],
+            [
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+            ],
+            [
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+            seed),
+    )(*_seed_args(seed), q4, k4, v4)
     return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
 
 
@@ -134,34 +219,49 @@ def pick_blocks(tq, tk):
     return bq, bk
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, scale=None, bq=128, bk=128,
-                    interpret=False):
-    """q [B,H,Tq,D], k/v [B,H,Tk,D] → [B,H,Tq,D]. Tq % bq == Tk % bk == 0."""
+                    interpret=False, dropout_p=0.0, seed=None):
+    """q [B,H,Tq,D], k/v [B,H,Tk,D] → [B,H,Tq,D]. Tq % bq == Tk % bk == 0.
+    dropout_p applies attention-weight dropout (upscale_in_train) with a
+    keep mask derived from `seed` (int32 scalar, traced ok) + tile
+    coordinates — identical in fwd and bwd kernels."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    out, _ = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret,
+                        dropout_p, seed)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
+def _vjp_fwd(q, k, v, causal, scale, bq, bk, interpret, dropout_p, seed):
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret,
+                          dropout_p, seed)
+    return out, (q, k, v, out, lse, seed)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
-               bq, bk, nk, causal, scale, q_off, has_glse):
+def _dq_kernel(*args, bq, bk, nk, causal, scale, q_off, has_glse,
+               dropout_p):
     """Grid (BH, Tq/bq, Tk/bk): accumulate dQ for one q block across k
-    blocks; ds = p * (dO·Vᵀ − delta + dLSE) — the dLSE term carries the
-    cotangent of the exposed log-sum-exp (∂lse/∂s_ij = p_ij), used by
-    ring attention's block-merge; zero for plain attention."""
+    blocks; ds = p * (mask·(dO·Vᵀ) − delta + dLSE) — the dLSE term carries
+    the cotangent of the exposed log-sum-exp (∂lse/∂s_ij = p_ij), used by
+    ring attention's block-merge; zero for plain attention. The dropout
+    keep mask regenerates bit-exactly from the tile coordinates (only the
+    dp term is masked: out = Σ_k w_k·m_k·v_k gives ds_j = w_j(m_j·dp_j −
+    g·out), and delta = g·out already absorbs the mask)."""
+    if dropout_p > 0:
+        seed_ref, *args = args
+    else:
+        seed_ref = None
     if has_glse:
-        glse_ref, dq_ref, dq_scr = refs
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            glse_ref, dq_ref, dq_scr = args
     else:
         glse_ref = None
-        dq_ref, dq_scr = refs
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            dq_ref, dq_scr = args
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -181,6 +281,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
         p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0:
+            dp = dp * _block_keep_mask(seed_ref[0], bh, qb, j, bq, bk,
+                                       q_off, dropout_p)
         corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
         ds = p * (dp - corr)
         dq_scr[:] = dq_scr[:] + jnp.dot(
@@ -191,15 +294,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
-                bq, bk, nq, causal, scale, q_off, has_glse):
+def _dkv_kernel(*args, bq, bk, nq, causal, scale, q_off, has_glse,
+                dropout_p):
     """Grid (BH, Tk/bk, Tq/bq): accumulate dK/dV for one k block across q
-    blocks; dV = pᵀ·dO, dK = scale · dsᵀ·Q."""
+    blocks; dV = (p·mask)ᵀ·dO, dK = scale · dsᵀ·Q."""
+    if dropout_p > 0:
+        seed_ref, *args = args
+    else:
+        seed_ref = None
     if has_glse:
-        glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            glse_ref, dk_ref, dv_ref, dk_scr, dv_scr = args
     else:
         glse_ref = None
-        dk_ref, dv_ref, dk_scr, dv_scr = refs
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = args
+    bh = pl.program_id(0)
     kb = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -219,11 +329,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
         g = g_ref[0].astype(jnp.float32)
         s = _masked_scores(q, k, causal, i, kb, bq, bk, q_off)
         p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
+        pm = p
+        if dropout_p > 0:
+            mask = _block_keep_mask(seed_ref[0], bh, i, kb, bq, bk,
+                                    q_off, dropout_p)
+            pm = p * mask
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # pᵀ·dO [BK, D]
+            pm, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (p·m)ᵀ·dO [BK, D]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0:
+            dp = dp * mask
         corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
         ds = p * (dp - corr)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
@@ -236,9 +353,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *refs,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse):
+def _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse,
+                    dropout_p=0.0, seed=None):
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, o, lse = res
+    if dropout_p <= 0:
+        seed = None
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     b, h, tq, d = q.shape
@@ -255,87 +375,112 @@ def _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse):
     glse4 = (glse.astype(jnp.float32).reshape(b * h, tq, 1)
              if has_glse else None)
     q_off = tk - tq
+    dp_eff = dropout_p if seed is not None else 0.0
     glse_in = ([glse4], [pl.BlockSpec((1, bq, 1),
                                       lambda bh, i, j: (bh, i, 0))])         if has_glse else ([], [])
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, nk=nk, causal=causal,
-                          scale=scale, q_off=q_off, has_glse=has_glse),
-        grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
-        ] + glse_in[1],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                          scale=scale, q_off=q_off, has_glse=has_glse,
+                          dropout_p=dp_eff),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q4, k4, v4, g4, lse4, delta4, *glse_in[0])
+        **_grid_spec(
+            (b * h, nq, nk),
+            [
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+            ] + glse_in[1],
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            [pltpu.VMEM((bq, d), jnp.float32)],
+            seed),
+    )(*_seed_args(seed), q4, k4, v4, g4, lse4, delta4, *glse_in[0])
 
     glse_in_kv = ([glse4], [pl.BlockSpec((1, bq, 1),
                                          lambda bh, j, i: (bh, i, 0))])         if has_glse else ([], [])
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, nq=nq, causal=causal,
-                          scale=scale, q_off=q_off, has_glse=has_glse),
-        grid=(b * h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
-        ] + glse_in_kv[1],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
-        ],
+                          scale=scale, q_off=q_off, has_glse=has_glse,
+                          dropout_p=dp_eff),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(q4, k4, v4, g4, lse4, delta4, *glse_in_kv[0])
+        **_grid_spec(
+            (b * h, nk, nq),
+            [
+                pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
+                pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh, i, 0)),
+            ] + glse_in_kv[1],
+            [
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            ],
+            [pltpu.VMEM((bk, d), jnp.float32),
+             pltpu.VMEM((bk, d), jnp.float32)],
+            seed),
+    )(*_seed_args(seed), q4, k4, v4, g4, lse4, delta4, *glse_in_kv[0])
 
     return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
             dv.reshape(b, h, tk, d))
 
 
-def _vjp_bwd(causal, scale, bq, bk, interpret, res, g):
-    return _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, None)
+def _vjp_bwd(causal, scale, bq, bk, interpret, dropout_p, res, g):
+    q, k, v, o, lse, seed = res
+    grads = _flash_bwd_impl(causal, scale, bq, bk, interpret,
+                            (q, k, v, o, lse), g, None, dropout_p, seed)
+    return grads + (_zero_seed_cot(seed),)
+
+
+def _zero_seed_cot(seed):
+    if seed is None:
+        return None
+    import numpy as _np
+    return _np.zeros(jnp.shape(seed), dtype=jax.dtypes.float0)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_lse(q, k, v, causal=False, scale=None, bq=128, bk=128,
-                        interpret=False):
+                        interpret=False, dropout_p=0.0, seed=None):
     """Like flash_attention but also returns the per-query log-sum-exp —
     the interface ring attention needs to merge per-block results
     (o_total = Σ_j o_j·exp(lse_j − lse_total)). Differentiable in both
-    outputs: the bwd kernels carry the lse cotangent via the dLSE term."""
+    outputs: the bwd kernels carry the lse cotangent via the dLSE term.
+    Note lse itself is dropout-free (mask applies to the numerator only),
+    so the ring block-merge stays exact under dropout."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    return _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return _flash_fwd(q, k, v, causal, scale, bq, bk, interpret,
+                      dropout_p, seed)
 
 
-def _lse_vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
+def _lse_vjp_fwd(q, k, v, causal, scale, bq, bk, interpret, dropout_p,
+                 seed):
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
-    return (out, lse), (q, k, v, out, lse)
+    out, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret,
+                          dropout_p, seed)
+    return (out, lse), (q, k, v, out, lse, seed)
 
 
-def _lse_vjp_bwd(causal, scale, bq, bk, interpret, res, gs):
+def _lse_vjp_bwd(causal, scale, bq, bk, interpret, dropout_p, res, gs):
+    q, k, v, o, lse, seed = res
     g, glse = gs
-    return _flash_bwd_impl(causal, scale, bq, bk, interpret, res, g, glse)
+    grads = _flash_bwd_impl(causal, scale, bq, bk, interpret,
+                            (q, k, v, o, lse), g, glse, dropout_p, seed)
+    return grads + (_zero_seed_cot(seed),)
 
 
 flash_attention_lse.defvjp(_lse_vjp_fwd, _lse_vjp_bwd)

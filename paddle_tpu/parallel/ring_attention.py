@@ -47,8 +47,8 @@ def _use_flash_blocks(tq, tk, d):
     return (pk.kernel_enabled(128, d) and tq % 128 == 0 and tk % 128 == 0)
 
 
-def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
-                                scale: float):
+def _ring_attention_shard_flash(q, k, v, seed, axis_name: str, causal: bool,
+                                scale: float, dropout_p: float = 0.0):
     """Flash-kernel variant: each ring step computes its [Tq_loc, Tk_loc]
     block with the Pallas flash kernel (O(T·D) VMEM) returning (o_j, lse_j)
     and merges blocks by log-sum-exp — compounding sp sharding with flash
@@ -68,8 +68,19 @@ def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
     if interpret:               # tiny test shapes: no tiling constraints
         bq = bq or 8            # _use_flash_blocks guarantees Tq % 8 == 0
         bk = bk or 8
-    flash = _ft.partial(pk.flash_attention_lse, scale=scale, bq=bq, bk=bk,
-                        interpret=interpret)
+    base = _ft.partial(pk.flash_attention_lse, scale=scale, bq=bq, bk=bk,
+                       interpret=interpret)
+
+    def flash(qq, kk, vv, causal, kv_rank):
+        if dropout_p <= 0:
+            return base(qq, kk, vv, causal=causal)
+        # per-(rank, kv_rank) seeds decorrelate the tile masks across ring
+        # steps; the custom_vjp carries the seed in its residuals, so
+        # fwd/bwd masks agree. (Masks are iid Bernoulli but not
+        # bit-identical to the single-device kernel's — documented
+        # divergence; the jnp ring path below IS bit-identical.)
+        return base(qq, kk, vv, causal=causal, dropout_p=dropout_p,
+                    seed=seed + rank * 1000003 + kv_rank)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def merge(o, lse, oj, lsej):
@@ -81,7 +92,7 @@ def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
 
     # step 0 is ALWAYS the diagonal block (kv starts as this rank's own
     # shard), so the causal flag is static per phase — no double compute
-    o, lse = flash(q, k, v, causal=causal)
+    o, lse = flash(q, k, v, causal, rank)
     o = o.astype(jnp.float32)
     lse = lse.astype(jnp.float32)
     kj = lax.ppermute(k, axis_name, perm=perm)
@@ -90,7 +101,7 @@ def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
     def step(carry, j):
         o, lse, kj, vj = carry
         kv_rank = (rank - j) % n
-        oj, lsej = flash(q, kj, vj, causal=False)
+        oj, lsej = flash(q, kj, vj, False, kv_rank)
         if causal:
             # off-diagonal: earlier ranks fully visible, later ranks masked
             visible = kv_rank < rank
@@ -106,13 +117,13 @@ def _ring_attention_shard_flash(q, k, v, axis_name: str, causal: bool,
     return o.astype(dtype)
 
 
-def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
-                          scale: float):
+def _ring_attention_shard(q, k, v, seed, axis_name: str, causal: bool,
+                          scale: float, dropout_p: float = 0.0):
     """Per-shard ring attention. q/k/v: [B, H, T_local, D] (this rank's
     sequence shard); returns [B, H, T_local, D]."""
     if _use_flash_blocks(q.shape[2], k.shape[2], q.shape[3]):
-        return _ring_attention_shard_flash(q, k, v, axis_name, causal,
-                                           scale)
+        return _ring_attention_shard_flash(q, k, v, seed, axis_name, causal,
+                                           scale, dropout_p)
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, H, Tq, D = q.shape
@@ -122,6 +133,8 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
     qf = q.astype(jnp.float32) * scale
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
+    bh_idx = jnp.arange(B * H).reshape(B, H, 1, 1)        # global coords →
+    # masks bit-identical to full_attention's jnp path with the same seed
 
     # derive the accumulators from qf so they carry the same manual-axis
     # "varying" annotation as the rotating kv (shard_map VMA typing)
@@ -144,7 +157,13 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
         if causal:
             p = p * valid[None, None]
         l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vj)
+        pv = p
+        if dropout_p > 0:
+            from paddle_tpu.ops.pallas.flash_attention import hash_keep_mask
+            pv = p * hash_keep_mask(seed[0], bh_idx,
+                                    q_pos[None, None, :, None],
+                                    k_pos[None, None, None, :], dropout_p)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", pv, vj)
         # rotate KV to the next rank (ring hop over ICI)
         kj = lax.ppermute(kj, axis_name, perm=perm)
         vj = lax.ppermute(vj, axis_name, perm=perm)
@@ -156,11 +175,12 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
     return out.astype(dtype)
 
 
-def _ulysses_attention_shard(q, k, v, axis_name: str, causal: bool,
-                             scale: float):
+def _ulysses_attention_shard(q, k, v, seed, axis_name: str, causal: bool,
+                             scale: float, dropout_p: float = 0.0):
     """All-to-all head-parallel attention (Ulysses). q/k/v:
     [B, H, T_local, D]; H must divide by the axis size."""
     n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
     H = q.shape[1]
     if H % n != 0:
         raise ValueError(f"ulysses needs heads ({H}) divisible by sp={n}")
@@ -181,13 +201,23 @@ def _ulysses_attention_shard(q, k, v, axis_name: str, causal: bool,
         pos = jnp.arange(T)
         s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0:
+        from paddle_tpu.ops.pallas.flash_attention import hash_keep_mask
+        B, Hl = qg.shape[0], qg.shape[1]
+        # global (batch*head) index: this rank owns heads
+        # [rank*H/n, (rank+1)*H/n) — bit-identical to the unsharded mask
+        bh = (jnp.arange(B)[:, None] * H
+              + rank * Hl + jnp.arange(Hl)[None, :])[..., None, None]
+        pos = jnp.arange(T)
+        p = p * hash_keep_mask(seed[0], bh, pos[None, None, :, None],
+                               pos[None, None, None, :], dropout_p)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, vg.astype(jnp.float32))
     return unexchange(out.astype(q.dtype))
 
 
 def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
                  scale=None, impl: str = "ring", batch_axis=None,
-                 head_axis=None):
+                 head_axis=None, dropout_p: float = 0.0, seed=None):
     """Sequence-parallel attention over global [B, H, T, D] arrays whose T
     dim is (or will be) sharded over `sp_axis`. Runs inside jit; shard_map
     drops to per-device code and XLA rides the ICI ring.
@@ -206,8 +236,20 @@ def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
 
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    fn = {"ring": _ring_attention_shard,
-          "ulysses": _ulysses_attention_shard}[impl]
+    base_fn = {"ring": _ring_attention_shard,
+               "ulysses": _ulysses_attention_shard}[impl]
+    if dropout_p > 0 and seed is None:
+        raise ValueError("sp_attention: dropout_p > 0 requires a seed")
+
+    def fn(qq, kk, vv, sd, axis_name, causal, scale):
+        if dropout_p > 0:
+            # decorrelate masks across dp/tp shards (the sp shards already
+            # decorrelate via global positions / per-rank seeds)
+            for ax in (batch_axis, head_axis):
+                if ax and ax in mesh.axis_names and ax != sp_axis:
+                    sd = sd + lax.axis_index(ax) * 7919
+        return base_fn(qq, kk, vv, sd, axis_name=axis_name, causal=causal,
+                       scale=scale, dropout_p=dropout_p)
 
     def ok(axis, dim):
         return (axis and axis != sp_axis and axis in mesh.axis_names
@@ -224,38 +266,59 @@ def sp_attention(q, k, v, mesh, sp_axis: str, causal: bool = False,
     uses_flash = impl == "ring" and _use_flash_blocks(
         q.shape[2] // sp_size, k.shape[2] // sp_size, q.shape[3])
     kwargs = {_relax_kw: False} if uses_flash else {}
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32).reshape(1)
     mapped = shard_map(
         partial(fn, axis_name=sp_axis, causal=causal, scale=float(scale)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
-    return mapped(q, k, v)
+        mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
+        **kwargs)
+    return mapped(q, k, v, seed)
 
 
-def full_attention(q, k, v, causal: bool = False, scale=None, bias=None):
+def full_attention(q, k, v, causal: bool = False, scale=None, bias=None,
+                   dropout_p: float = 0.0, seed=None):
     """Single-device attention ([B, H, Tq, D] x [B, H, Tk, D]); also the
     emitter fallback when no sp axis is configured. On TPU with aligned
     shapes this routes to the Pallas flash kernel (ops/pallas/ — the jit-
     microkernel tier): measured faster than the XLA-fused path from
     T≈4096 (11.3 vs 14.3 ms) to T=16384 (44.6 vs 75.9 ms on v5e) and
-    O(T·D) HBM instead of O(T²)."""
+    O(T·D) HBM instead of O(T²).
+
+    dropout_p > 0 applies attention-weight dropout (upscale_in_train;
+    reference semantics dist_transformer.py:1044) with a hash-derived
+    keep mask over (seed, batch*head, q position, k position) — the SAME
+    mask function as the flash kernels, so the two paths agree
+    bit-exactly given the same seed."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
+    if dropout_p > 0 and seed is None:
+        raise ValueError("full_attention: dropout_p > 0 requires a seed")
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
     if bias is None:
         from paddle_tpu.ops import pallas as pk
-        tq, tk, d = q.shape[2], k.shape[2], q.shape[3]
         if pk.kernel_enabled(128, d) and tq >= 2048:
             bq, bk = pk.pick_blocks(tq, tk)
             if bq and bk:
                 return pk.flash_attention(q, k, v, causal, scale, bq, bk,
-                                          False)
+                                          False, dropout_p, seed)
     s = jnp.einsum("bhqd,bhkd->bhqk",
                    q.astype(jnp.float32) * scale, k.astype(jnp.float32))
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal:
-        Tq, Tk = s.shape[-2], s.shape[-1]
-        qp = jnp.arange(Tq) + (Tk - Tq)
-        s = jnp.where((qp[:, None] >= jnp.arange(Tk)[None, :])[None, None],
+        qp = jnp.arange(tq) + (tk - tq)
+        s = jnp.where((qp[:, None] >= jnp.arange(tk)[None, :])[None, None],
                       s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0:
+        from paddle_tpu.ops.pallas.flash_attention import hash_keep_mask
+        seed = jnp.asarray(seed, jnp.int32).reshape(-1)[0]
+        bh = jnp.arange(b * h).reshape(b, h, 1, 1)
+        qpos = (tk - tq) + jnp.arange(tq)
+        p = p * hash_keep_mask(seed, bh, qpos[None, None, :, None],
+                               jnp.arange(tk)[None, None, None, :],
+                               dropout_p)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -177,7 +177,13 @@ def bucket_by_length(reader, len_fn: Callable, bucket_bounds: List[int],
     128]). Samples longer than the last bound go to the last bucket
     (caller truncates or the pad helper raises). Yields (bound, [samples])
     batches as each bucket fills; tail batches flush at the end unless
-    drop_last."""
+    drop_last.
+
+    NOTE: the len(bucket_bounds) compile-signature bound holds only when
+    every batch has exactly `batch_size` samples — with drop_last=False
+    the flushed tail batches have free batch dims, adding up to
+    len(bucket_bounds) extra signatures. Pass drop_last=True, or pad the
+    tail batch dim with `pad_batch(..., batch_size=batch_size)`."""
     bounds = sorted(bucket_bounds)
 
     def bucketed():
@@ -196,20 +202,29 @@ def bucket_by_length(reader, len_fn: Callable, bucket_bounds: List[int],
     return bucketed
 
 
-def pad_batch(samples, length: int, pad_value=0):
+def pad_batch(samples, length: int, pad_value=0, batch_size: int = None):
     """Collate variable-length samples (time on their FIRST axis) to
-    `[len(samples), length, ...]` + SeqLens — the feed pair the sequence
-    ops consume (ops/sequence_ops.py: padded [B, T, ...] + SeqLens
-    replaces LoD)."""
+    `[B, length, ...]` + SeqLens — the feed pair the sequence ops consume
+    (ops/sequence_ops.py: padded [B, T, ...] + SeqLens replaces LoD).
+
+    batch_size pads the BATCH dim too (tail batches from bucket_by_length
+    with drop_last=False): rows beyond len(samples) are pad_value with
+    SeqLens 0, keeping the compile-signature set at len(bucket_bounds)."""
     import numpy as np
     lens = np.asarray([np.shape(s)[0] for s in samples], np.int32)
     if lens.max() > length:
         raise ValueError(f"sample length {int(lens.max())} exceeds the "
                          f"bucket bound {length}; truncate upstream")
+    b = len(samples) if batch_size is None else batch_size
+    if b < len(samples):
+        raise ValueError(f"batch_size {b} < {len(samples)} samples")
     first = np.asarray(samples[0])
-    out_shape = (len(samples), length) + first.shape[1:]
+    out_shape = (b, length) + first.shape[1:]
     out = np.full(out_shape, pad_value, dtype=first.dtype)
     for i, s in enumerate(samples):
         s = np.asarray(s)
         out[i, :s.shape[0]] = s
+    if batch_size is not None:
+        lens = np.concatenate(
+            [lens, np.zeros(b - len(samples), np.int32)])
     return out, lens

@@ -1,0 +1,1 @@
+from paddle_tpu.utils import flops  # noqa: F401
