@@ -116,7 +116,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "mnist": (models.mnist.build, {}, "images/sec", None),
         "transformer": (models.transformer.build,
                         {"max_len": 64, "src_vocab": 32000,
-                         "tgt_vocab": 32000}, "tokens/sec", None),
+                         "tgt_vocab": 32000, "fused_attention": True},
+                        "tokens/sec", None),
         # long-context config: d_head 128 routes attention through the
         # Pallas flash kernels (fwd + blockwise bwd)
         "transformer_long": (models.transformer.build,

@@ -161,7 +161,7 @@ def _ge(a, b):
 
 
 AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
-                "conv2d_transpose", "fc")
+                "conv2d_transpose", "fc", "fused_linear_ce")
 
 
 RECURRENT_OPS = ("dynamic_lstm", "dynamic_gru", "dynamic_lstmp", "while",
@@ -211,6 +211,11 @@ def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=None):
                 # bias/scale adds after tagged ops: cast the fp32 param
                 # operand down instead of promoting the bf16 activation up
                 op.attrs["__amp_match_dtype__"] = True
+            elif pure and op.type == "lookup_table":
+                # the embedding STARTS the residual stream: keep it bf16
+                # or every downstream elementwise/norm runs fp32 (2x HBM)
+                op.attrs["__amp_keep_bf16__"] = True
+                n += 1
             elif op.type == "__vjp__":
                 # backward ops re-trace a SNAPSHOT of the forward op
                 # (grad_ops.py fwd_op dict) — tag it too so rewrites after
@@ -223,5 +228,7 @@ def rewrite_program_amp(program=None, op_types=AMP_OP_TYPES, pure=None):
                     n += 1
                 elif pure and fwd.get("type") in elementwise:
                     fwd.setdefault("attrs", {})["__amp_match_dtype__"] = True
+                elif pure and fwd.get("type") == "lookup_table":
+                    fwd.setdefault("attrs", {})["__amp_keep_bf16__"] = True
     program.desc.bump_version()
     return n

@@ -200,6 +200,11 @@ class Executor:
             else:
                 feeds[name] = jax.device_put(arr, self.device)
 
+        from paddle_tpu import flags
+        bench = flags.get("benchmark")
+        if bench:
+            import time
+            t0 = time.time()
         if iterations > 1:
             seed0 = self._step + 1
             self._step += iterations
@@ -208,6 +213,12 @@ class Executor:
         else:
             self._step += 1
             outs = cb(scope, feeds, self._step)
+        if bench:
+            # dispatch wall time (async: device completion lands later;
+            # reference capability: FLAGS_benchmark per-run executor timing)
+            print(f"[FLAGS_benchmark] run dispatch {time.time() - t0:.4f}s "
+                  f"iterations={iterations} feeds={len(feed_names)} "
+                  f"fetches={len(fetch_names)}")
         if _check_nan_inf_enabled():
             # FLAGS_check_nan_inf capability (reference: operator.cc:978-990
             # scans every op output per step). Here outputs are fused, so
@@ -233,10 +244,10 @@ def run_startup(startup_program, scope: Optional[Scope] = None,
 
 
 def _check_nan_inf_enabled() -> bool:
-    """env FLAGS_check_nan_inf=1|true — same flag name as the reference's
-    gflags re-export convention (python __init__.py:125 tryfromenv)."""
-    import os
-    return os.environ.get("FLAGS_check_nan_inf", "0").lower() in ("1", "true")
+    """FLAGS_check_nan_inf via the unified registry (paddle_tpu.flags;
+    reference gflags re-export convention, python __init__.py:125)."""
+    from paddle_tpu import flags
+    return flags.get("check_nan_inf")
 
 
 def _assert_finite(name: str, arr):

@@ -1,15 +1,62 @@
 """CIFAR reader (reference: python/paddle/dataset/cifar.py — train10/test10,
-train100/test100 yielding (3072-float image, label))."""
+train100/test100 yielding (3072-float image, label)).
+
+Real-format parsing (reference cifar.py:50-75 reader_creator): the
+cifar-10/100-python tarball of pickled batch dicts — b'data' ([N, 3072]
+uint8) with b'labels' (cifar-10) or b'fine_labels' (cifar-100) — member
+files selected by substring ('data_batch'/'test_batch' for 10,
+'train'/'test' for 100), pixels normalized /255.0. Raw tarballs are
+looked up under DATA_HOME/cifar/ with the canonical names; offline
+fallback: cached npz, then synthetic.
+"""
 
 from __future__ import annotations
+
+import os
+import pickle
+import tarfile
 
 import numpy as np
 
 from paddle_tpu.dataset import common
 
+_TARS = {10: "cifar-10-python.tar.gz", 100: "cifar-100-python.tar.gz"}
+_SUBNAMES = {(10, "train"): "data_batch", (10, "test"): "test_batch",
+             (100, "train"): "train", (100, "test"): "test"}
+
+
+def reader_from_tar(path, sub_name):
+    """Reader over a cifar-python tarball: yields (float32 [3072] in
+    [0, 1], int label) from every member whose name contains sub_name."""
+    def reader():
+        with tarfile.open(path, mode="r") as f:
+            names = [m.name for m in f if sub_name in m.name]
+            for name in sorted(names):
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                data = batch[b"data"]
+                labels = batch.get(b"labels",
+                                   batch.get(b"fine_labels"))
+                if labels is None:
+                    raise ValueError(
+                        f"{path}:{name}: no b'labels'/b'fine_labels' key")
+                for sample, label in zip(data, labels):
+                    yield (np.asarray(sample, np.float32) / 255.0,
+                           int(label))
+    return reader
+
+
+def _raw_tar(classes: int):
+    p = os.path.join(common.DATA_HOME, "cifar", _TARS[classes])
+    return p if os.path.exists(p) else None
+
 
 def _reader(split: str, classes: int, n_synth: int, seed: int):
     def reader():
+        tar = _raw_tar(classes)
+        if tar is not None:
+            yield from reader_from_tar(
+                tar, _SUBNAMES[(classes, split)])()
+            return
         data = common.cached_npz(f"cifar{classes}_{split}")
         if data is not None:
             xs, ys = data["x"], data["y"]

@@ -1,21 +1,111 @@
 """IMDB sentiment reader (reference: python/paddle/dataset/imdb.py —
-word-id sequences + binary label; feeds the LSTM text-cls benchmark)."""
+word-id sequences + binary label; feeds the LSTM text-cls benchmark).
+
+Real-format parsing (reference imdb.py:39-77): the aclImdb tarball is
+walked SEQUENTIALLY (tarfile.next — the reference's explicit choice over
+random-access extractfile), each review matching the split's path pattern
+is tokenized as: strip trailing newline, delete ASCII punctuation,
+lowercase, whitespace-split. The vocabulary (build_dict) keeps words with
+freq > cutoff, ordered by (-freq, word), ids 0..n-1, plus '<unk>' = n.
+Sample labels follow the reference: pos = 0, neg = 1. Raw tarball is
+looked up at DATA_HOME/imdb/aclImdb_v1.tar.gz; offline fallback: cached
+npz, then synthetic.
+"""
 
 from __future__ import annotations
+
+import collections
+import os
+import re
+import string
+import tarfile
 
 import numpy as np
 
 from paddle_tpu.dataset import common
 
-VOCAB_SIZE = 5147  # reference vocab size order of magnitude
+VOCAB_SIZE = 5147  # synthetic-fallback vocab size order of magnitude
+
+_TAR = "aclImdb_v1.tar.gz"
+
+
+def tokenize_tar(path, pattern):
+    """Yield tokenized reviews from tar members matching `pattern`
+    (compiled regex) — the reference's tokenize(): sequential tar walk,
+    rstrip newline, remove punctuation, lower, split."""
+    pat = re.compile(pattern) if isinstance(pattern, str) else pattern
+    with tarfile.open(path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if pat.match(tf.name):
+                raw = tarf.extractfile(tf).read().rstrip(b"\n\r")
+                raw = raw.translate(None, string.punctuation.encode())
+                yield raw.lower().split()
+            tf = tarf.next()
+
+
+def build_dict(path, pattern, cutoff=0):
+    """Word -> id over the matched corpus (reference build_dict: freq >
+    cutoff survivors sorted by (-freq, word); '<unk>' appended last)."""
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize_tar(path, pattern):
+        for w in doc:
+            word_freq[w] += 1
+    kept = [(w, f) for w, f in word_freq.items() if f > cutoff]
+    kept.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(kept)}
+    word_idx[b"<unk>"] = len(kept)
+    return word_idx
+
+
+def reader_from_tar(path, split, word_idx):
+    """(word-id list, label) reader over one split; reference label
+    convention: pos = 0, neg = 1."""
+    unk = word_idx[b"<unk>"]
+    samples = []
+    for label, sub in ((0, "pos"), (1, "neg")):
+        pat = re.compile(rf"aclImdb/{split}/{sub}/.*\.txt$")
+        for doc in tokenize_tar(path, pat):
+            samples.append(([word_idx.get(w, unk) for w in doc], label))
+
+    def reader():
+        yield from samples
+    return reader
+
+
+def _raw_tar():
+    p = os.path.join(common.DATA_HOME, "imdb", _TAR)
+    return p if os.path.exists(p) else None
 
 
 def word_dict():
+    tar = _raw_tar()
+    if tar is not None:
+        # reference imdb.py:138: the corpus is the LABELED splits only —
+        # ((pos)|(neg)); train/unsup and the urls_*.txt lists must not
+        # contribute frequencies or the id ordering diverges
+        return build_dict(
+            tar,
+            re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+            cutoff=150)
     return {i: i for i in range(VOCAB_SIZE)}
 
 
-def _reader(split: str, n: int, seed: int, maxlen: int = 100):
+def _reader(split: str, n: int, seed: int, maxlen: int = 100,
+            word_idx=None):
+    # vocab + tokenized samples build ONCE per reader creation, not per
+    # epoch (reader() is re-invoked every pass; a per-epoch build_dict
+    # would re-walk the whole tarball each time)
+    tar = _raw_tar()
+    real = None
+    if tar is not None:
+        wi = word_idx or word_dict()
+        real = reader_from_tar(tar, split, wi)
+
     def reader():
+        if real is not None:
+            yield from real()
+            return
         data = common.cached_npz(f"imdb_{split}")
         if data is not None:
             xs, ys = data["x"], data["y"]
@@ -34,8 +124,8 @@ def _reader(split: str, n: int, seed: int, maxlen: int = 100):
 
 
 def train(word_idx=None):
-    return _reader("train", 1024, 90)
+    return _reader("train", 1024, 90, word_idx=word_idx)
 
 
 def test(word_idx=None):
-    return _reader("test", 256, 91)
+    return _reader("test", 256, 91, word_idx=word_idx)

@@ -1,0 +1,123 @@
+"""Unified runtime flag registry.
+
+The reference re-exports a curated set of C++ gflags into Python and seeds
+them from the environment at import (reference:
+python/paddle/fluid/__init__.py:125-163 `__bootstrap__` collects
+read_env_flags and calls core.init_gflags). TPU-native equivalent: typed
+flag definitions with `FLAGS_<name>` environment override, queried at use
+sites via `flags.get(...)` and settable programmatically via
+`flags.set(...)` (tests) — one registry instead of ad-hoc os.environ
+lookups scattered through the runtime.
+
+Every flag the runtime honors is defined here, so `python -m
+paddle_tpu.flags` prints the complete documented surface.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class FlagDef:
+    name: str
+    type: type
+    default: Any
+    help: str
+
+
+_DEFS: Dict[str, FlagDef] = {}
+_OVERRIDES: Dict[str, Any] = {}
+
+
+def define(name: str, type_, default, help_: str):
+    if name in _DEFS:
+        raise ValueError(f"flag {name!r} already defined")
+    _DEFS[name] = FlagDef(name, type_, default, help_)
+
+
+def _parse(d: FlagDef, raw: str):
+    if d.type is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return d.type(raw)
+
+
+def get(name: str):
+    """Current value: programmatic override > FLAGS_<name> env > default."""
+    d = _DEFS.get(name)
+    if d is None:
+        raise KeyError(f"unknown flag {name!r}; defined: {sorted(_DEFS)}")
+    if name in _OVERRIDES:
+        return _OVERRIDES[name]
+    raw = os.environ.get("FLAGS_" + name)
+    if raw is not None:
+        try:
+            return _parse(d, raw)
+        except ValueError:
+            import warnings
+            warnings.warn(f"FLAGS_{name}={raw!r} does not parse as "
+                          f"{d.type.__name__}; using default {d.default!r}")
+    return d.default
+
+
+def set(name: str, value):   # noqa: A001 - mirrors gflags SetCommandLineOption
+    d = _DEFS.get(name)
+    if d is None:
+        raise KeyError(f"unknown flag {name!r}")
+    _OVERRIDES[name] = d.type(value) if value is not None else None
+
+
+def reset(name: Optional[str] = None):
+    if name is None:
+        _OVERRIDES.clear()
+    else:
+        _OVERRIDES.pop(name, None)
+
+
+def all_flags():
+    return dict(_DEFS)
+
+
+# --- runtime flag definitions (reference names kept where they exist) ----
+
+define("check_nan_inf", bool, False,
+       "Scan every fetch and updated state var for NaN/Inf after each "
+       "executor run (reference: operator.cc FLAGS_check_nan_inf).")
+define("debug_graphviz_path", str, "",
+       "Write a graphviz dump of each compiled program here "
+       "(reference: inference/analysis FLAGS_IA_graphviz_log_root "
+       "capability; fluid/debugger.py draw_block_graphviz).")
+define("benchmark", bool, False,
+       "Print per-run compile/execute timing from the Executor "
+       "(reference: FLAGS_benchmark executor timing).")
+define("tpu_prng", str, "rbg",
+       "JAX PRNG implementation: 'rbg' (TPU hardware path; default) or "
+       "'threefry2x32'. Read once at import by paddle_tpu/__init__.py "
+       "via PADDLE_TPU_PRNG (kept for compat) or FLAGS_tpu_prng.")
+define("disable_pallas", bool, False,
+       "Force the refer (jnp) tier instead of Pallas kernels "
+       "(ops/pallas kernel_pool gate; PADDLE_TPU_DISABLE_PALLAS compat).")
+define("eager_delete_tensor_gb", float, 0.0,
+       "Accepted for API parity (reference: FLAGS_eager_delete_tensor_gb "
+       "GC threshold) — XLA/PJRT owns buffer lifetime on TPU; no-op.")
+define("fraction_of_gpu_memory_to_use", float, 1.0,
+       "Accepted for API parity (reference allocator knob) — PJRT "
+       "preallocation is controlled by XLA_PYTHON_CLIENT_* instead; "
+       "no-op.")
+
+
+def _main():
+    print("paddle_tpu runtime flags (override with FLAGS_<name> env or "
+          "paddle_tpu.flags.set):\n")
+    for name, d in sorted(_DEFS.items()):
+        cur = get(name)
+        mark = "  [set]" if (name in _OVERRIDES
+                             or ("FLAGS_" + name) in os.environ) else ""
+        print(f"FLAGS_{name} ({d.type.__name__}, default {d.default!r}, "
+              f"current {cur!r}){mark}\n    {d.help}\n")
+
+
+if __name__ == "__main__":
+    _main()

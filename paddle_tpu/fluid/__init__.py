@@ -8,7 +8,9 @@ from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor,
 from paddle_tpu.core.scope import Scope, global_scope
 from paddle_tpu.fluid import backward, clip, initializer, layers, nets
 from paddle_tpu.fluid import optimizer, param_attr, regularizer, unique_name
-from paddle_tpu.fluid import io, learning_rate_scheduler, metrics, profiler
+from paddle_tpu.fluid import (io, learning_rate_scheduler, metrics,
+                              profiler)
+from paddle_tpu.fluid import evaluator
 from paddle_tpu.fluid.data_feeder import DataFeeder
 from paddle_tpu.fluid.framework import (Program, default_main_program,
                                         default_startup_program,

@@ -1,0 +1,196 @@
+"""In-graph accumulating evaluators.
+
+Capability parity with the reference's `fluid.evaluator` module
+(reference: python/paddle/fluid/evaluator.py — Evaluator base :44,
+ChunkEvaluator :126, EditDistance :217, DetectionMAP :298): each evaluator
+appends accumulation ops to the MAIN program (state += batch statistic per
+run), `reset(exe)` zeroes the states through a small side program, and
+`eval(exe)` computes the aggregate metric. The reference itself steers new
+code toward `fluid.metrics.*` (host-side accumulation, metrics.py); both
+surfaces exist here.
+
+TPU note: the accumulating states are persistable scope vars updated by
+the compiled step itself — under `exe.run(iterations=N)` the accumulation
+rides the device-side loop with no host round-trips.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.layer_helper import LayerHelper
+from paddle_tpu.fluid import layers
+
+
+class Evaluator:
+    """reference: evaluator.py:44. States zero on reset; subclasses append
+    accumulation ops at construction time (inside a program_guard)."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            f"The {type(self).__name__} evaluator is the legacy in-graph "
+            f"surface; prefer fluid.metrics.{type(self).__name__} "
+            f"(host-side accumulation)", Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        """Zero every state var (reference: evaluator.py:76)."""
+        if reset_program is None:
+            reset_program = framework.Program()
+        with framework.program_guard(main_program=reset_program):
+            for var in self.states:
+                g_var = reset_program.global_block().create_var(
+                    name=var.name, shape=var.shape, dtype=var.dtype,
+                    persistable=True)
+                layers.fill_constant(shape=list(var.shape),
+                                     dtype=var.dtype, value=0.0, out=g_var)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        """Persistable accumulator var, zero-initialized in the startup
+        program (reference: evaluator.py _create_state)."""
+        from paddle_tpu.fluid import unique_name
+        name = "_".join([unique_name.generate(self.helper.name), suffix])
+        main = framework.default_main_program()
+        startup = framework.default_startup_program()
+        state = main.global_block().create_var(
+            name=name, persistable=True, dtype=dtype, shape=list(shape),
+            stop_gradient=True)
+        sv = startup.global_block().create_var(
+            name=name, persistable=True, dtype=dtype, shape=list(shape))
+        with framework.program_guard(startup):
+            layers.fill_constant(shape=list(shape), dtype=dtype, value=0.0,
+                                 out=sv)
+        self.states.append(state)
+        return state
+
+    def _accumulate(self, state, batch_value):
+        """state += batch_value, in-graph (runs every exe.run of main)."""
+        if batch_value.dtype != state.dtype:
+            batch_value = layers.cast(batch_value, state.dtype)
+        summed = layers.elementwise_add(
+            state, layers.reshape(batch_value, shape=list(state.shape)))
+        layers.assign(summed, state)
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk P/R/F1 (reference: evaluator.py:126). Appends
+    chunk_eval to the main program and accumulates the three chunk counts;
+    eval() computes precision/recall/F1 from the totals."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_lens=None):
+        super().__init__("chunk_eval")
+        main = framework.default_main_program()
+        if main.random_seed is None:
+            pass
+        (precision, recall, f1,
+         num_infer, num_label, num_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types, seq_lens=seq_lens)
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "int64", (1,))
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "int64", (1,))
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "int64", (1,))
+        self._accumulate(self.num_infer_chunks, num_infer)
+        self._accumulate(self.num_label_chunks, num_label)
+        self._accumulate(self.num_correct_chunks, num_correct)
+        self.metrics.extend((precision, recall, f1))
+
+    def eval(self, executor, eval_program=None):
+        from paddle_tpu.core.scope import global_scope
+        ni = float(np.asarray(global_scope().find_var(
+            self.num_infer_chunks.name)).reshape(()))
+        nl = float(np.asarray(global_scope().find_var(
+            self.num_label_chunks.name)).reshape(()))
+        nc = float(np.asarray(global_scope().find_var(
+            self.num_correct_chunks.name)).reshape(()))
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if nc else 0.0)
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance + instance error rate
+    (reference: evaluator.py:217)."""
+
+    def __init__(self, input, label, ignored_tokens=None, input_length=None,
+                 label_length=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            input_length=input_length, label_length=label_length)
+        self.total_distance = self._create_state(
+            "total_distance", "float32", (1,))
+        self.seq_num = self._create_state("seq_num", "int64", (1,))
+        self.instance_error = self._create_state(
+            "instance_error", "int64", (1,))
+        batch_dist = layers.reduce_sum(distances)
+        batch_err = layers.reduce_sum(
+            layers.cast(layers.greater_than(
+                distances, layers.fill_constant([1], "float32", 0.0)),
+                "int64"))
+        self._accumulate(self.total_distance, batch_dist)
+        self._accumulate(self.seq_num, seq_num)
+        self._accumulate(self.instance_error, batch_err)
+
+    def eval(self, executor, eval_program=None):
+        from paddle_tpu.core.scope import global_scope
+        dist = float(np.asarray(global_scope().find_var(
+            self.total_distance.name)).reshape(()))
+        n = float(np.asarray(global_scope().find_var(
+            self.seq_num.name)).reshape(()))
+        err = float(np.asarray(global_scope().find_var(
+            self.instance_error.name)).reshape(()))
+        avg = dist / n if n else 0.0
+        rate = err / n if n else 0.0
+        return np.array([avg]), np.array([rate])
+
+
+class DetectionMAP(Evaluator):
+    """Accumulated detection mAP (reference: evaluator.py:298). The
+    stateless detection_map op scores each batch; cur_map is the
+    per-batch value and accum_map the running average over batches
+    (static-shape redesign of the reference's accumulating
+    PosCount/TruePos/FalsePos states — detection_map_op.cc)."""
+
+    def __init__(self, input, gt_label, gt_box, class_num,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__("map_eval")
+        label = layers.concat([layers.cast(gt_label, "float32"), gt_box],
+                              axis=-1)
+        cur = layers.detection_map(
+            input, label, class_num, background_label=background_label,
+            overlap_threshold=overlap_threshold, ap_version=ap_version)
+        self.map_sum = self._create_state("map_sum", "float32", (1,))
+        self.batches = self._create_state("batches", "float32", (1,))
+        self._accumulate(self.map_sum, cur)
+        self._accumulate(self.batches,
+                         layers.fill_constant([1], "float32", 1.0))
+        self.cur_map = cur
+        self.metrics.append(cur)
+
+    def get_map_var(self):
+        return self.cur_map, self.map_sum
+
+    def eval(self, executor, eval_program=None):
+        from paddle_tpu.core.scope import global_scope
+        s = float(np.asarray(global_scope().find_var(
+            self.map_sum.name)).reshape(()))
+        n = float(np.asarray(global_scope().find_var(
+            self.batches.name)).reshape(()))
+        return np.array([s / n if n else 0.0])

@@ -257,7 +257,8 @@ class GraphVizPass(Pass):
     def apply(self, graph: Graph) -> Graph:
         import os
         from paddle_tpu.fluid import debugger
-        path = self.path or os.environ.get("FLAGS_debug_graphviz_path")
+        from paddle_tpu import flags
+        path = self.path or flags.get("debug_graphviz_path") or None
         if path:
             debugger.draw_block_graphviz(graph.block, path=path)
         return graph

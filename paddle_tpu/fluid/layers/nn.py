@@ -551,14 +551,18 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
 
 def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
                                  scale=None, sp="auto", sp_impl="ring",
-                                 dropout_prob=0.0, name=None):
+                                 dropout_prob=0.0, layout="bhtd",
+                                 name=None):
     """Fused attention over [B, H, T, D] tensors (TPU-native extension —
     the reference composes matmul+softmax+matmul; see ops.attention). With
     a mesh sp axis configured, computes ring attention / Ulysses over the
     sequence shards (parallel/ring_attention.py). dropout_prob applies
     attention-weight dropout (upscale_in_train — the reference's composed
     graph, dist_transformer.py:1044) inside the fused/flash kernels;
-    disabled automatically in test-mode programs."""
+    disabled automatically in test-mode programs. layout="bthd" takes
+    [B, T, H, D] tensors so the head split at the call site is a free
+    reshape (no materialized transpose — parallel/ring_attention.py
+    full_attention docstring)."""
     helper = LayerHelper("attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     ins = {"Q": [q], "K": [k], "V": [v]}
@@ -566,9 +570,32 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
         ins["Bias"] = [bias]
     helper.append_op("attention", inputs=ins, outputs={"Out": [out]},
                      attrs={"causal": causal, "scale": scale, "sp": sp,
-                            "sp_impl": sp_impl,
+                            "sp_impl": sp_impl, "layout": layout,
                             "dropout_prob": float(dropout_prob)})
     return out
+
+
+def fused_linear_cross_entropy(input, label, num_classes, label_smoothing=0.0,
+                               ignore_index=-100, param_attr=None,
+                               name=None):
+    """Classifier head: `fc(input, num_classes)` + label-smoothed
+    softmax-cross-entropy, fused so the [N, num_classes] logits never
+    materialize in HBM (Pallas streaming kernel, ops/pallas/fused_ce.py;
+    composed-op fallback off-TPU). input [N, D] (flatten upstream), label
+    [N, 1] int. Returns per-row Loss [N, 1]. TPU-native extension of the
+    reference's softmax_with_cross_entropy
+    (softmax_with_cross_entropy_op.cc) that also fuses the projection."""
+    helper = LayerHelper("fused_linear_ce", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[d, num_classes],
+                                dtype="float32")
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fused_linear_ce",
+                     inputs={"X": [input], "W": [w], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"label_smoothing": float(label_smoothing),
+                            "ignore_index": ignore_index})
+    return loss
 
 
 def cos_sim(X, Y, name=None):

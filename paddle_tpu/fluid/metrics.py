@@ -167,3 +167,66 @@ class EditDistance(MetricBase):
             raise ValueError("EditDistance: no updates yet")
         return (self.total_distance / self.seq_num,
                 self.instance_error / self.seq_num)
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate layers.chunk_eval's per-batch chunk counts and compute
+    precision/recall/F1 over the whole pass (reference: metrics.py:359 —
+    update() takes the three NumChunks outputs of chunk_eval)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        for name, v in (("num_infer_chunks", num_infer_chunks),
+                        ("num_label_chunks", num_label_chunks),
+                        ("num_correct_chunks", num_correct_chunks)):
+            if not isinstance(v, (int, float, np.integer, np.floating,
+                                  np.ndarray)):
+                raise ValueError(
+                    f"ChunkEvaluator.update: {name} must be a number or "
+                    f"numpy array, got {type(v).__name__}")
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """Running mean of per-batch detection mAP values (reference:
+    metrics.py:566 accumulates the detection_map evaluator's output;
+    the in-graph accumulating variant is fluid.evaluator.DetectionMAP)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self._sum = 0.0
+        self._n = 0
+
+    def update(self, value, weight=1):
+        v = np.asarray(value, dtype=np.float64).reshape(-1)
+        w = np.asarray(weight, dtype=np.float64).reshape(-1)
+        self._sum += float((v * w).sum())
+        self._n += float(w.sum())
+
+    def eval(self):
+        if self._n == 0:
+            raise ValueError("DetectionMAP: no updates yet")
+        return self._sum / self._n

@@ -49,30 +49,43 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
     k = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
     v = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
 
+    if fused:
+        # fused (and, with an sp mesh axis, ring/Ulysses sequence-parallel)
+        # attention; attention-weight dropout runs INSIDE the fused/flash
+        # kernels (hash-derived keep mask regenerated in the backward —
+        # ops/pallas/flash_attention.py), matching the unfused graph's
+        # softmax→dropout→matmul semantics in expectation. layout="bthd":
+        # the head split is a FREE reshape ([B,L,D] -> [B,L,H,dk]); XLA
+        # folds the head transposition into the attention einsums instead
+        # of materializing [B,H,L,dk] copies (measured ~7 ms/step of
+        # reshape/copy traffic on Transformer-base bs128 v5e)
+        def split_heads_free(x):
+            return layers.reshape(x, shape=[0, 0, n_head, d_k])
+
+        q, k, v = split_heads_free(q), split_heads_free(k), \
+            split_heads_free(v)
+        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal,
+                                                  dropout_prob=dropout,
+                                                  layout="bthd")
+        ctx = layers.reshape(ctx, shape=[0, 0, d_model])
+        return layers.fc(ctx, size=d_model, num_flatten_dims=2,
+                         bias_attr=False)
+
     def split_heads(x):
         # [B, L, D] -> [B, H, L, dk]
         r = layers.reshape(x, shape=[0, 0, n_head, d_k])
         return layers.transpose(r, perm=[0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if fused:
-        # fused (and, with an sp mesh axis, ring/Ulysses sequence-parallel)
-        # attention; attention-weight dropout runs INSIDE the fused/flash
-        # kernels (hash-derived keep mask regenerated in the backward —
-        # ops/pallas/flash_attention.py), matching the unfused graph's
-        # softmax→dropout→matmul semantics in expectation
-        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal,
-                                                  dropout_prob=dropout)
-    else:
-        q = layers.scale(q, scale=d_k ** -0.5)
-        logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]
-        if mask is not None:
-            logits = layers.elementwise_add(logits, mask)
-        weights = layers.softmax(logits)
-        if dropout:
-            weights = layers.dropout(weights, dropout_prob=dropout,
-                                     dropout_implementation="upscale_in_train")
-        ctx = layers.matmul(weights, v)                  # [B, H, Lq, dk]
+    q = layers.scale(q, scale=d_k ** -0.5)
+    logits = layers.matmul(q, k, transpose_y=True)   # [B, H, Lq, Lk]
+    if mask is not None:
+        logits = layers.elementwise_add(logits, mask)
+    weights = layers.softmax(logits)
+    if dropout:
+        weights = layers.dropout(weights, dropout_prob=dropout,
+                                 dropout_implementation="upscale_in_train")
+    ctx = layers.matmul(weights, v)                  # [B, H, Lq, dk]
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False)
@@ -119,7 +132,8 @@ def decoder_layer(x, enc_out, causal_mask, d_model, d_inner, n_head,
 
 def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
                 d_model=512, d_inner=2048, n_head=8, n_layer=6,
-                dropout=0.1, fused_attention=False, name="transformer"):
+                dropout=0.1, fused_attention=False, name="transformer",
+                project=True):
     pe = _const_var(name + "_pos_enc",
                     position_encoding(max_len, d_model))
     # causal mask [1, 1, L, L]: -1e9 above the diagonal
@@ -153,6 +167,10 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
         dec = decoder_layer(dec, enc, causal_mask, d_model, d_inner, n_head,
                             dropout, fused=fused_attention)
     dec = layers.layer_norm(dec, begin_norm_axis=2)
+    if not project:
+        # caller fuses the vocab projection into the loss
+        # (layers.fused_linear_cross_entropy)
+        return dec
     return layers.fc(dec, size=tgt_vocab, num_flatten_dims=2,
                      bias_attr=False)
 
@@ -161,25 +179,46 @@ def build(is_train: bool = True, src_vocab: int = 32000,
           tgt_vocab: int = 32000, max_len: int = 128, d_model: int = 512,
           d_inner: int = 2048, n_head: int = 8, n_layer: int = 6,
           dropout: float = 0.1, lr: float = 1e-4, warmup: int = 4000,
-          label_smooth_eps: float = 0.1, fused_attention: bool = False):
-    """Transformer-base training graph (Vaswani config: 512/2048/8/6)."""
+          label_smooth_eps: float = 0.1, fused_attention: bool = False,
+          fused_head: bool = False):
+    """Transformer-base training graph (Vaswani config: 512/2048/8/6).
+
+    fused_head routes the loss through layers.fused_linear_cross_entropy
+    (Pallas streaming kernel — the [N, V] logits never reach HBM). Off by
+    default for training: XLA's composed path runs the two grad matmuls
+    off the SAVED logits at ~peak MXU, so the kernel's recompute tax
+    outweighs its traffic savings at base dims (measured 47.8 vs 41.8
+    ms/step, bs128 v5e); it wins forward-only and when logits memory is
+    the constraint (large N·V)."""
     src = layers.data(name="src_ids", shape=[max_len, 1], dtype="int64")
     tgt = layers.data(name="tgt_ids", shape=[max_len, 1], dtype="int64")
     lbl = layers.data(name="lbl_ids", shape=[max_len, 1], dtype="int64")
-    logits = transformer(src, tgt, src_vocab, tgt_vocab, max_len, d_model,
-                         d_inner, n_head, n_layer,
-                         dropout if is_train else 0.0,
-                         fused_attention=fused_attention)
-    flat_logits = layers.reshape(logits, shape=[-1, tgt_vocab])
     flat_label = layers.reshape(lbl, shape=[-1, 1])
-    if label_smooth_eps and is_train:
+    eps = label_smooth_eps if is_train else 0.0
+    if fused_head:
+        # fused loss head: vocab projection + label-smoothed CE in one
+        # Pallas kernel — the [N, V] logits (0.5 GB bf16 at bs128) never
+        # reach HBM (layers.fused_linear_cross_entropy)
+        dec = transformer(src, tgt, src_vocab, tgt_vocab, max_len, d_model,
+                          d_inner, n_head, n_layer,
+                          dropout if is_train else 0.0,
+                          fused_attention=fused_attention, project=False)
+        flat_dec = layers.reshape(dec, shape=[-1, d_model])
+        loss_vec = layers.fused_linear_cross_entropy(
+            flat_dec, flat_label, tgt_vocab, label_smoothing=eps)
+    else:
+        logits = transformer(src, tgt, src_vocab, tgt_vocab, max_len,
+                             d_model, d_inner, n_head, n_layer,
+                             dropout if is_train else 0.0,
+                             fused_attention=fused_attention)
+        flat_logits = layers.reshape(logits, shape=[-1, tgt_vocab])
         # closed-form smoothing inside the CE op (no [N, V] one-hot
         # materialization — at V=32k the one_hot+label_smooth+soft CE
         # chain cost several full-width HBM passes)
         loss_vec = layers.softmax_with_cross_entropy(
-            flat_logits, flat_label, label_smoothing=label_smooth_eps)
-    else:
-        loss_vec = layers.softmax_with_cross_entropy(flat_logits, flat_label)
+            flat_logits, flat_label,
+            label_smoothing=eps) if eps else \
+            layers.softmax_with_cross_entropy(flat_logits, flat_label)
     loss = layers.mean(loss_vec)
     if is_train:
         # Adam + fixed LR for round 1 (Noam warmup scheduler in a later round)

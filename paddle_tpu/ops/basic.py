@@ -134,16 +134,17 @@ def _broadcast_y(x, y, axis):
 
 def _match_low_precision(x, y):
     """When one side is a low-precision activation (bf16/fp16) and the
-    other a smaller fp32 broadcast operand (a bias/scale parameter), cast
-    the parameter down instead of letting promotion lift the whole
-    activation to fp32 — keeps pure-bf16 AMP programs bf16 through
-    bias-adds (HBM bandwidth is the bottleneck, SURVEY §2 #16 TPU note).
-    Only applied to ops tagged __amp_match_dtype__ by rewrite_program_amp
-    (pure mode): a non-AMP program's deliberate fp32 promotion is kept."""
+    other fp32, cast the fp32 side DOWN instead of letting promotion lift
+    the result to fp32 — keeps pure-bf16 AMP programs bf16 through
+    bias-adds AND full-size mixes like residual adds (an fp32 residual
+    stream doubles the HBM traffic of every elementwise/norm op between
+    matmuls; measured on Transformer-base bs128 v5e). Only applied to ops
+    tagged __amp_match_dtype__ by rewrite_program_amp (pure mode): a
+    non-AMP program's deliberate fp32 promotion is kept."""
     lowp = (jnp.bfloat16, jnp.float16)
-    if (x.dtype in lowp and y.dtype == jnp.float32 and y.size < x.size):
+    if x.dtype in lowp and y.dtype == jnp.float32:
         y = y.astype(x.dtype)
-    elif (y.dtype in lowp and x.dtype == jnp.float32 and x.size < y.size):
+    elif y.dtype in lowp and x.dtype == jnp.float32:
         x = x.astype(y.dtype)
     return x, y
 

@@ -469,7 +469,14 @@ def _lookup_table(ctx, ins, attrs):
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
     out_shape = tuple(ids.shape[:-1] if ids.shape and ids.shape[-1] == 1 else ids.shape) + (w.shape[-1],)
-    return single(out.reshape(out_shape))
+    out = out.reshape(out_shape)
+    if attrs.get("__amp_keep_bf16__") and out.dtype == jnp.float32:
+        # pure-AMP: the embedding output STARTS the residual stream; left
+        # fp32 it poisons every downstream elementwise/norm op with 2x HBM
+        # traffic (master table stays fp32 in the Scope; the vjp casts the
+        # gradient back up before the scatter-add)
+        out = out.astype(jnp.bfloat16)
+    return single(out)
 
 
 # ---------------------------------------------------------------------------
@@ -556,6 +563,41 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     logp = jax.nn.log_softmax(logits, axis=-1)
     loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     return {"Loss": [loss], "Softmax": [jnp.exp(logp)]}
+
+
+@register_op("fused_linear_ce",
+             ref="composed: mul_op.cc + softmax_with_cross_entropy_op.cc "
+                 "(TPU-native fusion — the [N, V] logits never reach HBM)")
+def _fused_linear_ce(ctx, ins, attrs):
+    """X [N, D] @ W [D, V] -> label-smoothed CE Loss [N, 1]. Routes to the
+    Pallas streaming kernel (ops/pallas/fused_ce.py) when the dims tile;
+    otherwise emits the composed matmul + closed-form CE (identical
+    math)."""
+    from paddle_tpu.ops import pallas as pk
+    from paddle_tpu.ops.pallas import fused_ce as fce
+
+    x = first(ins, "X")
+    w = first(ins, "W")
+    label = first(ins, "Label")
+    eps = float(attrs.get("label_smoothing", 0.0))
+    ignore = attrs.get("ignore_index", -100)
+    if attrs.get("__amp_bf16__"):
+        x, w = _amp_cast(attrs, x, w)
+    n, d = x.shape
+    v = w.shape[1]
+    use_kernel = (pk.kernel_enabled(128, d) and fce.supported(n, d, v)) \
+        or (pk.interpret_mode()
+            and __import__("os").environ.get(
+                "PADDLE_TPU_FORCE_PALLAS", "0") == "1")
+    if use_kernel:
+        loss = fce.fused_linear_ce(x, w, label.reshape(-1), eps, ignore,
+                                   pk.interpret_mode())
+        return {"Loss": [loss]}
+    logits = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    outs = _softmax_with_cross_entropy(
+        ctx, {"Logits": [logits], "Label": [label]},
+        {"label_smoothing": eps, "ignore_index": ignore})
+    return {"Loss": outs["Loss"]}
 
 
 @register_op("sigmoid_cross_entropy_with_logits",
@@ -687,14 +729,21 @@ def _attention(ctx, ins, attrs):
         seed = jax.random.randint(ctx.step_key(), (1,), 0, 2 ** 31 - 1,
                                   dtype=jnp.int32)
 
+    layout = attrs.get("layout", "bhtd")
+    t_dim = 1 if layout == "bthd" else 2
+
     sp = attrs.get("sp", "auto")
     mesh = ctx.mesh
     sp_axis = getattr(ctx.dist, "sp_axis", None) if sp == "auto" else sp
     use_sp = (mesh is not None and sp_axis and sp_axis in mesh.axis_names
               and mesh.shape[sp_axis] > 1
-              and q.shape[2] % mesh.shape[sp_axis] == 0
-              and k.shape[2] % mesh.shape[sp_axis] == 0
-              and q.shape[2] == k.shape[2])
+              and q.shape[t_dim] % mesh.shape[sp_axis] == 0
+              and k.shape[t_dim] % mesh.shape[sp_axis] == 0
+              and q.shape[t_dim] == k.shape[t_dim])
+    if use_sp and layout == "bthd":
+        # the sequence-parallel schedules work on [B, H, T, D]; under sp
+        # the transpose cost is negligible next to the ring/all-to-all
+        q, k, v = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     if use_sp:
         if bias is not None:
             raise ValueError(
@@ -708,7 +757,10 @@ def _attention(ctx, ins, attrs):
                               head_axis=getattr(ctx.dist, "model_axis",
                                                 None),
                               dropout_p=dropout_p, seed=seed)
+        if layout == "bthd":
+            out = out.transpose(0, 2, 1, 3)
     else:
         out = ra.full_attention(q, k, v, causal=causal, scale=scale,
-                                bias=bias, dropout_p=dropout_p, seed=seed)
+                                bias=bias, dropout_p=dropout_p, seed=seed,
+                                layout=layout)
     return single(out)

@@ -32,7 +32,10 @@ def on_tpu() -> bool:
 
 
 def kernels_disabled() -> bool:
-    return os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "0") == "1"
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS", "0") == "1":
+        return True
+    from paddle_tpu import flags
+    return flags.get("disable_pallas")
 
 
 def interpret_mode() -> bool:
@@ -52,6 +55,7 @@ def kernel_enabled(min_align: int = 128, *dims) -> bool:
 
 from paddle_tpu.ops.pallas.flash_attention import (  # noqa: E402,F401
     flash_attention, flash_attention_lse, pick_blocks)
+from paddle_tpu.ops.pallas.fused_ce import fused_linear_ce  # noqa: E402,F401
 from paddle_tpu.ops.pallas.fused_rnn import (fused_gru_sequence,  # noqa: E402,F401
                                              fused_lstm_sequence)
 from paddle_tpu.ops.pallas.seqpool import masked_seqpool  # noqa: E402,F401

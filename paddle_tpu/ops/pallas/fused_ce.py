@@ -1,0 +1,236 @@
+"""Fused linear + softmax-cross-entropy kernel (Pallas TPU).
+
+The transformer's loss head is `fc(d_model → V) → softmax_with_cross_entropy`
+with V = 32k: composed, the [N, V] logits tensor (0.5 GB bf16 at N=8k)
+materializes in HBM and the softmax/CE/backward chain re-reads it ~4×
+(~2.6 GB, ~3 ms/step on v5e — measured via hlo_stats on Transformer-base
+bs128). This kernel streams vocab chunks through VMEM with an online
+log-sum-exp, so HBM never sees a logits tensor:
+
+- forward: one pass over vocab chunks per row block — chunk logits =
+  x·W_chunk on the MXU, running (max, sumexp, Σz, z_label); emits the
+  label-smoothed loss (identical closed form to
+  ops/nn_ops.py softmax_with_cross_entropy: lse − (1−eps)·z_y − eps·z̄)
+  and the lse.
+- backward: recomputes chunk logits (deterministic — same dot, same
+  inputs), forms dlogits = (softmax − target)·dloss in VMEM, and feeds the
+  two grad matmuls (dx, dW) directly — the flash-attention trade of FLOPs
+  for HBM applied to the classifier head (reference capability:
+  softmax_with_cross_entropy_op.cc fuses softmax+CE but still
+  materializes logits; this also fuses the projection).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _pick(n, cands):
+    return next((c for c in cands if n % c == 0), None)
+
+
+def supported(n, d, v):
+    """Tiling gate: all three dims must tile onto (8,128) hardware tiles."""
+    bn, bv = _blocks(n, v, d)
+    return bn is not None and d % 128 == 0 and bv is not None
+
+
+def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
+                m_scr, l_scr, zsum_scr, zlab_scr,
+                *, bn, bv, nv, smooth, ignore_index, vocab):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, -1e30)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        zsum_scr[:] = jnp.zeros_like(zsum_scr)
+        zlab_scr[:] = jnp.zeros_like(zlab_scr)
+
+    x = x_ref[...].astype(jnp.float32)                 # [BN, D]
+    w = w_ref[...].astype(jnp.float32)                 # [D, BV]
+    z = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m = m_scr[:]
+    m_new = jnp.maximum(m, jnp.max(z, axis=1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_scr[:] * alpha + jnp.sum(jnp.exp(z - m_new), axis=1,
+                                          keepdims=True)
+    zsum_scr[:] = zsum_scr[:] + jnp.sum(z, axis=1, keepdims=True)
+    # the label's logit lives in exactly one chunk
+    lab = lab_ref[...]                                 # [BN, 1] int32
+    labpos = lab - j * bv
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    zlab_scr[:] = zlab_scr[:] + jnp.sum(
+        jnp.where(cols == labpos, z, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == nv - 1)
+    def _():
+        lse = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
+        loss = (lse - (1.0 - smooth) * zlab_scr[:]
+                - smooth * zsum_scr[:] / vocab)
+        loss = jnp.where(lab == ignore_index, 0.0, loss)
+        loss_ref[...] = loss
+        lse_ref[...] = lse
+
+
+def _dlogits(z, lse, lab, g, j, bn, bv, smooth, ignore_index, vocab):
+    """(softmax − target)·dloss for one chunk — the single source of the
+    backward's dlogits, shared by the dx and dW kernels."""
+    p = jnp.exp(z - lse)
+    labpos = lab - j * bv
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    t = jnp.where(cols == labpos, 1.0 - smooth, 0.0) + smooth / vocab
+    dz = (p - t) * g
+    return jnp.where(lab == ignore_index, 0.0, dz)
+
+
+def _bwd_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, dw_ref,
+                dx_scr, *, bn, bv, nn, nv, smooth, ignore_index, vocab):
+    """Combined backward, grid (rows, vocab): ONE logits recompute per
+    tile feeds both grad matmuls. dx accumulates in VMEM scratch across
+    the inner vocab loop; dW accumulates into its HBM output window,
+    which is revisited once per row block (nn round-trips of D×BV —
+    with bn=2048 that's ~0.5 GB total, far below the [N, V] logits
+    traffic this kernel exists to avoid)."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        dx_scr[:] = jnp.zeros_like(dx_scr)
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    z = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    dz = _dlogits(z, lse_ref[...], lab_ref[...], g_ref[...], j,
+                  bn, bv, smooth, ignore_index, vocab)
+    dx_scr[:] = dx_scr[:] + jax.lax.dot_general(
+        dz, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [BN, D]
+    # per-row-block dW partial — each (i, j) grid step owns its own
+    # output window, so no window is ever revisited (revisit-accumulate
+    # read-modify-write gave wrong results on real TPU); partials sum
+    # outside the kernel (nn × D×V f32, ~0.5 GB at bn=1024 — still far
+    # below the [N, V] logits traffic avoided)
+    dw_ref[...] = jax.lax.dot_general(
+        x, dz, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dw_ref.dtype)[None]
+
+    @pl.when(j == nv - 1)
+    def _():
+        dx_ref[...] = dx_scr[:].astype(dx_ref.dtype)
+
+
+def _blocks(n, v, d=512):
+    # big row blocks amortize streaming W (and the dW window revisits);
+    # VMEM budget (16M scoped limit, double-buffered windows): per row
+    # block ~ x(2B) + dx scratch(4B) over d, plus z/dz chunks (4B each)
+    # over bv, plus the d×bv w/dw windows
+    bv = _pick(v, (1024, 512, 256, 128))
+    if bv is None:
+        return None, None
+    bn = next((c for c in (2048, 1024, 512, 256, 128)
+               if n % c == 0
+               and c * (6 * d + 8 * bv) + 6 * d * bv <= 8 * 2 ** 20),
+              None)
+    return bn, bv
+
+
+def _fwd(x, w, labels, smooth, ignore_index, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+    n, d = x.shape
+    v = w.shape[1]
+    bn, bv = _blocks(n, v, d)
+    if interpret:
+        bn, bv = bn or min(n, 8), bv or min(v, 8)
+    nv = v // bv
+    lab2 = labels.astype(jnp.int32).reshape(n, 1)
+    kern = functools.partial(_fwd_kernel, bn=bn, bv=bv, nv=nv,
+                             smooth=smooth, ignore_index=ignore_index,
+                             vocab=float(v))
+    loss, lse = pl.pallas_call(
+        kern,
+        grid=(n // bn, nv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bn, 1), jnp.float32)] * 4,
+        interpret=interpret,
+    )(x, w, lab2)
+    return loss, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_linear_ce(x, w, labels, label_smoothing=0.0, ignore_index=-100,
+                    interpret=False):
+    """x [N, D] @ w [D, V] → label-smoothed softmax CE loss [N, 1] without
+    materializing the [N, V] logits. Matches
+    ops/nn_ops.py softmax_with_cross_entropy (hard-label path) exactly."""
+    loss, _ = _fwd(x, w, labels, label_smoothing, ignore_index, interpret)
+    return loss
+
+
+def _vjp_fwd(x, w, labels, label_smoothing, ignore_index, interpret):
+    loss, lse = _fwd(x, w, labels, label_smoothing, ignore_index, interpret)
+    return loss, (x, w, labels, lse)
+
+
+def _vjp_bwd(label_smoothing, ignore_index, interpret, res, g):
+    from jax.experimental.pallas import tpu as pltpu
+    x, w, labels, lse = res
+    n, d = x.shape
+    v = w.shape[1]
+    bn, bv = _blocks(n, v, d)
+    if interpret:
+        bn, bv = bn or min(n, 8), bv or min(v, 8)
+    nn, nv = n // bn, v // bv
+    lab2 = labels.astype(jnp.int32).reshape(n, 1)
+    g2 = g.astype(jnp.float32).reshape(n, 1)
+
+    dx, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, bn=bn, bv=bv, nn=nn, nv=nv,
+                          smooth=label_smoothing,
+                          ignore_index=ignore_index, vocab=float(v)),
+        grid=(nn, nv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, bv), lambda i, j: (0, j)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, d, bv), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x.dtype),
+            jax.ShapeDtypeStruct((nn, d, v), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=interpret,
+    )(x, w, lab2, lse, g2)
+
+    import numpy as _np
+    dlab = _np.zeros(jnp.shape(labels), dtype=jax.dtypes.float0)
+    return dx, dw.sum(axis=0).astype(w.dtype), dlab
+
+
+fused_linear_ce.defvjp(_vjp_fwd, _vjp_bwd)

@@ -84,12 +84,24 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch) -> float:
         if out is None:
             return 0.0
         return 2.0 * _prod(out) * k
+    if op_type == "fused_linear_ce":
+        x, w = ishape("X"), ishape("W")
+        if x is None or w is None:
+            return 0.0
+        # model FLOPs of the fused projection (the backward's in-kernel
+        # logits recompute is implementation FLOPs, excluded by the
+        # module-docstring convention)
+        return 2.0 * _prod(x) * w[-1]
     if op_type == "attention":
-        q, k = ishape("Q"), ishape("K")  # [B, H, Tq, D], [B, H, Tk, D]
+        q, k = ishape("Q"), ishape("K")
         if q is None or k is None:
             return 0.0
-        b, h, tq, d = q[-4], q[-3], q[-2], q[-1]
-        tk = k[-2]
+        if attrs.get("layout") == "bthd":      # [B, Tq, H, D]
+            b, tq, h, d = q[-4], q[-3], q[-2], q[-1]
+            tk = k[-3]
+        else:                                  # [B, H, Tq, D]
+            b, h, tq, d = q[-4], q[-3], q[-2], q[-1]
+            tk = k[-2]
         # QK^T + PV, halved when causal masking skips half the square
         f = 2.0 * b * h * tq * tk * d * 2.0
         if attrs.get("causal"):

@@ -159,6 +159,22 @@ def test_ulysses_sp_dropout_matches_full():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_bthd_layout_matches_bhtd():
+    """layout='bthd' ([B,T,H,D] in/out, transpose folded into the einsum)
+    computes the same attention as the default layout, incl. dropout."""
+    q, k, v = _qkv(b=2, h=3, tq=8, tk=8, d=4)
+    seed = jnp.array([11], jnp.int32)
+    for kwargs in (dict(causal=True),
+                   dict(causal=False, dropout_p=0.3, seed=seed)):
+        ref = ra.full_attention(q, k, v, **kwargs)
+        out = ra.full_attention(q.transpose(0, 2, 1, 3),
+                                k.transpose(0, 2, 1, 3),
+                                v.transpose(0, 2, 1, 3),
+                                layout="bthd", **kwargs)
+        np.testing.assert_allclose(np.asarray(out.transpose(0, 2, 1, 3)),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
 def test_fused_transformer_no_warning_and_test_mode_clean():
     """The fused transformer no longer warns, and a test-mode program
     applies no attention dropout (clone(for_test) semantics)."""

@@ -200,7 +200,9 @@ def test_recommender_system():
         (l,) = exe.run(main, feed={"uid": u, "mid": m, "score": s},
                        fetch_list=[avg])
         losses.append(float(l))
-    assert losses[-1] < losses[0], losses
+    # single-batch losses are noisy (random mini-batches): compare averaged
+    # windows, not two individual batches
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses
 
 
 def test_label_semantic_roles_crf():

@@ -1,0 +1,133 @@
+"""fluid.evaluator (in-graph accumulating) + the matching fluid.metrics
+classes (reference: evaluator.py:44,126,217,298; metrics.py:359,566) —
+driven through short executor loops like the reference book tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_chunk_evaluator_in_graph():
+    B, T = 4, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = layers.data(name="pred", shape=[T], dtype="int64")
+        label = layers.data(name="label", shape=[T], dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.ChunkEvaluator(
+                pred, label, chunk_scheme="IOB", num_chunk_types=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ev.reset(exe)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        tags = rng.randint(0, 5, (B, T)).astype(np.int64)
+        exe.run(main, feed={"pred": tags, "label": tags}, fetch_list=[])
+    p, r, f1 = ev.eval(exe)
+    # identical predictions and labels -> perfect chunking scores
+    assert float(p[0]) == 1.0 and float(r[0]) == 1.0 and float(f1[0]) == 1.0
+
+    # different tags -> imperfect
+    ev.reset(exe)
+    for _ in range(3):
+        tags = rng.randint(0, 5, (B, T)).astype(np.int64)
+        other = rng.randint(0, 5, (B, T)).astype(np.int64)
+        exe.run(main, feed={"pred": tags, "label": other}, fetch_list=[])
+    p2, r2, f2 = ev.eval(exe)
+    assert 0.0 <= float(f2[0]) < 1.0
+
+
+def test_chunk_evaluator_reset_zeroes():
+    B, T = 2, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = layers.data(name="pred", shape=[T], dtype="int64")
+        label = layers.data(name="label", shape=[T], dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.ChunkEvaluator(
+                pred, label, chunk_scheme="IOB", num_chunk_types=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    tags = np.array([[1, 2, 0, 1, 2, 0]] * B, dtype=np.int64)
+    exe.run(main, feed={"pred": tags, "label": tags}, fetch_list=[])
+    assert ev.eval(exe)[2][0] == 1.0
+    ev.reset(exe)
+    p, r, f1 = ev.eval(exe)
+    assert float(p[0]) == 0.0 and float(f1[0]) == 0.0
+
+
+def test_edit_distance_evaluator():
+    B, T = 3, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = layers.data(name="hyp", shape=[T], dtype="int64")
+        ref = layers.data(name="ref", shape=[T], dtype="int64")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.EditDistance(hyp, ref)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ev.reset(exe)
+    h = np.array([[1, 2, 3, 4, 5]] * B, dtype=np.int64)
+    exe.run(main, feed={"hyp": h, "ref": h}, fetch_list=[])
+    avg, err = ev.eval(exe)
+    assert float(avg[0]) == 0.0 and float(err[0]) == 0.0
+    # one substitution per sequence -> distance 1, all erroneous
+    r2 = h.copy()
+    r2[:, 0] = 9
+    exe.run(main, feed={"hyp": h, "ref": r2}, fetch_list=[])
+    avg, err = ev.eval(exe)
+    assert abs(float(avg[0]) - 0.5) < 1e-6      # (0*B + 1*B) / 2B
+    assert abs(float(err[0]) - 0.5) < 1e-6
+
+
+def test_detection_map_evaluator():
+    B, D, G, C = 1, 4, 3, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = layers.data(name="det", shape=[D, 6], dtype="float32")
+        gl = layers.data(name="gl", shape=[G, 1], dtype="float32")
+        gb = layers.data(name="gb", shape=[G, 4], dtype="float32")
+        with pytest.warns(Warning):
+            ev = fluid.evaluator.DetectionMAP(det, gl, gb, class_num=C)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ev.reset(exe)
+    # perfect detections: same boxes, high confidence
+    boxes = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                      [0.2, 0.6, 0.4, 0.9]], np.float32)
+    gl_v = np.array([[1], [2], [1]], np.float32)[None]
+    gb_v = boxes[None]
+    det_v = np.concatenate(
+        [gl_v[0], np.full((G, 1), 0.9, np.float32), boxes],
+        axis=1)[None]
+    det_v = np.concatenate(
+        [det_v, np.full((B, D - G, 6), -1, np.float32)], axis=1)
+    exe.run(main, feed={"det": det_v, "gl": gl_v, "gb": gb_v},
+            fetch_list=[])
+    (m,) = ev.eval(exe)
+    assert float(m) > 0.99
+
+
+def test_metrics_chunk_evaluator():
+    m = fluid.metrics.ChunkEvaluator()
+    m.update(10, 8, 7)
+    m.update(np.array([5]), np.array([7]), np.array([4]))
+    p, r, f1 = m.eval()
+    assert abs(p - 11 / 15) < 1e-9
+    assert abs(r - 11 / 15) < 1e-9
+    assert abs(f1 - 11 / 15) < 1e-9
+    with pytest.raises(ValueError):
+        m.update("bad", 1, 1)
+    m.reset()
+    assert m.eval() == (0.0, 0.0, 0.0)
+
+
+def test_metrics_detection_map():
+    m = fluid.metrics.DetectionMAP()
+    with pytest.raises(ValueError):
+        m.eval()
+    m.update(0.5)
+    m.update(np.array([0.7]), weight=3)
+    assert abs(m.eval() - (0.5 + 2.1) / 4) < 1e-9

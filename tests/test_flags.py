@@ -1,0 +1,93 @@
+"""Unified flag registry (paddle_tpu.flags) — the reference's gflags
+re-export surface (python/paddle/fluid/__init__.py:125-163 __bootstrap__):
+typed defs, FLAGS_<name> env override, programmatic set/reset, and the
+runtime honoring the values."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+
+
+def test_defaults_and_types():
+    assert flags.get("check_nan_inf") is False
+    assert flags.get("debug_graphviz_path") == ""
+    assert isinstance(flags.get("eager_delete_tensor_gb"), float)
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "true")
+    assert flags.get("check_nan_inf") is True
+    monkeypatch.setenv("FLAGS_check_nan_inf", "0")
+    assert flags.get("check_nan_inf") is False
+
+
+def test_programmatic_set_wins_over_env(monkeypatch):
+    monkeypatch.setenv("FLAGS_benchmark", "0")
+    flags.set("benchmark", True)
+    try:
+        assert flags.get("benchmark") is True
+    finally:
+        flags.reset("benchmark")
+    assert flags.get("benchmark") is False
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(KeyError):
+        flags.get("no_such_flag")
+    with pytest.raises(KeyError):
+        flags.set("no_such_flag", 1)
+
+
+def test_bad_parse_warns_and_defaults(monkeypatch):
+    monkeypatch.setenv("FLAGS_eager_delete_tensor_gb", "not-a-float")
+    with pytest.warns(UserWarning):
+        assert flags.get("eager_delete_tensor_gb") == 0.0
+
+
+def test_check_nan_inf_honored_by_executor(monkeypatch):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[2], dtype="float32")
+        y = layers.log(x)        # log(-1) -> NaN
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bad = np.array([[-1.0, 2.0]], dtype=np.float32)
+    # off: runs fine (NaN in output)
+    (out,) = exe.run(main, feed={"x": bad}, fetch_list=[y])
+    assert np.isnan(out).any()
+    flags.set("check_nan_inf", True)
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed={"x": bad}, fetch_list=[y])
+    finally:
+        flags.reset("check_nan_inf")
+
+
+def test_benchmark_flag_prints(capsys):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.fill_constant([2], "float32", 1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    flags.set("benchmark", True)
+    try:
+        exe.run(main, fetch_list=[x])
+    finally:
+        flags.reset("benchmark")
+    assert "[FLAGS_benchmark]" in capsys.readouterr().out
+
+
+def test_flag_listing_module():
+    import subprocess
+    import sys
+    out = subprocess.run([sys.executable, "-m", "paddle_tpu.flags"],
+                         capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0
+    assert "FLAGS_check_nan_inf" in out.stdout
+    assert "FLAGS_debug_graphviz_path" in out.stdout

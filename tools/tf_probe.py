@@ -1,0 +1,47 @@
+"""Quick transformer config probe: ms/step + MFU for one config."""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def run(bs, fused, steps=10):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from bench import _device_batch
+    from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
+    from paddle_tpu.utils import flops as fm
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        loss, _, feed_specs = models.transformer.build(
+            is_train=True, max_len=64, src_vocab=32000, tgt_vocab=32000,
+            fused_attention=fused)
+        rewrite_program_amp(main_p)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    feeds = _device_batch(exe, feed_specs, bs)
+    out = exe.run(main_p, feed=feeds, fetch_list=[loss], iterations=steps,
+                  return_numpy=False)[0]
+    np.asarray(out)
+    out = exe.run(main_p, feed=feeds, fetch_list=[loss], iterations=steps,
+                  return_numpy=False)[0]
+    np.asarray(out)
+    t0 = time.time()
+    R = 3
+    for _ in range(R):
+        out = exe.run(main_p, feed=feeds, fetch_list=[loss],
+                      iterations=steps, return_numpy=False)[0]
+    lv = np.asarray(out)
+    dt = (time.time() - t0) / (R * steps)
+    f = fm.program_flops(main_p, bs)
+    print("bs%d fused=%d: %.1f ms/step, %.0f tok/s, MFU %.1f%%, loss %.3f"
+          % (bs, fused, dt * 1e3, bs * 64 / dt, f / dt / 197e12 * 100,
+             lv[-1]))
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]), sys.argv[2] == "1")
