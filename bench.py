@@ -104,7 +104,8 @@ def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None):
 
 
 def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
-              amp: bool = False, mesh=None, nhwc: bool = True):
+              amp: bool = False, mesh=None, nhwc: bool = True,
+              batch_merge: int = 0):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -156,6 +157,11 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         if nhwc:
             from paddle_tpu.contrib.layout import rewrite_program_nhwc
             rewrite_program_nhwc(main)
+    if batch_merge and batch_merge > 1:
+        # k-step gradient accumulation (multi_batch_merge_pass capability:
+        # fluid/batch_merge.py) — optimizer applies every k-th step on the
+        # k-step mean gradient
+        fluid.apply_batch_merge(main, startup, batch_merge)
 
     run_target = main
     n_chips = 1
@@ -324,6 +330,9 @@ def main():
     ap.add_argument("--steps", type=int, default=None,
                     help="device-side steps per dispatch chunk "
                          "(default: per-model table)")
+    ap.add_argument("--batch-merge", type=int, default=0,
+                    help="k-step gradient accumulation (the reference's "
+                         "multi_batch_merge_pass capability)")
     ap.add_argument("--infer", action="store_true",
                     help="benchmark the deployment/inference path "
                          "(save_inference_model -> AnalysisPredictor)")
@@ -345,7 +354,7 @@ def main():
     else:
         bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
         result = run_bench(args.model, bs, args.steps, amp=args.amp,
-                           nhwc=args.nhwc)
+                           nhwc=args.nhwc, batch_merge=args.batch_merge)
     print(json.dumps(result))
 
 

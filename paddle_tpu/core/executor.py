@@ -79,7 +79,7 @@ class Executor:
         return (dist.mesh, dist.data_axis, dist.model_axis, dist.sp_axis,
                 tuple(sorted((k, tuple(v))
                              for k, v in (dist.param_axes or {}).items())),
-                dist.reduce_strategy)
+                dist.reduce_strategy, getattr(dist, "auto_shard", True))
 
     def _compiled(self, program, feed_names, fetch_names, is_test: bool):
         desc = program.desc if hasattr(program, "desc") else program

@@ -335,11 +335,16 @@ class CompiledBlock:
         repl = NamedSharding(mesh, P())
         block = self.block
 
-        # params (and embedding tables) declared sharded, by regex or by the
-        # dist hint the embedding(is_distributed=True) layer recorded
+        # params (and embedding tables) sharded by explicit regex, by the
+        # dist hint the embedding(is_distributed=True) layer recorded, or
+        # by graph-derived role (DistributeConfig auto_shard: matmul/fc
+        # weights column-parallel, lookup tables row-sharded)
         param_specs = {}
         all_params = set()
-        for n in tuple(self.sig.state_names) + tuple(self.sig.const_names):
+        names = tuple(self.sig.state_names) + tuple(self.sig.const_names)
+        if hasattr(self.dist, "check_param_axes_matched"):
+            self.dist.check_param_axes_matched(names)
+        for n in names:
             axes = self.dist._axes_for(n, block)
             if axes is not None:
                 param_specs[n] = axes

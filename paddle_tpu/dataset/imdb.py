@@ -78,16 +78,25 @@ def _raw_tar():
     return p if os.path.exists(p) else None
 
 
+_WORD_DICT_CACHE = {}
+
+
 def word_dict():
     tar = _raw_tar()
     if tar is not None:
+        # deterministic for a given tarball — memoize so train()+test()
+        # don't each pay a full sequential walk of ~100k files
+        if tar in _WORD_DICT_CACHE:
+            return _WORD_DICT_CACHE[tar]
         # reference imdb.py:138: the corpus is the LABELED splits only —
         # ((pos)|(neg)); train/unsup and the urls_*.txt lists must not
         # contribute frequencies or the id ordering diverges
-        return build_dict(
+        wi = build_dict(
             tar,
             re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
             cutoff=150)
+        _WORD_DICT_CACHE[tar] = wi
+        return wi
     return {i: i for i in range(VOCAB_SIZE)}
 
 

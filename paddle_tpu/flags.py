@@ -66,7 +66,14 @@ def set(name: str, value):   # noqa: A001 - mirrors gflags SetCommandLineOption
     d = _DEFS.get(name)
     if d is None:
         raise KeyError(f"unknown flag {name!r}")
-    _OVERRIDES[name] = d.type(value) if value is not None else None
+    if value is None:
+        _OVERRIDES[name] = None
+    elif isinstance(value, str):
+        # same parsing as the FLAGS_* env path — set('benchmark', '0')
+        # must disable, not bool('0') == True
+        _OVERRIDES[name] = _parse(d, value)
+    else:
+        _OVERRIDES[name] = d.type(value)
 
 
 def reset(name: Optional[str] = None):

@@ -11,6 +11,7 @@ from paddle_tpu.fluid import optimizer, param_attr, regularizer, unique_name
 from paddle_tpu.fluid import (io, learning_rate_scheduler, metrics,
                               profiler)
 from paddle_tpu.fluid import evaluator
+from paddle_tpu.fluid.batch_merge import apply_batch_merge
 from paddle_tpu.fluid.data_feeder import DataFeeder
 from paddle_tpu.fluid.framework import (Program, default_main_program,
                                         default_startup_program,

@@ -1,0 +1,143 @@
+"""Gradient-accumulation (multi-batch-merge) program rewrite.
+
+Capability parity with the reference's multi_batch_merge_pass
+(reference: framework/ir/multi_batch_merge_pass.cc — repeats the
+forward/backward k times and applies the optimizer once on the merged
+gradients, for large effective batches that don't fit memory).
+
+TPU-native redesign: instead of cloning the graph k times (the
+reference's repeat_grad approach), the rewrite keeps ONE step graph and
+makes the optimizer CONDITIONAL — jit-friendly dataflow, no control-flow
+divergence between steps:
+
+  acc      += grad                    (persistable accumulator per grad)
+  counter  += 1
+  apply     = (counter % k == 0)      ([1] bool)
+  opt step runs on (acc / k) into fresh names
+  state     = select(apply, new, old) (params + every optimizer state)
+  acc       = acc * (1 - apply)       (zeroed after an apply step)
+
+Every k-th `exe.run` (or scan iteration under `iterations=N`) performs
+exactly one optimizer update on the k-step mean gradient; the others only
+accumulate. Equivalent to one big-batch step for mean-reduced losses
+(test_batch_merge.py asserts exact parity vs the 2x batch for SGD).
+
+Divergence from the reference, by design: batch_norm statistics see each
+micro-batch (the reference's repeated forward does too); in-graph lr
+schedulers advance per micro-step.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core import ir
+
+OPT_OP_TYPES = ("sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+                "decayed_adagrad", "ftrl", "rmsprop", "proximal_gd",
+                "proximal_adagrad", "lars_momentum")
+
+
+def _startup_fill(startup, name, shape, dtype, value):
+    blk = startup.desc.global_block
+    if not blk.has_var(name):
+        blk.add_var(ir.VarDesc(name=name, shape=list(shape), dtype=dtype,
+                               persistable=True))
+    blk.append_op(ir.OpDesc(
+        type="fill_constant", outputs={"Out": [name]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": value}))
+
+
+def apply_batch_merge(main_program, startup_program, k: int):
+    """Rewrite `main_program` (after minimize()) for k-step gradient
+    accumulation. Returns the number of optimizer ops rewritten."""
+    if k < 2:
+        return 0
+    blk = main_program.desc.global_block
+    opt_idxs = [i for i, op in enumerate(blk.ops)
+                if op.type in OPT_OP_TYPES]
+    if not opt_idxs:
+        raise ValueError("apply_batch_merge: no optimizer ops in the "
+                         "program — call minimize() first")
+
+    cnt = "batch_merge_step@BM"
+    blk.add_var(ir.VarDesc(name=cnt, shape=[1], dtype="float32",
+                           persistable=True))
+    _startup_fill(startup_program, cnt, [1], "float32", 0.0)
+
+    def op(type_, ins, outs, attrs=None):
+        return ir.OpDesc(type=type_, inputs=ins, outputs=outs,
+                         attrs=attrs or {})
+
+    # counter/apply-flag ops, emitted once before the first optimizer op
+    pre = [
+        op("fill_constant", {}, {"Out": ["one@BM"]},
+           {"shape": [1], "dtype": "float32", "value": 1.0}),
+        op("elementwise_add", {"X": [cnt], "Y": ["one@BM"]},
+           {"Out": ["cnt_new@BM"]}),
+        op("assign", {"X": ["cnt_new@BM"]}, {"Out": [cnt]}),
+        op("cast", {"X": ["cnt_new@BM"]}, {"Out": ["cnt_i@BM"]},
+           {"out_dtype": "int32"}),
+        op("fill_constant", {}, {"Out": ["k@BM"]},
+           {"shape": [1], "dtype": "int32", "value": float(k)}),
+        op("elementwise_mod", {"X": ["cnt_i@BM"], "Y": ["k@BM"]},
+           {"Out": ["rem@BM"]}),
+        op("fill_constant", {}, {"Out": ["zero_i@BM"]},
+           {"shape": [1], "dtype": "int32", "value": 0.0}),
+        op("equal", {"X": ["rem@BM"], "Y": ["zero_i@BM"]},
+           {"Out": ["apply@BM"]}),
+        op("cast", {"X": ["apply@BM"]}, {"Out": ["apply_f@BM"]},
+           {"out_dtype": "float32"}),
+        op("elementwise_sub", {"X": ["one@BM"], "Y": ["apply_f@BM"]},
+           {"Out": ["keep_f@BM"]}),
+    ]
+
+    new_ops = []
+    first_opt = opt_idxs[0]
+    n_rewritten = 0
+    for i, o in enumerate(blk.ops):
+        if i == first_opt:
+            new_ops.extend(pre)
+        if o.type not in OPT_OP_TYPES:
+            new_ops.append(o)
+            continue
+        gname = o.inputs["Grad"][0]
+        acc = gname + "@BM_ACC"
+        gvd = blk.var(gname) if blk.has_var(gname) else None
+        pshape = list((gvd.shape if gvd is not None and gvd.shape
+                       else blk.var(o.inputs["Param"][0]).shape) or [1])
+        blk.add_var(ir.VarDesc(name=acc, shape=pshape, dtype="float32",
+                               persistable=True))
+        _startup_fill(startup_program, acc, pshape, "float32", 0.0)
+        tag = f"@BM{n_rewritten}"
+        new_ops.append(op("elementwise_add", {"X": [acc], "Y": [gname]},
+                          {"Out": [f"gsum{tag}"]}))
+        new_ops.append(op("scale", {"X": [f"gsum{tag}"]},
+                          {"Out": [f"geff{tag}"]}, {"scale": 1.0 / k}))
+        o.inputs = dict(o.inputs)
+        o.inputs["Grad"] = [f"geff{tag}"]
+        # optimizer writes into fresh names; selects gate the commit
+        selects = []
+        new_outputs = {}
+        for slot, names in o.outputs.items():
+            fresh = []
+            for j, name in enumerate(names):
+                nn = f"{slot}{j}{tag}"
+                fresh.append(nn)
+                selects.append(op("select",
+                                  {"Condition": ["apply@BM"],
+                                   "X": [nn], "Y": [name]},
+                                  {"Out": [name]}))
+            new_outputs[slot] = fresh
+        o.outputs = new_outputs
+        new_ops.append(o)
+        new_ops.extend(selects)
+        new_ops.append(op("elementwise_mul",
+                          {"X": [f"gsum{tag}"], "Y": ["keep_f@BM"]},
+                          {"Out": [f"acc_new{tag}"]}))
+        new_ops.append(op("assign", {"X": [f"acc_new{tag}"]},
+                          {"Out": [acc]}))
+        n_rewritten += 1
+
+    blk.ops[:] = new_ops
+    main_program.desc.bump_version()
+    startup_program.desc.bump_version()
+    return n_rewritten

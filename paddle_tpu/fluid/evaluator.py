@@ -89,9 +89,6 @@ class ChunkEvaluator(Evaluator):
     def __init__(self, input, label, chunk_scheme, num_chunk_types,
                  excluded_chunk_types=None, seq_lens=None):
         super().__init__("chunk_eval")
-        main = framework.default_main_program()
-        if main.random_seed is None:
-            pass
         (precision, recall, f1,
          num_infer, num_label, num_correct) = layers.chunk_eval(
             input=input, label=label, chunk_scheme=chunk_scheme,
@@ -181,11 +178,15 @@ class DetectionMAP(Evaluator):
         self._accumulate(self.map_sum, cur)
         self._accumulate(self.batches,
                          layers.fill_constant([1], "float32", 1.0))
+        # accum_map is mAP-VALUED (running average), matching the
+        # reference contract (evaluator.py:298 returns accum_map) — not
+        # the raw sum
+        self.accum_map = layers.elementwise_div(self.map_sum, self.batches)
         self.cur_map = cur
         self.metrics.append(cur)
 
     def get_map_var(self):
-        return self.cur_map, self.map_sum
+        return self.cur_map, self.accum_map
 
     def eval(self, executor, eval_program=None):
         from paddle_tpu.core.scope import global_scope

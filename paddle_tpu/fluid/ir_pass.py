@@ -8,10 +8,20 @@ TPU-native scope note: the reference needs ~25 fusion passes because its
 interpreter executes ops one kernel at a time — fusion is the only way two
 ops share registers. Under XLA the compiler fuses automatically, so passes
 here exist for (a) *semantic* rewrites XLA cannot do (BN folding uses
-trained statistics; fc fusion changes the op-level program the transpilers
-and serializers see) and (b) diagnostics (graphviz). The Graph is a live
-view over a BlockDesc: mutations write through and graph_to_program is the
-identity (the reference needs an explicit round-trip pass)."""
+trained statistics; embedding_fc_lstm pre-multiplies weights; fc fusion
+changes the op-level program the transpilers and serializers see) and
+(b) diagnostics (graphviz). The Graph is a live view over a BlockDesc:
+mutations write through and graph_to_program is the identity (the
+reference needs an explicit round-trip pass).
+
+Documented divergence: attention_lstm_fuse_pass (ir/attention_lstm_fuse_
+pass.cc) matches one specific while-loop OCR subgraph; here the
+`attention_lstm` fused op is constructed directly (ops/lod_ops.py) and a
+DynamicRNN-built attention loop lowers to ONE lax.scan that XLA fuses —
+the interpreter-era motivation (escaping per-op dispatch inside the
+loop) does not exist under trace-once compilation. The gradient-
+accumulation rewrite (multi_batch_merge_pass.cc) lives in
+fluid/batch_merge.py as a conditional-optimizer dataflow rewrite."""
 
 from __future__ import annotations
 
@@ -270,4 +280,157 @@ class GraphToProgramPass(Pass):
     block view, so the round-trip is the identity."""
 
     def apply(self, graph: Graph) -> Graph:
+        return graph
+
+
+@register_pass("seqconv_eltadd_relu_fuse_pass")
+class SeqconvEltaddReluFusePass(Pass):
+    """sequence_conv + elementwise_add(bias) + relu →
+    fusion_seqconv_eltadd_relu (reference: ir/seqconv_eltadd_relu_fuse_pass.cc)
+    — an unfused user program reaches the fused emitter."""
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        for conv, add, relu in det.match_chain(
+                ["sequence_conv", "elementwise_add", "relu"]):
+            conv_out = conv.outputs["Out"][0]
+            if add.inputs.get("X", [None])[0] != conv_out:
+                continue
+            bias = add.inputs.get("Y", [None])[0]
+            if bias is None:
+                continue
+            bvd = (graph.block.var(bias)
+                   if graph.block.has_var(bias) else None)
+            bshape = list(bvd.shape or []) if bvd is not None else []
+            if len([d for d in bshape if d != 1]) > 1:
+                continue
+            fused = ir.OpDesc(
+                type="fusion_seqconv_eltadd_relu",
+                inputs={"X": list(conv.inputs["X"]),
+                        "Filter": list(conv.inputs["Filter"]),
+                        "Bias": [bias],
+                        **({"SeqLens": list(conv.inputs["SeqLens"])}
+                           if conv.inputs.get("SeqLens") else {})},
+                outputs={"Out": [relu.outputs["Out"][0]]},
+                attrs=dict(conv.attrs))
+            idx = graph.block.ops.index(conv)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([add, relu])
+        return graph
+
+
+@register_pass("fc_lstm_fuse_pass")
+class FcLstmFusePass(Pass):
+    """mul (the fc projection) [+ elementwise_add bias] + dynamic_lstm →
+    fusion_lstm (reference: ir/fc_lstm_fuse_pass.cc — the gate projection
+    folds into the recurrence's input)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        candidates = (det.match_chain(
+            ["mul", "elementwise_add", "dynamic_lstm"])
+            + det.match_chain(["mul", "dynamic_lstm"]))
+        seen = set()
+        for ops in candidates:
+            mul = ops[0]
+            if id(mul) in seen:
+                continue
+            lstm = ops[-1]
+            add = ops[1] if len(ops) == 3 else None
+            proj_out = (add or mul).outputs["Out"][0]
+            if lstm.inputs.get("Input", [None])[0] != proj_out:
+                continue
+            bias = None
+            if add is not None:
+                if lstm.inputs.get("Bias"):
+                    continue   # two gate biases — would need a combine op
+                if add.inputs.get("X", [None])[0] != mul.outputs["Out"][0]:
+                    continue
+                bias = add.inputs.get("Y", [None])[0]
+                # the add's Y must actually BE a gate bias (≤1 non-unit
+                # dim); a full [B,T,4D] activation add is not an fc bias
+                bvd = (graph.block.var(bias)
+                       if bias and graph.block.has_var(bias) else None)
+                bshape = list(bvd.shape or []) if bvd is not None else [0, 0]
+                if len([d for d in bshape if d != 1]) > 1:
+                    continue
+            elif lstm.inputs.get("Bias"):
+                bias = lstm.inputs["Bias"][0]
+            ins = {"X": list(mul.inputs["X"]),
+                   "WeightX": list(mul.inputs["Y"]),
+                   "WeightH": list(lstm.inputs["Weight"])}
+            if bias:
+                ins["Bias"] = [bias]
+            for slot in ("SeqLens", "H0", "C0"):
+                if lstm.inputs.get(slot):
+                    ins[slot] = list(lstm.inputs[slot])
+            fused = ir.OpDesc(
+                type="fusion_lstm", inputs=ins,
+                outputs={"Hidden": list(lstm.outputs["Hidden"]),
+                         **({"Cell": list(lstm.outputs["Cell"])}
+                            if lstm.outputs.get("Cell") else {})},
+                attrs=dict(lstm.attrs))
+            idx = graph.block.ops.index(mul)
+            graph.block.ops[idx] = fused
+            graph.remove_ops(([add] if add else []) + [lstm])
+            seen.add(id(mul))
+        return graph
+
+
+@register_pass("embedding_fc_lstm_fuse_pass")
+class EmbeddingFcLstmFusePass(Pass):
+    """lookup_table + mul + dynamic_lstm → fused_embedding_fc_lstm
+    (reference: ir/embedding_fc_lstm_fuse_pass.cc). The reference
+    pre-multiplies the embedding table by the gate projection at pass
+    time (W_combined = table @ Wx, computed from the scope's trained
+    values) so the runtime does one [V, 4D] gather instead of gather +
+    matmul — requires `scope` with initialized params."""
+
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        import numpy as np
+        if self.scope is None:
+            return graph
+        det = PatternDetector(graph)
+        for emb, mul, lstm in det.match_chain(
+                ["lookup_table", "mul", "dynamic_lstm"]):
+            if lstm.inputs.get("Input", [None])[0] != \
+                    mul.outputs["Out"][0]:
+                continue
+            if mul.inputs.get("X", [None])[0] != emb.outputs["Out"][0]:
+                continue
+            if emb.attrs.get("padding_idx", -1) is not None \
+                    and emb.attrs.get("padding_idx", -1) >= 0:
+                # the pre-multiplied table cannot represent the
+                # post-lookup zeroing of pad rows (combined[pad] =
+                # table[pad] @ Wx != 0) — keep the composed form
+                continue
+            table = emb.inputs["W"][0]
+            wx = mul.inputs["Y"][0]
+            tv, wv = self.scope.find_var(table), self.scope.find_var(wx)
+            if tv is None or wv is None:
+                continue
+            combined_name = f"{table}__matmul__{wx}"
+            combined = np.asarray(tv, np.float32) @ np.asarray(wv,
+                                                              np.float32)
+            graph.block.add_var(ir.VarDesc(
+                name=combined_name, shape=list(combined.shape),
+                dtype="float32", persistable=True))
+            self.scope.set_var(combined_name, combined)
+            ins = {"Ids": list(emb.inputs["Ids"]),
+                   "Embeddings": [combined_name],
+                   "WeightH": list(lstm.inputs["Weight"])}
+            for slot in ("Bias", "SeqLens", "H0", "C0"):
+                if lstm.inputs.get(slot):
+                    ins[slot] = list(lstm.inputs[slot])
+            fused = ir.OpDesc(
+                type="fused_embedding_fc_lstm", inputs=ins,
+                outputs={"Hidden": list(lstm.outputs["Hidden"]),
+                         **({"Cell": list(lstm.outputs["Cell"])}
+                            if lstm.outputs.get("Cell") else {})},
+                attrs=dict(lstm.attrs))
+            idx = graph.block.ops.index(emb)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([mul, lstm])
         return graph

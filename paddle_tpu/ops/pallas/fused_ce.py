@@ -128,6 +128,15 @@ def _bwd_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, dw_ref,
         dx_ref[...] = dx_scr[:].astype(dx_ref.dtype)
 
 
+def _interpret_blocks(n, v, bn, bv):
+    """Interpret-mode fallback blocks must still DIVIDE the dims — a
+    non-dividing block would silently drop trailing rows/columns from
+    the grid (code-review finding)."""
+    bn = bn or next(c for c in (8, 4, 2, 1) if n % c == 0)
+    bv = bv or next(c for c in (8, 4, 2, 1) if v % c == 0)
+    return bn, bv
+
+
 def _blocks(n, v, d=512):
     # big row blocks amortize streaming W (and the dW window revisits);
     # VMEM budget (16M scoped limit, double-buffered windows): per row
@@ -149,7 +158,7 @@ def _fwd(x, w, labels, smooth, ignore_index, interpret):
     v = w.shape[1]
     bn, bv = _blocks(n, v, d)
     if interpret:
-        bn, bv = bn or min(n, 8), bv or min(v, 8)
+        bn, bv = _interpret_blocks(n, v, bn, bv)
     nv = v // bv
     lab2 = labels.astype(jnp.int32).reshape(n, 1)
     kern = functools.partial(_fwd_kernel, bn=bn, bv=bv, nv=nv,
@@ -199,7 +208,7 @@ def _vjp_bwd(label_smoothing, ignore_index, interpret, res, g):
     v = w.shape[1]
     bn, bv = _blocks(n, v, d)
     if interpret:
-        bn, bv = bn or min(n, 8), bv or min(v, 8)
+        bn, bv = _interpret_blocks(n, v, bn, bv)
     nn, nv = n // bn, v // bv
     lab2 = labels.astype(jnp.int32).reshape(n, 1)
     g2 = g.astype(jnp.float32).reshape(n, 1)

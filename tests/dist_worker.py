@@ -21,22 +21,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np                             # noqa: E402
 
 
-def main():
-    rank = int(os.environ["PADDLE_TRAINER_ID"])
-    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
-
-    from paddle_tpu import distributed
-    distributed.init_parallel_env(
-        coordinator_address=os.environ["PADDLE_COORDINATOR"],
-        num_processes=nprocs, process_id=rank)
-
-    assert jax.process_count() == nprocs
-    n_global = len(jax.devices())
-    assert n_global == 2 * nprocs, n_global
-
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.parallel import DistributeConfig, make_mesh
-
+def _build_mlp(fluid):
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = 5
     with fluid.program_guard(main_p, startup):
@@ -45,23 +30,72 @@ def main():
         pred = fluid.layers.fc(fluid.layers.fc(x, 16, act="relu"), 1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
         fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8).astype(np.float32)
+    feed = {"x": xs, "y": xs.sum(axis=1, keepdims=True)
+            .astype(np.float32) * 0.25}
+    return main_p, startup, loss, feed
 
-    mesh = make_mesh({"dp": n_global})
-    compiled = fluid.CompiledProgram(main_p).with_sharding(
-        DistributeConfig(mesh=mesh, data_axis="dp"))
+
+def _build_transformer(fluid):
+    """Tiny Transformer (fused attention path, dropout 0 so local and
+    sharded runs are bit-comparable) — the reference's dist_transformer
+    model-parity subject (test_dist_base.py:257-286)."""
+    from paddle_tpu import models
+    V, T, B = 64, 8, 8
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        loss, _, feed_specs = models.transformer.build(
+            is_train=True, src_vocab=V, tgt_vocab=V, max_len=T,
+            d_model=16, d_inner=32, n_head=2, n_layer=2, dropout=0.0,
+            lr=1e-3, label_smooth_eps=0.1, fused_attention=True)
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randint(0, V, [B if d == -1 else d for d in sh])
+            .astype("int64") for n, (sh, dt) in feed_specs.items()}
+    return main_p, startup, loss, feed
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+    model = os.environ.get("PADDLE_TEST_MODEL", "mlp")
+    steps = int(os.environ.get("PADDLE_TEST_STEPS", "12"))
+    local_only = os.environ.get("PADDLE_LOCAL_BASELINE", "0") == "1"
+
+    if not local_only:
+        from paddle_tpu import distributed
+        distributed.init_parallel_env(
+            coordinator_address=os.environ["PADDLE_COORDINATOR"],
+            num_processes=nprocs, process_id=rank)
+        assert jax.process_count() == nprocs
+        n_global = len(jax.devices())
+        assert n_global == 2 * nprocs, n_global
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel import DistributeConfig, make_mesh
+
+    build = {"mlp": _build_mlp, "transformer": _build_transformer}[model]
+    main_p, startup, loss, feed = build(fluid)
+
+    if local_only:
+        # single-process, single-device reference run — the loss-curve
+        # parity bar the distributed run must meet (test_dist_base.py
+        # compares dist losses against the local model's)
+        run_target = main_p
+    else:
+        mesh = make_mesh({"dp": len(jax.devices())})
+        run_target = fluid.CompiledProgram(main_p).with_sharding(
+            DistributeConfig(mesh=mesh, data_axis="dp"))
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
 
     # every process feeds the SAME global batch (jit with in_shardings
     # splits it over the dp axis; each process computes its shard)
-    rng = np.random.RandomState(0)
-    xs = rng.rand(16, 8).astype(np.float32)
-    ys = xs.sum(axis=1, keepdims=True).astype(np.float32) * 0.25
     losses = []
-    for _ in range(12):
-        (lv,) = exe.run(compiled, feed={"x": xs, "y": ys},
-                        fetch_list=[loss.name])
+    for _ in range(steps):
+        (lv,) = exe.run(run_target, feed=feed, fetch_list=[loss.name])
         losses.append(float(np.asarray(lv).reshape(())))
     print("RESULT " + json.dumps({"rank": rank, "losses": losses}),
           flush=True)

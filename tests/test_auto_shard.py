@@ -1,0 +1,133 @@
+"""Graph-derived tensor-parallel shardings (round-1 verdict item 5).
+
+DistributeConfig.auto_shard resolves TP placement from op structure —
+matmul/fc weights column-parallel over model_axis, lookup tables
+row-sharded — replacing the name-regex table (reference analogue: the
+transpiler computed placement from the graph, distribute_transpiler.py
+slice_var_up, not from user-supplied names). Renaming a layer can no
+longer silently degrade TP to replication; an explicit regex that
+matches nothing now warns.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.parallel import DistributeConfig
+
+
+def _mesh(dp=2, tp=2):
+    devs = np.array(jax.devices()[:dp * tp]).reshape(dp, tp)
+    return Mesh(devs, ("dp", "tp"))
+
+
+def _build_mlp_emb():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[1], dtype="int64")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        emb = layers.embedding(ids, size=[16, 8],
+                               param_attr=fluid.ParamAttr(name="tbl"))
+        h = layers.fc(emb, size=8, act="relu",
+                      param_attr=fluid.ParamAttr(name="proj_w"))
+        logits = layers.fc(h, size=4,
+                           param_attr=fluid.ParamAttr(name="head_w"))
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_roles_derived_from_graph():
+    main, _, _ = _build_mlp_emb()
+    mesh = _mesh()
+    dist = DistributeConfig(mesh=mesh, data_axis="dp", model_axis="tp")
+    blk = main.desc.global_block
+    assert dist._axes_for("proj_w", blk) == (None, "tp")   # column-parallel
+    assert dist._axes_for("head_w", blk) == (None, "tp")
+    assert dist._axes_for("tbl", blk) == ("tp", None)      # row-sharded
+    # biases / non-params stay replicated
+    assert dist._axes_for("proj_w.b_0" if blk.has_var("proj_w.b_0")
+                          else "nonexistent", blk) is None
+
+
+def test_auto_shard_off_replicates():
+    main, _, _ = _build_mlp_emb()
+    dist = DistributeConfig(mesh=_mesh(), data_axis="dp", model_axis="tp",
+                            auto_shard=False)
+    blk = main.desc.global_block
+    assert dist._axes_for("proj_w", blk) is None
+
+
+def test_explicit_regex_overrides_derivation():
+    main, _, _ = _build_mlp_emb()
+    dist = DistributeConfig(mesh=_mesh(), data_axis="dp", model_axis="tp",
+                            param_axes={"proj_w": (None, None)})
+    blk = main.desc.global_block
+    assert dist._axes_for("proj_w", blk) == (None, None)
+    assert dist._axes_for("head_w", blk) == (None, "tp")
+
+
+def test_indivisible_dims_stay_replicated():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        layers.fc(x, size=5, param_attr=fluid.ParamAttr(name="odd_w"))
+    dist = DistributeConfig(mesh=_mesh(), data_axis="dp", model_axis="tp")
+    assert dist._axes_for("odd_w", main.desc.global_block) is None  # 5 % 2
+
+
+def test_training_step_shards_params_without_regexes():
+    """End-to-end: one training step on a dp×tp mesh with NO param_axes —
+    params land in the scope with the derived shardings and the loss is
+    finite; a later step consumes the sharded state."""
+    main, startup, loss = _build_mlp_emb()
+    mesh = _mesh()
+    dist = DistributeConfig(mesh=mesh, data_axis="dp", model_axis="tp")
+    cp = fluid.CompiledProgram(main).with_sharding(dist)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, 16, (8, 1)).astype(np.int64),
+            "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+    (l1,) = exe.run(cp, feed=feed, fetch_list=[loss])
+    (l2,) = exe.run(cp, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l1) and np.isfinite(l2) and float(l2) < float(l1)
+    from paddle_tpu.core.scope import global_scope
+    w = global_scope().find_var("proj_w")
+    assert w.sharding.is_equivalent_to(NamedSharding(mesh, P(None, "tp")),
+                                       2)
+    tbl = global_scope().find_var("tbl")
+    assert tbl.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("tp", None)), 2)
+
+
+def test_unmatched_regex_warns():
+    main, startup, loss = _build_mlp_emb()
+    dist = DistributeConfig(mesh=_mesh(), data_axis="dp", model_axis="tp",
+                            param_axes={r"fc_\d+\.w_\d+": (None, "tp")})
+    cp = fluid.CompiledProgram(main).with_sharding(dist)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, 16, (8, 1)).astype(np.int64),
+            "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+    with pytest.warns(UserWarning, match="matched no variable"):
+        exe.run(cp, feed=feed, fetch_list=[loss])
+
+
+def test_dryrun_multichip_regex_free():
+    """The driver's dryrun now runs with derivation only (the regex table
+    is deleted)."""
+    import __graft_entry__ as ge
+    import inspect
+    src = inspect.getsource(ge.dryrun_multichip)
+    assert "param_axes" not in src
+    ge.dryrun_multichip(8)
