@@ -7,9 +7,10 @@ inference."""
 from paddle_tpu.contrib import layout  # noqa: F401
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib import recompute  # noqa: F401
+from paddle_tpu.contrib import slim  # noqa: F401
 from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
 
 from paddle_tpu.contrib.quantize_transpiler import QuantizeTranspiler  # noqa: F401
 
 __all__ = ["BF16Transpiler", "Float16Transpiler", "QuantizeTranspiler",
-           "layout", "mixed_precision"]
+           "layout", "mixed_precision", "slim"]

@@ -1,0 +1,235 @@
+"""Model-compression framework (capability parity with the reference's
+contrib/slim: core/strategy.py Strategy callbacks, core/compress_pass.py
+CompressPass/Context orchestration, prune/pruner.py Magnitude/Ratio
+pruners, prune/prune_strategy.py Sensitive/PruneStrategy).
+
+TPU-native re-design: the reference computes zero-masks with in-graph
+layers (topk/less_than) and mutates scope tensors through a side program;
+here masks are computed host-side from the scope's device arrays and
+re-applied after each training step (mask-and-freeze magnitude pruning) —
+a scope-level transform, like contrib.float16's transpilers, with no
+per-step graph overhead. Sparsity survives optimizer updates because the
+strategy re-masks after every batch; for deployment the masked weights
+serialize as-is through fluid.io (dense-with-zeros, the reference's
+format too — neither stack had a sparse kernel path in this era).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Pruner:
+    """reference: slim/prune/pruner.py:21 — mask factory base."""
+
+    def prune(self, name: str, value: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class MagnitudePruner(Pruner):
+    """Zero-mask by |w| < threshold (reference: pruner.py:33)."""
+
+    def __init__(self, threshold: float):
+        self.threshold = float(threshold)
+
+    def prune(self, name, value):
+        return (np.abs(value) >= self.threshold).astype(value.dtype)
+
+
+class RatioPruner(Pruner):
+    """Keep the top `ratio` fraction of weights by magnitude (reference:
+    pruner.py:51 — `ratio=0.4` keeps 40%, zeroing the rest). Per-param
+    ratios with a '*' default, like the reference's ratios dict."""
+
+    def __init__(self, ratios: Optional[Dict[str, float]] = None):
+        self.ratios = ratios or {"*": 1.0}
+
+    def ratio_for(self, name: str) -> float:
+        return float(self.ratios.get(name, self.ratios.get("*", 1.0)))
+
+    def prune(self, name, value, ratio: Optional[float] = None):
+        rat = self.ratio_for(name) if ratio is None else float(ratio)
+        if rat >= 1.0:
+            return np.ones_like(value)
+        k = max(int(rat * value.size), 1)
+        flat = np.abs(value).reshape(-1)
+        thresh = np.partition(flat, -k)[-k]
+        return (np.abs(value) >= thresh).astype(value.dtype)
+
+
+class Strategy:
+    """reference: slim/core/strategy.py:18 — epoch/batch callbacks."""
+
+    def __init__(self, start_epoch=0, end_epoch=10):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compress_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_compress_end(self, context):
+        pass
+
+
+class Context:
+    """reference: slim/core/compress_pass.py:21 — compression state."""
+
+    def __init__(self, exe, program, scope):
+        self.epoch = 0
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.exe = exe
+        self.program = program
+        self.scope = scope
+
+
+class PruneStrategy(Strategy):
+    """Apply a pruner's masks to `params` at start_epoch and RE-APPLY
+    after every batch so the optimizer cannot regrow pruned weights
+    (reference: slim/prune/prune_strategy.py:38 PruneStrategy)."""
+
+    def __init__(self, pruner: Pruner, params: List[str],
+                 start_epoch=0, end_epoch=10):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner
+        self.params = list(params)
+        self.masks: Dict[str, np.ndarray] = {}
+
+    def _apply_masks(self, context):
+        import jax
+        for name, mask in self.masks.items():
+            v = context.scope.find_var(name)
+            if v is not None:
+                context.scope.set_var(
+                    name, jax.numpy.asarray(np.asarray(v) * mask))
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch and not self.masks:
+            for name in self.params:
+                v = context.scope.find_var(name)
+                if v is None:
+                    raise KeyError(f"PruneStrategy: param {name!r} not in "
+                                   f"scope — run the startup program first")
+                self.masks[name] = self.pruner.prune(name, np.asarray(v))
+            self._apply_masks(context)
+
+    def on_batch_end(self, context):
+        if self.masks and context.epoch_id >= self.start_epoch:
+            self._apply_masks(context)
+
+    def sparsity(self, context) -> Dict[str, float]:
+        out = {}
+        for name in self.params:
+            v = context.scope.find_var(name)
+            if v is not None:
+                a = np.asarray(v)
+                out[name] = float((a == 0).mean())
+        return out
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """Pick each param's keep-ratio by SENSITIVITY: sweep candidate
+    ratios, measure the eval-loss delta from pruning that param alone,
+    and keep the most aggressive ratio whose delta stays under
+    `max_loss_increase` (reference: prune_strategy.py:23 — its published
+    form delegated the schedule; the scan here is the capability)."""
+
+    def __init__(self, pruner: RatioPruner, params: List[str],
+                 eval_fn, candidate_ratios=(0.9, 0.7, 0.5, 0.3),
+                 max_loss_increase=0.05, start_epoch=0, end_epoch=10):
+        super().__init__(pruner, params, start_epoch, end_epoch)
+        self.eval_fn = eval_fn
+        self.candidates = sorted(candidate_ratios, reverse=True)
+        self.max_loss_increase = float(max_loss_increase)
+        self.chosen: Dict[str, float] = {}
+
+    def on_compress_begin(self, context):
+        import jax
+        base = float(self.eval_fn())
+        for name in self.params:
+            v = context.scope.find_var(name)
+            if v is None:
+                raise KeyError(
+                    f"SensitivePruneStrategy: param {name!r} not in "
+                    f"scope — run the startup program first")
+            orig = np.asarray(v).copy()
+            chosen = 1.0
+            # largest keep-ratio first; stop at the first ratio whose
+            # loss delta exceeds the budget (sensitivity is monotone)
+            for ratio in self.candidates:
+                mask = self.pruner.prune(name, orig, ratio=ratio)
+                context.scope.set_var(name, jax.numpy.asarray(orig * mask))
+                loss = float(self.eval_fn())
+                if loss - base <= self.max_loss_increase:
+                    chosen = ratio
+                else:
+                    break
+            context.scope.set_var(name, jax.numpy.asarray(orig))
+            self.chosen[name] = chosen
+        self.pruner.ratios = dict(self.pruner.ratios)
+        self.pruner.ratios.update(self.chosen)
+
+
+class Compressor:
+    """Training-loop orchestration (reference: compress_pass.py:45
+    CompressPass.apply): runs `epoch` epochs over `reader`, executing the
+    train program per batch and firing every strategy's callbacks."""
+
+    def __init__(self, place=None, reader=None, feeder=None, scope=None,
+                 epoch: int = 1):
+        import paddle_tpu.fluid as fluid
+        self.place = place or fluid.TPUPlace()
+        self.reader = reader
+        self.feeder = feeder
+        self.scope = scope
+        self.epoch = epoch
+        self.strategies: List[Strategy] = []
+
+    def add_strategy(self, strategy: Strategy):
+        self.strategies.append(strategy)
+        self.epoch = max(self.epoch, strategy.end_epoch)
+        return self
+
+    def run(self, program, fetch_list=None):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.core.scope import global_scope
+        exe = fluid.Executor(self.place)
+        scope = self.scope or global_scope()
+        context = Context(exe, program, scope)
+        context.epoch = self.epoch
+        for s in self.strategies:
+            s.on_compress_begin(context)
+        last_fetch = None
+        for epoch_id in range(self.epoch):
+            context.epoch_id = epoch_id
+            for s in self.strategies:
+                s.on_epoch_begin(context)
+            for batch_id, data in enumerate(self.reader()):
+                context.batch_id = batch_id
+                for s in self.strategies:
+                    s.on_batch_begin(context)
+                feed = self.feeder.feed(data) if self.feeder else data
+                last_fetch = exe.run(program, feed=feed,
+                                     fetch_list=fetch_list or [],
+                                     scope=scope)
+                for s in self.strategies:
+                    s.on_batch_end(context)
+            for s in self.strategies:
+                s.on_epoch_end(context)
+        for s in self.strategies:
+            s.on_compress_end(context)
+        return last_fetch

@@ -130,3 +130,76 @@ def cuda_profiler(output_file=None, output_mode=None, config=None):
     """reference: profiler.py:39 — nvprof passthrough; no TPU analogue
     (use trace_dir→TensorBoard instead). Accepted as a no-op for parity."""
     yield
+
+
+def device_op_stats(trace_dir: str, top: int = 0):
+    """Per-HLO-op DEVICE time attribution from a jax.profiler trace
+    captured via start_profiler(trace_dir=...) — the TPU delivery of the
+    reference's CUPTI DeviceTracer per-op device table
+    (platform/device_tracer.h:39 correlates device events back to ops;
+    here the XPlane protos are parsed through xprof's hlo_stats tool).
+
+    With multi-step device loops (exe.run iterations=N) host-side spans
+    can no longer attribute time per op — the whole window is one
+    dispatch; this is the device-side view that can. Returns rows of
+    {name, category, self_time_us, occurrences, flop_rate, bound_by,
+    bandwidth_gbs}, sorted by self time (top rows if top > 0)."""
+    import glob
+    import json as _json
+
+    try:
+        from xprof.convert import raw_to_tool_data as _rtd
+    except ImportError as e:                       # pragma: no cover
+        raise RuntimeError(
+            "device_op_stats needs the xprof package (baked into this "
+            "environment; pip install xprof elsewhere)") from e
+
+    run_dirs = sorted(glob.glob(trace_dir + "/plugins/profile/*"))
+    if not run_dirs:
+        raise FileNotFoundError(
+            f"no profile runs under {trace_dir!r} — call "
+            f"start_profiler(trace_dir=...) / stop_profiler first")
+    files = glob.glob(run_dirs[-1] + "/*.xplane.pb")
+    if not files:
+        raise FileNotFoundError(
+            f"profile run {run_dirs[-1]!r} has no .xplane.pb — the "
+            f"capture was interrupted before stop_profiler flushed it; "
+            f"re-capture the trace")
+    data, _ = _rtd.xspace_to_tool_data(files, "hlo_stats", {})
+    raw = _json.loads(data)
+    cols = [c["label"] for c in raw["cols"]]
+    idx = {c: i for i, c in enumerate(cols)}
+
+    def col(row, label, default=None):
+        cell = row["c"][idx[label]] if label in idx else None
+        return cell.get("v", default) if cell else default
+
+    rows = []
+    for r in raw["rows"]:
+        rows.append({
+            "name": col(r, "HLO op name", ""),
+            "category": col(r, "HLO op category", ""),
+            "self_time_us": float(col(r, "Total self time (us)", 0.0) or 0),
+            "occurrences": int(col(r, "#Occurrences", 0) or 0),
+            "flop_rate": col(r, "Model GFLOP/s"),
+            "bound_by": col(r, "Bound by"),
+            "bandwidth_gbs": col(r, "Measured memory BW (GiB/s)"),
+        })
+    rows.sort(key=lambda x: -x["self_time_us"])
+    return rows[:top] if top else rows
+
+
+def print_device_op_stats(trace_dir: str, top: int = 20):
+    """Sorted per-op device-time table (the reference's sorted profiler
+    report, but for DEVICE time — EventSortingKey profiler.h:114)."""
+    all_rows = device_op_stats(trace_dir)      # parse ONCE
+    total = sum(r["self_time_us"] for r in all_rows)
+    rows = all_rows[:top] if top else all_rows
+    print(f"{'HLO op':<44}{'Category':<22}{'Self(us)':>10}{'%':>7}"
+          f"{'Bound':>9}")
+    for r in rows:
+        pct = 100.0 * r["self_time_us"] / total if total else 0.0
+        print(f"{r['name'][:43]:<44}{r['category'][:21]:<22}"
+              f"{r['self_time_us']:>10.0f}{pct:>6.1f}%"
+              f"{str(r['bound_by'] or ''):>9}")
+    return rows

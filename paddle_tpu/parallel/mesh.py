@@ -113,18 +113,24 @@ class DistributeConfig:
     def _derived_roles(self, block):
         """Graph walk: {param name: axes} for params whose consumer ops
         mark them tensor-parallel candidates. Cached per block object."""
+        import weakref
         cache = getattr(self, "_roles_cache", None)
         if cache is None:
             cache = self._roles_cache = {}
-        # op count in the key guards against id() reuse after gc and
-        # against post-query block mutation (code-review finding)
-        key = (id(block), len(block.ops))
-        if key in cache:
-            return cache[key]
+        # id-keyed with a weakref GUARD (BlockDesc is unhashable, so no
+        # WeakKeyDictionary): the stored weakref must still point at this
+        # exact block — a new block allocated at a freed block's address
+        # fails the guard instead of aliasing stale roles (code-review
+        # finding); op count catches post-query mutation
+        hit = cache.get(id(block))
+        if (hit is not None and hit[0]() is block
+                and hit[1] == len(block.ops)):
+            return hit[2]
         roles: Dict[str, tuple] = {}
         ax, size = self._model_axis_size()
         if not self.auto_shard or not ax or size <= 1:
-            cache[key] = roles
+            cache[id(block)] = (weakref.ref(block), len(block.ops),
+                                roles)
             return roles
 
         def param_shape(n):
@@ -158,7 +164,7 @@ class DistributeConfig:
                 # capability on ICI (SURVEY §2 #24/#27)
                 if sh is not None and len(sh) == 2 and sh[0] % size == 0:
                     roles.setdefault(w, (ax, None))
-        cache[key] = roles
+        cache[id(block)] = (weakref.ref(block), len(block.ops), roles)
         return roles
 
     def check_param_axes_matched(self, names):
