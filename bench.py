@@ -56,7 +56,11 @@ DEFAULT_CHUNKS = {"alexnet": 128, "resnet50": 32, "transformer": 32,
                   "transformer_long": 32, "mnist": 512,
                   "stacked_dynamic_lstm": 128, "vgg": 16, "se_resnext": 32,
                   "machine_translation": 128, "deepfm": 512,
-                  "googlenet": 64, "smallnet": 512}
+                  # googlenet: XLA's compile of LONG scans over the
+                  # inception graph is pathological (>18 min at 64);
+                  # 8 compiles in ~30 s and the window still spans 64+
+                  # device steps
+                  "googlenet": 8, "smallnet": 512}
 
 
 def _time_chunks(run_chunk, fence, min_seconds=3.0, min_chunks=2,

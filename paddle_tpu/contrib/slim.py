@@ -108,14 +108,21 @@ class PruneStrategy(Strategy):
         self.pruner = pruner
         self.params = list(params)
         self.masks: Dict[str, np.ndarray] = {}
+        self._device_masks: Dict[str, object] = {}
 
     def _apply_masks(self, context):
-        import jax
+        import jax.numpy as jnp
         for name, mask in self.masks.items():
             v = context.scope.find_var(name)
             if v is not None:
-                context.scope.set_var(
-                    name, jax.numpy.asarray(np.asarray(v) * mask))
+                # device-side multiply with a device-resident mask — no
+                # per-batch host round-trip (the masks are tiny state;
+                # the WEIGHTS must not sync through the host every step)
+                dm = self._device_masks.get(name)
+                if dm is None or dm.dtype != jnp.asarray(v).dtype:
+                    dm = self._device_masks[name] = jnp.asarray(
+                        mask, dtype=jnp.asarray(v).dtype)
+                context.scope.set_var(name, jnp.asarray(v) * dm)
 
     def on_epoch_begin(self, context):
         if context.epoch_id == self.start_epoch and not self.masks:
@@ -190,18 +197,23 @@ class Compressor:
     train program per batch and firing every strategy's callbacks."""
 
     def __init__(self, place=None, reader=None, feeder=None, scope=None,
-                 epoch: int = 1):
+                 epoch: Optional[int] = None):
         import paddle_tpu.fluid as fluid
         self.place = place or fluid.TPUPlace()
         self.reader = reader
         self.feeder = feeder
         self.scope = scope
-        self.epoch = epoch
+        # an EXPLICIT epoch is the user's training length and wins; left
+        # unset, strategies' end_epoch extends the run (the reference's
+        # max() behavior, compress_pass.py add_strategy)
+        self._epoch_explicit = epoch is not None
+        self.epoch = epoch if epoch is not None else 1
         self.strategies: List[Strategy] = []
 
     def add_strategy(self, strategy: Strategy):
         self.strategies.append(strategy)
-        self.epoch = max(self.epoch, strategy.end_epoch)
+        if not self._epoch_explicit:
+            self.epoch = max(self.epoch, strategy.end_epoch)
         return self
 
     def run(self, program, fetch_list=None):

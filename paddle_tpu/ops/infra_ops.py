@@ -157,38 +157,58 @@ def _conv2d_inception_fusion(ctx, ins, attrs):
 @register_op("cudnn_lstm", ref="operators/cudnn_lstm_op.cc (capability; "
                               "packed-weight multi-layer LSTM)")
 def _cudnn_lstm(ctx, ins, attrs):
-    """Multi-layer unidirectional LSTM over packed weights. Input [T,B,D];
-    W flat: per layer [Wx (Din,4H) | Wh (H,4H) | b (4H)] concatenated.
-    (The reference packs cudnn's filter layout; this op defines the
-    TPU-native packing and runs each layer as one lax.scan.)"""
+    """Multi-layer LSTM over packed weights. Input [T,B,D]; W flat: per
+    layer, per direction, [Wx (Din,4H) | Wh (H,4H) | b (4H)] concatenated
+    (the reference packs cudnn's filter layout; this op defines the
+    TPU-native packing and runs each direction as one lax.scan).
+
+    is_bidirec=True runs forward and time-reversed backward passes per
+    layer and concatenates their hiddens on the feature axis ([T,B,2H] —
+    the cudnn bidirectional contract), so the next layer sees Din=2H;
+    per-layer final states stack to [num_layers*2, B, H] (fwd, bwd
+    interleaved per layer, cudnn's order)."""
     x = first(ins, "Input")              # [T, B, Din]
     w = first(ins, "W").reshape(-1)
     hidden = int(attrs["hidden_size"])
     layers = int(attrs.get("num_layers", 1))
-    if attrs.get("is_bidirec", False):
-        raise NotImplementedError("cudnn_lstm: bidirectional packing not "
-                                  "defined for the TPU layout yet")
+    bidirec = bool(attrs.get("is_bidirec", False))
     t, b, din = x.shape
     off = 0
     h_all = x
     spec = get_op("dynamic_lstm")
     last_hs, last_cs = [], []
-    for layer in range(layers):
-        d_in = din if layer == 0 else hidden
+
+    def run_dir(inp, d_in, off, reverse):
         wx = w[off:off + d_in * 4 * hidden].reshape(d_in, 4 * hidden)
         off += d_in * 4 * hidden
         wh = w[off:off + hidden * 4 * hidden].reshape(hidden, 4 * hidden)
         off += hidden * 4 * hidden
         bias = w[off:off + 4 * hidden].reshape(1, 4 * hidden)
         off += 4 * hidden
-        proj = jnp.einsum("tbd,dk->tbk", h_all, wx)
+        seq = inp[::-1] if reverse else inp
+        proj = jnp.einsum("tbd,dk->tbk", seq, wx)
         res = spec.emit(ctx, {"Input": [jnp.swapaxes(proj, 0, 1)],
                               "Weight": [wh], "Bias": [bias]}, {})
-        h_all = jnp.swapaxes(res["Hidden"][0], 0, 1)   # [T, B, H]
-        last_hs.append(res["LastHidden"][0])
-        last_cs.append(res["LastCell"][0])
-    # per-layer final states [num_layers, B, H] (cudnn_lstm LastH/LastC
-    # contract — feeding truncated-BPTT chunks needs every layer's state)
+        h = jnp.swapaxes(res["Hidden"][0], 0, 1)       # [T, B, H]
+        if reverse:
+            h = h[::-1]
+        return h, res["LastHidden"][0], res["LastCell"][0], off
+
+    for layer in range(layers):
+        d_in = h_all.shape[-1]
+        h_fwd, lh, lc, off = run_dir(h_all, d_in, off, reverse=False)
+        last_hs.append(lh)
+        last_cs.append(lc)
+        if bidirec:
+            h_bwd, lh, lc, off = run_dir(h_all, d_in, off, reverse=True)
+            last_hs.append(lh)
+            last_cs.append(lc)
+            h_all = jnp.concatenate([h_fwd, h_bwd], axis=-1)
+        else:
+            h_all = h_fwd
+    # per-layer final states [num_layers(*2), B, H] (cudnn_lstm
+    # LastH/LastC contract — feeding truncated-BPTT chunks needs every
+    # layer's state)
     return {"Out": [h_all],
             "last_h": [jnp.stack(last_hs, axis=0)],
             "last_c": [jnp.stack(last_cs, axis=0)]}

@@ -122,15 +122,21 @@ class DistributeConfig:
         # exact block — a new block allocated at a freed block's address
         # fails the guard instead of aliasing stale roles (code-review
         # finding); op count catches post-query mutation
-        hit = cache.get(id(block))
+        key = id(block)
+        hit = cache.get(key)
         if (hit is not None and hit[0]() is block
                 and hit[1] == len(block.ops)):
             return hit[2]
+
+        def _ref(b):
+            # evict on collection so a reused DistributeConfig doesn't
+            # accumulate dead entries across program rebuilds
+            return weakref.ref(b, lambda _r, _c=cache, _k=key:
+                               _c.pop(_k, None))
         roles: Dict[str, tuple] = {}
         ax, size = self._model_axis_size()
         if not self.auto_shard or not ax or size <= 1:
-            cache[id(block)] = (weakref.ref(block), len(block.ops),
-                                roles)
+            cache[key] = (_ref(block), len(block.ops), roles)
             return roles
 
         def param_shape(n):
@@ -164,7 +170,7 @@ class DistributeConfig:
                 # capability on ICI (SURVEY §2 #24/#27)
                 if sh is not None and len(sh) == 2 and sh[0] % size == 0:
                     roles.setdefault(w, (ax, None))
-        cache[id(block)] = (weakref.ref(block), len(block.ops), roles)
+        cache[key] = (_ref(block), len(block.ops), roles)
         return roles
 
     def check_param_axes_matched(self, names):

@@ -56,6 +56,30 @@ def _build_transformer(fluid):
     return main_p, startup, loss, feed
 
 
+def _build_sharded_table(fluid):
+    """Embedding-table model: the table row-shards over a CROSS-PROCESS
+    'tp' axis (auto_shard derives it from the lookup_table consumer) —
+    the pserver-sharded-table capability exercised over the process
+    boundary (SURVEY §2 #24/#27; reference test_dist_transpiler's
+    sharded-table path)."""
+    V, D, B = 64, 16, 16
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[V, D], param_attr=fluid.ParamAttr(name="big_table"))
+        pred = fluid.layers.fc(emb, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    ids_v = rng.randint(0, V, (B, 1)).astype(np.int64)
+    feed = {"ids": ids_v,
+            "y": (ids_v % 5).astype(np.float32)}
+    return main_p, startup, loss, feed
+
+
 def main():
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
@@ -75,7 +99,8 @@ def main():
     import paddle_tpu.fluid as fluid
     from paddle_tpu.parallel import DistributeConfig, make_mesh
 
-    build = {"mlp": _build_mlp, "transformer": _build_transformer}[model]
+    build = {"mlp": _build_mlp, "transformer": _build_transformer,
+             "sharded_table": _build_sharded_table}[model]
     main_p, startup, loss, feed = build(fluid)
 
     if local_only:
@@ -83,6 +108,17 @@ def main():
         # parity bar the distributed run must meet (test_dist_base.py
         # compares dist losses against the local model's)
         run_target = main_p
+    elif model == "sharded_table":
+        # tp × dp with tp MAJOR: the embedding table row-shards over a tp
+        # axis that SPANS the two processes (device order [p0d0, p0d1,
+        # p1d0, p1d1] reshaped (tp=2, dp=2) puts tp shard 0 on process 0
+        # and shard 1 on process 1 — each process holds half the table
+        # rows, the pserver placement); auto_shard derives the placement
+        # from the lookup_table consumer
+        n = len(jax.devices())
+        mesh = make_mesh({"tp": 2, "dp": n // 2})
+        run_target = fluid.CompiledProgram(main_p).with_sharding(
+            DistributeConfig(mesh=mesh, data_axis="dp", model_axis="tp"))
     else:
         mesh = make_mesh({"dp": len(jax.devices())})
         run_target = fluid.CompiledProgram(main_p).with_sharding(

@@ -124,6 +124,41 @@ def test_cudnn_lstm_packed():
     assert np.isfinite(out["__out_Out_0"]).all()
 
 
+def test_cudnn_lstm_bidirectional():
+    """Bidirectional packing: [T,B,2H] output whose forward half equals
+    the unidirectional run with the same fwd weights, and whose backward
+    half equals the time-reversed run with the bwd weights."""
+    t, b, d, h = 4, 2, 3, 5
+    rng = np.random.RandomState(1)
+    per_dir0 = [d * 4 * h, h * 4 * h, 4 * h]            # layer 0, one dir
+    w_fwd = (rng.rand(sum(per_dir0)) * 0.2 - 0.1).astype(np.float32)
+    w_bwd = (rng.rand(sum(per_dir0)) * 0.2 - 0.1).astype(np.float32)
+    w = np.concatenate([w_fwd, w_bwd])
+    x = _r(t, b, d, seed=3)
+
+    out = run_single_op("cudnn_lstm", {"Input": {"x": x}, "W": {"w": w}},
+                        attrs={"hidden_size": h, "num_layers": 1,
+                               "is_bidirec": True},
+                        out_slots=("Out", "last_h", "last_c"))
+    y = out["__out_Out_0"]
+    assert y.shape == (t, b, 2 * h)
+    assert out["__out_last_h_0"].shape == (2, b, h)
+
+    fwd = run_single_op("cudnn_lstm",
+                        {"Input": {"x": x}, "W": {"w": w_fwd}},
+                        attrs={"hidden_size": h, "num_layers": 1},
+                        out_slots=("Out", "last_h", "last_c"))
+    np.testing.assert_allclose(y[..., :h], fwd["__out_Out_0"], rtol=1e-5,
+                               atol=1e-6)
+    bwd = run_single_op("cudnn_lstm",
+                        {"Input": {"x": x[::-1].copy()},
+                         "W": {"w": w_bwd}},
+                        attrs={"hidden_size": h, "num_layers": 1},
+                        out_slots=("Out", "last_h", "last_c"))
+    np.testing.assert_allclose(y[..., h:], bwd["__out_Out_0"][::-1],
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_generate_proposal_labels_sampling():
     rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30], [0, 0, 9, 9],
                       [50, 50, 60, 60]]], np.float32)

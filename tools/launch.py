@@ -1,0 +1,130 @@
+"""Multi-process training launcher.
+
+Capability parity with the reference era's cluster launch scripts (the
+transpiler workflow started one process per trainer/pserver with
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM env roles; the later
+paddle.distributed.launch formalized it). TPU-native form: every worker
+is a TRAINER — there are no pserver processes to start (mesh sharding +
+ICI collectives replace them) — and the workers rendezvous through the
+jax.distributed coordination service that
+`paddle_tpu.distributed.init_parallel_env` contacts via the same env
+convention.
+
+    python tools/launch.py --nprocs 4 train.py --lr 0.1
+    python tools/launch.py --nprocs 2 --devices-per-proc 2 train.py
+
+The training script calls `paddle_tpu.distributed.init_parallel_env()`
+with no arguments; the launcher provides PADDLE_COORDINATOR,
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM (and, for CPU simulation,
+XLA_FLAGS device-count forcing). Worker stdout/stderr stream through
+with `[rank N]` prefixes; the first failure kills the remaining workers
+and sets the exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pump(stream, rank, out):
+    for line in iter(stream.readline, ""):
+        out.write(f"[rank {rank}] {line}")
+        out.flush()
+    stream.close()
+
+
+def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
+           coordinator: str = "", use_cpu: bool = False) -> int:
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    pumps = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env["PADDLE_COORDINATOR"] = coordinator
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        if use_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        if devices_per_proc:
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{devices_per_proc}").strip()
+        p = subprocess.Popen([sys.executable] + list(script_argv),
+                             env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        t = threading.Thread(target=_pump, args=(p.stdout, rank,
+                                                 sys.stdout), daemon=True)
+        t.start()
+        pumps.append(t)
+
+    exit_code = 0
+    try:
+        remaining = set(range(nprocs))
+        while remaining:
+            for rank in sorted(remaining):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                remaining.discard(rank)
+                if rc != 0:
+                    exit_code = rc
+                    print(f"[launch] rank {rank} exited with {rc}; "
+                          f"terminating the other workers",
+                          file=sys.stderr)
+                    for other in remaining:
+                        procs[other].terminate()
+            if remaining:
+                import time
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        exit_code = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in pumps:
+            t.join(timeout=5)
+    return exit_code
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch N coordinated training processes.")
+    ap.add_argument("--nprocs", type=int, default=2,
+                    help="number of worker processes (trainers)")
+    ap.add_argument("--devices-per-proc", type=int, default=0,
+                    help="force N virtual CPU devices per process "
+                         "(multi-host simulation on one machine)")
+    ap.add_argument("--coordinator", default="",
+                    help="host:port of the coordination service "
+                         "(default: a free local port)")
+    ap.add_argument("--use-cpu", action="store_true",
+                    help="force the cpu backend in workers")
+    ap.add_argument("script", help="training script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch(args.nprocs, [args.script] + args.script_args,
+                  devices_per_proc=args.devices_per_proc,
+                  coordinator=args.coordinator, use_cpu=args.use_cpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
