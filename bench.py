@@ -39,7 +39,7 @@ VGG19_XEON_IMG_S = 28.46        # IntelOptimizedPaddle.md:29-36, bs64
 DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 128,
                        "transformer": 128, "transformer_long": 2,
                        "mnist": 512, "stacked_dynamic_lstm": 64,
-                       "vgg": 64, "se_resnext": 32,
+                       "vgg": 64, "se_resnext": 64,
                        "machine_translation": 64,
                        "deepfm": 512, "googlenet": 128, "smallnet": 512}
 RESNET50_XEON_IMG_S = 81.69     # IntelOptimizedPaddle.md:39-46, bs64

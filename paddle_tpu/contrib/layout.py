@@ -39,6 +39,29 @@ AGNOSTIC = {
 from paddle_tpu.ops.basic import ELEMENTWISE_OPS as ELEMENTWISE
 
 
+def _bcast_kind(ys, axis):
+    """Classify an elementwise op's Y-broadcast against a rank-4 X — the
+    SINGLE source shared by the residency fixpoint and the tagging pass
+    (and mirrored by the emitter re-aims in ops/basic.py):
+    'scalar'  — rank-0/[1] Y, layout-free;
+    'chan'    — rank-1 [C] at axis=1 (re-aims to the last axis);
+    'bc'      — rank-2 [B, C] at axis=0 (squeeze-excitation gates,
+                re-aims to [B, 1, 1, C]);
+    'full'    — rank-4 Y (same-layout group constraint);
+    None      — positional broadcast the emitter cannot re-aim."""
+    if ys is None:
+        return None
+    if len(ys) == 0 or (len(ys) == 1 and ys[0] == 1):
+        return "scalar"
+    if len(ys) == 1 and axis == 1:
+        return "chan"
+    if len(ys) == 2 and axis == 0:
+        return "bc"
+    if len(ys) >= 4:
+        return "full"
+    return None
+
+
 def rewrite_program_nhwc(program=None):
     """Tag maximal NHWC regions in block 0. Returns #ops tagged."""
     from paddle_tpu.fluid import framework
@@ -123,25 +146,18 @@ def rewrite_program_nhwc(program=None):
                 yv = _var(y)
                 ys = yv.shape if (yv is not None
                                   and yv.shape is not None) else None
-                scalar = ys is not None and (len(ys) == 0
-                                             or (len(ys) == 1
-                                                 and ys[0] == 1))
-                chan_bcast = (ys is not None and len(ys) == 1
-                              and ys[0] != 1
-                              and op.attrs.get("axis", -1) == 1)
-                if scalar or chan_bcast:
-                    # scalar: layout-free; channel broadcast (axis=1): the
-                    # emitter re-aims it at the last axis under NHWC
+                kind = _bcast_kind(ys, op.attrs.get("axis", -1))
+                if kind in ("scalar", "chan", "bc"):
+                    # layout-free or emitter-re-aimable broadcasts
                     changed |= group_all_or_none([x, o])
-                elif ys is None or len(ys) < 4:
-                    # other broadcast patterns (axis=-1 trailing, rank-2/3
-                    # Y) target positional axes the emitter cannot re-aim:
+                elif kind is None:
+                    # positional broadcasts the emitter cannot re-aim:
                     # X/Out must stay NCHW
                     for n in (x, o):
                         if nhwc.get(n):
                             nhwc[n] = False
                             changed = True
-                else:
+                else:                       # 'full': same-layout group
                     changed |= group_all_or_none([x, y, o])
             else:
                 # unconvertible op: all its rank-4 vars must be NCHW
@@ -190,10 +206,15 @@ def rewrite_program_nhwc(program=None):
             x = (op.inputs.get("X") or [None])[0]
             y = (op.inputs.get("Y") or [None])[0]
             yv = _var(y)
-            if (nhwc.get(x) and yv is not None and yv.shape is not None
-                    and len(yv.shape) == 1 and yv.shape[0] != 1
-                    and op.attrs.get("axis", -1) == 1):
+            ys = yv.shape if (yv is not None
+                              and yv.shape is not None) else None
+            kind = _bcast_kind(ys, op.attrs.get("axis", -1))
+            if nhwc.get(x) and kind == "chan":
                 tags[oi] = {"__nhwc_bcast__": True}
+            elif nhwc.get(x) and kind == "bc":
+                # [B, C] gate at axis=0 broadcasts as [B, 1, 1, C] when X
+                # is NHWC-resident (squeeze-excitation)
+                tags[oi] = {"__nhwc_bcast_bc__": True}
         elif t == "concat":
             first_in = (op.inputs.get("X") or [None])[0]
             if nhwc.get(first_in) and op.attrs.get("axis", 0) == 1:

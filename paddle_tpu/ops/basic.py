@@ -164,6 +164,11 @@ def _register_elementwise(name, fn):
             # contrib.layout NHWC region: the channel (axis=1) broadcast
             # re-aims at the physical last axis
             y = y.reshape((1,) * (x.ndim - 1) + (-1,))
+        elif attrs.get("__nhwc_bcast_bc__") and y.ndim == 2:
+            # [B, C] at axis=0 over an NHWC-resident X: batch leads,
+            # channels re-aim at the physical last axis (SE gates)
+            y = y.reshape((y.shape[0],) + (1,) * (x.ndim - 2)
+                          + (y.shape[1],))
         else:
             y = _broadcast_y(x, y, attrs.get("axis", -1))
         if attrs.get("__amp_match_dtype__") \

@@ -322,6 +322,52 @@ def test_nhwc_layout_rewrite_exact_parity():
     np.testing.assert_array_equal(w_nchw, w_nhwc)
 
 
+def test_nhwc_layout_squeeze_excitation_parity():
+    """The SE gate multiply — elementwise_mul(x [B,C,H,W], gates [B,C],
+    axis=0) — stays inside the NHWC region (the emitter re-aims the gate
+    to [B,1,1,C]); the rewrite remains bit-exact AND the SE op no longer
+    falsifies residency (one full train step, fp32)."""
+    import numpy as np
+    from paddle_tpu.contrib.layout import rewrite_program_nhwc
+
+    def run_once(rewrite):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        startup.random_seed = 9
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup):
+            img = layers.data(name="img", shape=[8, 8, 8],
+                              dtype="float32")
+            lbl = layers.data(name="lbl", shape=[1], dtype="int64")
+            c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)
+            b = layers.batch_norm(c, act="relu")
+            pool = layers.pool2d(b, pool_type="avg", global_pooling=True)
+            sq = layers.fc(pool, size=4, act="relu")
+            gates = layers.fc(sq, size=8, act="sigmoid")
+            se = layers.elementwise_mul(b, gates, axis=0)
+            c2 = layers.conv2d(se, num_filters=8, filter_size=3, padding=1)
+            p2 = layers.pool2d(c2, pool_type="avg", global_pooling=True)
+            logits = layers.fc(p2, size=4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+            if rewrite:
+                rewrite_program_nhwc(main)
+                # the SE multiply got the re-aim tag (its X stayed NHWC)
+                assert any(op.attrs.get("__nhwc_bcast_bc__")
+                           for op in main.desc.global_block.ops
+                           if op.type == "elementwise_mul")
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup, scope=scope)
+            rng = np.random.RandomState(5)
+            feeds = {"img": rng.rand(2, 8, 8, 8).astype(np.float32),
+                     "lbl": rng.randint(0, 4, (2, 1)).astype(np.int64)}
+            lv, = exe.run(main, feed=feeds, fetch_list=[loss], scope=scope)
+        return float(np.asarray(lv).reshape(()))
+
+    assert run_once(False) == run_once(True)
+
+
 def test_nhwc_layout_untracked_and_fetch_boundaries():
     """Review regressions: (1) an agnostic op on the raw feed must not
     mark downstream convs in-ready (feed vars are fixed NCHW); (2) a
