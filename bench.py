@@ -38,10 +38,10 @@ VGG19_XEON_IMG_S = 28.46        # IntelOptimizedPaddle.md:29-36, bs64
 
 DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 128,
                        "transformer": 128, "transformer_long": 2,
-                       "mnist": 512, "stacked_dynamic_lstm": 64,
+                       "mnist": 2048, "stacked_dynamic_lstm": 64,
                        "vgg": 64, "se_resnext": 64,
                        "machine_translation": 64,
-                       "deepfm": 512, "googlenet": 128, "smallnet": 512}
+                       "deepfm": 2048, "googlenet": 128, "smallnet": 512}
 RESNET50_XEON_IMG_S = 81.69     # IntelOptimizedPaddle.md:39-46, bs64
 GOOGLENET_K40M_IMG_S = 128 / 1.149   # benchmark/README.md:44-49, bs128
                                      # 1149 ms/batch → ~111.4 img/s

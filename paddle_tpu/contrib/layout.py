@@ -39,6 +39,17 @@ AGNOSTIC = {
 from paddle_tpu.ops.basic import ELEMENTWISE_OPS as ELEMENTWISE
 
 
+def _op_bcast_kind(op, var_lookup):
+    """_bcast_kind over an elementwise OpDesc — the one extraction point
+    (Y slot, shape, axis attr) shared by the residency fixpoint and the
+    tagging pass, so the two can never classify the same op
+    differently."""
+    y = (op.inputs.get("Y") or [None])[0]
+    yv = var_lookup(y)
+    ys = yv.shape if (yv is not None and yv.shape is not None) else None
+    return _bcast_kind(ys, op.attrs.get("axis", -1))
+
+
 def _bcast_kind(ys, axis):
     """Classify an elementwise op's Y-broadcast against a rank-4 X — the
     SINGLE source shared by the residency fixpoint and the tagging pass
@@ -143,10 +154,7 @@ def rewrite_program_nhwc(program=None):
                 x = (op.inputs.get("X") or [None])[0]
                 y = (op.inputs.get("Y") or [None])[0]
                 o = (op.outputs.get("Out") or [None])[0]
-                yv = _var(y)
-                ys = yv.shape if (yv is not None
-                                  and yv.shape is not None) else None
-                kind = _bcast_kind(ys, op.attrs.get("axis", -1))
+                kind = _op_bcast_kind(op, _var)
                 if kind in ("scalar", "chan", "bc"):
                     # layout-free or emitter-re-aimable broadcasts
                     changed |= group_all_or_none([x, o])
@@ -204,11 +212,7 @@ def rewrite_program_nhwc(program=None):
                             "__nhwc_out_keep__": out_keep}
         elif t in ELEMENTWISE:
             x = (op.inputs.get("X") or [None])[0]
-            y = (op.inputs.get("Y") or [None])[0]
-            yv = _var(y)
-            ys = yv.shape if (yv is not None
-                              and yv.shape is not None) else None
-            kind = _bcast_kind(ys, op.attrs.get("axis", -1))
+            kind = _op_bcast_kind(op, _var)
             if nhwc.get(x) and kind == "chan":
                 tags[oi] = {"__nhwc_bcast__": True}
             elif nhwc.get(x) and kind == "bc":
