@@ -138,13 +138,28 @@ class Executor:
         feeds = {}
         dist_mode = cb.dist is not None and cb.dist.mesh is not None
         multi_host = dist_mode and jax.process_count() > 1
-        if stacked and multi_host:
-            raise NotImplementedError(
-                "iterations>1 with a list of feeds is single-host only; "
-                "pre-shard stacked global arrays on the producer side")
+
+        def stacked_sharding(name):
+            """Per-step feed sharding with the [iterations] axis
+            prepended (matches CompiledBlock._multi_fn's in_shardings)."""
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = cb.feed_sharding(name)
+            return NamedSharding(cb.dist.mesh, P(None, *sh.spec))
+
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
+            if stacked and multi_host:
+                # every process feeds the same stacked global batch; the
+                # callback slices this host's shard (same convention as
+                # the single-step multi-host path below)
+                arr = np.asarray(val)
+                if want is not None and str(arr.dtype) != want:
+                    arr = arr.astype(want)
+                sh = stacked_sharding(name)
+                feeds[name] = jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+                continue
             if isinstance(val, jax.Array) and multi_host:
                 want_sh = cb.feed_sharding(name)
                 if want is not None and str(val.dtype) != want:

@@ -53,15 +53,16 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch) -> float:
         names = outputs.get(slot) or []
         return _var_shape(block, names[0], batch) if names else None
 
-    if op_type in ("conv2d", "depthwise_conv2d"):
+    if op_type in ("conv2d", "depthwise_conv2d", "conv3d"):
         out = oshape("Output")
-        filt = ishape("Filter")          # [Cout, Cin/g, kh, kw]
+        filt = ishape("Filter")          # [Cout, Cin/g, *k]
         if out is None or filt is None:
             return 0.0
         return 2.0 * _prod(out) * _prod(filt[1:])
-    if op_type == "conv2d_transpose":
-        inp = ishape("Input")            # [N, Cin, H, W]
-        filt = ishape("Filter")          # [Cin, Cout/g, kh, kw]
+    if op_type in ("conv2d_transpose", "conv3d_transpose",
+                   "depthwise_conv2d_transpose"):
+        inp = ishape("Input")            # [N, Cin, *spatial]
+        filt = ishape("Filter")          # [Cin, Cout/g, *k]
         if inp is None or filt is None:
             return 0.0
         return 2.0 * _prod(inp) * _prod(filt[1:])

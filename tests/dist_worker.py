@@ -129,10 +129,18 @@ def main():
 
     # every process feeds the SAME global batch (jit with in_shardings
     # splits it over the dp axis; each process computes its shard)
-    losses = []
-    for _ in range(steps):
-        (lv,) = exe.run(run_target, feed=feed, fetch_list=[loss.name])
-        losses.append(float(np.asarray(lv).reshape(())))
+    if model == "mlp" and not local_only:
+        # exercise the multi-host MULTI-STEP path: the whole run is one
+        # device-side scan over a stacked feed list (exe.run iterations=N
+        # with global arrays built per process)
+        (lvs,) = exe.run(run_target, feed=[feed] * steps,
+                         fetch_list=[loss.name], iterations=steps)
+        losses = [float(v) for v in np.asarray(lvs).reshape(-1)]
+    else:
+        losses = []
+        for _ in range(steps):
+            (lv,) = exe.run(run_target, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
     print("RESULT " + json.dumps({"rank": rank, "losses": losses}),
           flush=True)
 

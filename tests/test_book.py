@@ -170,6 +170,45 @@ def test_word2vec():
     assert losses[-1] < losses[0], losses
 
 
+def test_sequence_conv_pool_text_classification():
+    """nets.sequence_conv_pool (reference: nets.py:248 — the text-conv
+    building block of book/test_understand_sentiment's conv net): trains
+    a tiny bag-of-windows classifier on padded sequences + SeqLens."""
+    V, T, B, D = 40, 12, 16, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 17
+    with fluid.program_guard(main, startup):
+        words = layers.data(name="words", shape=[T], dtype="int64")
+        sl = layers.data(name="sl", shape=[], dtype="int32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[V, D])
+        conv = fluid.nets.sequence_conv_pool(
+            emb, num_filters=16, filter_size=3, seq_lens=sl,
+            act="tanh", pool_type="max")
+        logits = layers.fc(conv, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    trigger = 7
+    losses = []
+    for _ in range(60):
+        w = rng.randint(0, V, (B, T)).astype(np.int64)
+        w[w == trigger] = trigger + 1          # scrub, then plant
+        lens = rng.randint(4, T + 1, (B,)).astype(np.int32)
+        y = rng.randint(0, 2, (B, 1)).astype(np.int64)
+        for i in range(B):
+            if y[i, 0]:
+                w[i, rng.randint(0, lens[i])] = trigger
+        # presence detection — the conv+max-pool sweet spot
+        (l,) = exe.run(main, feed={"words": w, "sl": lens, "label": y},
+                       fetch_list=[loss])
+        losses.append(float(l))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7, losses
+
+
 def test_recommender_system():
     """Embedding towers -> cos_sim -> square error
     (reference: book/test_recommender_system.py)."""
