@@ -97,7 +97,8 @@ class Executor:
             fetch_list: Optional[List[Any]] = None,
             feed_var_name: str = "feed", fetch_var_name: str = "fetch",
             scope: Optional[Scope] = None, return_numpy: bool = True,
-            use_program_cache: bool = True, iterations: int = 1):
+            use_program_cache: bool = True, iterations: int = 1,
+            stacked_feed: bool = False):
         """reference: executor.py:447 — same signature contract.
 
         iterations > 1 runs that many steps in ONE device-side loop
@@ -107,7 +108,14 @@ class Executor:
         scales with the number of parameter buffers. `feed` is either one
         batch dict (resident batch reused each step) or a list of
         `iterations` batch dicts (stacked and scanned). Fetches come back
-        stacked with a leading [iterations] axis."""
+        stacked with a leading [iterations] axis.
+
+        stacked_feed=True declares that `feed` is a DICT whose arrays
+        already carry the leading [iterations] axis (e.g. a device-built
+        batch-per-step tensor) — no host-side stacking. NOTE for
+        stateless (inference) programs: a RESIDENT batch reused across
+        the scan is loop-invariant and XLA computes the step once;
+        benchmark such programs with per-step data (stacked feeds)."""
         if program is None:
             from paddle_tpu.fluid import framework as fw
             program = fw.default_main_program()
@@ -127,6 +135,17 @@ class Executor:
             else:
                 feed = {n: np.stack([np.asarray(b[n]) for b in feed])
                         for n in feed[0]}
+        elif stacked_feed:
+            if iterations <= 1:
+                raise ValueError("stacked_feed=True requires iterations>1")
+            for n, v in (feed or {}).items():
+                shape = np.shape(v)
+                if not shape or shape[0] != iterations:
+                    raise ValueError(
+                        f"stacked_feed: {n!r} leading dim "
+                        f"{shape[0] if shape else '<scalar>'} != "
+                        f"iterations {iterations}")
+            stacked = True
         feed = feed or {}
 
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
@@ -150,13 +169,29 @@ class Executor:
             val = feed[name]
             want = cb.feed_dtype(name)
             if stacked and multi_host:
+                sh = stacked_sharding(name)
+                if isinstance(val, jax.Array):
+                    # mirror the single-step global-array contract below:
+                    # pass through when correctly sharded, refuse a
+                    # cross-host reshard, host-copy only addressable
+                    # committed arrays
+                    if want is not None and str(val.dtype) != want:
+                        val = val.astype(want)
+                    if val.sharding == sh:
+                        feeds[name] = val
+                        continue
+                    if not val.is_fully_addressable:
+                        raise ValueError(
+                            f"stacked feed {name!r} is a global jax.Array "
+                            f"with a different sharding than the program "
+                            f"expects ({val.sharding} vs {sh}); reshard "
+                            f"it on the producer side")
                 # every process feeds the same stacked global batch; the
                 # callback slices this host's shard (same convention as
                 # the single-step multi-host path below)
                 arr = np.asarray(val)
                 if want is not None and str(arr.dtype) != want:
                     arr = arr.astype(want)
-                sh = stacked_sharding(name)
                 feeds[name] = jax.make_array_from_callback(
                     arr.shape, sh, lambda idx, a=arr: a[idx])
                 continue
@@ -189,8 +224,13 @@ class Executor:
                 # single-device array doesn't clash with in_shardings
                 if want is not None and str(val.dtype) != want:
                     val = val.astype(want)
-                sh = (cb.feed_sharding(name)
-                      if dist_mode and not stacked else None)
+                sh = None
+                if dist_mode:
+                    # reshard device-side to the (stacked-aware) feed
+                    # sharding so a committed single-device array doesn't
+                    # clash with in_shardings
+                    sh = (stacked_sharding(name) if stacked
+                          else cb.feed_sharding(name))
                 if sh is not None:
                     val = jax.device_put(val, sh)
                 feeds[name] = val

@@ -53,14 +53,16 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
 
 
 def sequence_conv_pool(input, num_filters, filter_size, seq_lens=None,
-                       param_attr=None, act="sigmoid", pool_type="max"):
+                       param_attr=None, bias_attr=None, act="sigmoid",
+                       pool_type="max"):
     """reference: nets.py:248 sequence_conv_pool — context-window conv
     over a padded [B, T, D] sequence followed by a sequence pool (the
     text-classification building block; SeqLens masks padding in both
     halves, the LoD redesign's convention)."""
     conv = layers.sequence_conv(input, num_filters=num_filters,
                                 filter_size=filter_size, seq_lens=seq_lens,
-                                param_attr=param_attr, act=act)
+                                param_attr=param_attr, bias_attr=bias_attr,
+                                act=act)
     return layers.sequence_pool(conv, pool_type=pool_type,
                                 seq_lens=seq_lens)
 

@@ -130,6 +130,29 @@ def test_single_element_feed_list():
     assert np.isfinite(float(lv))
 
 
+def test_stacked_feed_dict():
+    """stacked_feed=True: a dict of arrays with the leading [iterations]
+    axis (device-built batch-per-step) scans without host stacking, and
+    per-step outputs track their distinct inputs (the benchmark's guard
+    against loop-invariant hoisting of stateless steps)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        s = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    stacked = np.arange(3 * 2 * 4, dtype=np.float32).reshape(3, 2, 4)
+    (out,) = exe.run(main, feed={"x": stacked}, fetch_list=[s],
+                     iterations=3, stacked_feed=True)
+    np.testing.assert_allclose(out, stacked.sum(axis=(1, 2)))
+    with pytest.raises(ValueError, match="leading dim"):
+        exe.run(main, feed={"x": stacked}, fetch_list=[s],
+                iterations=4, stacked_feed=True)
+    with pytest.raises(ValueError, match="iterations"):
+        exe.run(main, feed={"x": stacked[0]}, fetch_list=[s],
+                stacked_feed=True)
+
+
 def test_iterations_under_mesh():
     """Multi-step under a dp mesh: shardings thread through the scan."""
     import jax
