@@ -340,6 +340,11 @@ def main():
     ap.add_argument("--batch-merge", type=int, default=0,
                     help="k-step gradient accumulation (the reference's "
                          "multi_batch_merge_pass capability)")
+    ap.add_argument("--all", nargs="?", const="", default=None,
+                    metavar="M1,M2",
+                    help="sweep every model (or a comma list) printing one "
+                         "JSON line each; failures print an error line "
+                         "and the sweep continues")
     ap.add_argument("--infer", action="store_true",
                     help="benchmark the deployment/inference path "
                          "(save_inference_model -> AnalysisPredictor)")
@@ -350,6 +355,44 @@ def main():
                     default=True, help="disable the channels-last layout "
                     "rewrite (contrib.layout)")
     args = ap.parse_args()
+    if args.all is not None:
+        import subprocess
+        models_ = ([m for m in args.all.split(",") if m] if args.all
+                   else sorted(DEFAULT_BATCH_SIZES))
+        for m in models_:
+            # one subprocess per model: a fresh backend per run keeps a
+            # pathological compile (googlenet-style) or OOM from taking
+            # the whole sweep down. Every non-sweep flag forwards.
+            cmd = [sys.executable, __file__, "--model", m]
+            if not args.amp:
+                cmd.append("--no-amp")
+            if not args.nhwc:
+                cmd.append("--no-nhwc")
+            if args.infer:
+                cmd.append("--infer")
+            if args.batch_size:
+                cmd += ["--batch-size", str(args.batch_size)]
+            if args.steps:
+                cmd += ["--steps", str(args.steps)]
+            if args.batch_merge:
+                cmd += ["--batch-merge", str(args.batch_merge)]
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=1200)
+                lines = [l for l in r.stdout.splitlines()
+                         if l.startswith("{")]
+                ok = r.returncode == 0 and lines
+                err = r.stderr[-300:]
+            except subprocess.TimeoutExpired:
+                ok, err = False, "timeout after 1200s"
+            if ok:
+                print(lines[-1], flush=True)
+            else:
+                print(json.dumps({"metric": f"{m} train throughput",
+                                  "value": None, "unit": None,
+                                  "vs_baseline": None, "error": err}),
+                      flush=True)
+        return
     if args.infer:
         infer_bs = {"resnet50": 16, "vgg": 1, "googlenet": 16}
         if args.model not in infer_bs:

@@ -219,16 +219,14 @@ class Executor:
             if isinstance(val, jax.Array):
                 # already on device (e.g. a prefetched pipeline batch or a
                 # benchmark-resident tensor) — keep it device-side, but
-                # still honour the declared dtype and, under a mesh, reshard
-                # (device-to-device) to the feed's sharding so a committed
-                # single-device array doesn't clash with in_shardings
+                # still honour the declared dtype and, under a mesh,
+                # reshard (device-to-device) to the stacked-aware feed
+                # sharding so a committed single-device array doesn't
+                # clash with in_shardings
                 if want is not None and str(val.dtype) != want:
                     val = val.astype(want)
                 sh = None
                 if dist_mode:
-                    # reshard device-side to the (stacked-aware) feed
-                    # sharding so a committed single-device array doesn't
-                    # clash with in_shardings
                     sh = (stacked_sharding(name) if stacked
                           else cb.feed_sharding(name))
                 if sh is not None:
