@@ -34,9 +34,14 @@ def _pick(n, cands):
 
 
 def supported(n, d, v):
-    """Tiling gate: all three dims must tile onto (8,128) hardware tiles."""
+    """Tiling gate: all three dims must tile onto (8,128) hardware tiles,
+    and the backward's dW-partials buffer (nn x DxV f32, summed outside the
+    kernel) must stay within the [N, V] bf16 logits traffic the kernel
+    exists to avoid — otherwise the composed path is the better program."""
     bn, bv = _blocks(n, v, d)
-    return bn is not None and d % 128 == 0 and bv is not None
+    if bn is None or bv is None or d % 128 != 0:
+        return False
+    return (n // bn) * d * v * 4 <= n * v * 2
 
 
 def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
@@ -138,18 +143,24 @@ def _interpret_blocks(n, v, bn, bv):
 
 
 def _blocks(n, v, d=512):
-    # big row blocks amortize streaming W (and the dW window revisits);
-    # VMEM budget (16M scoped limit, double-buffered windows): per row
-    # block ~ x(2B) + dx scratch(4B) over d, plus z/dz chunks (4B each)
-    # over bv, plus the d×bv w/dw windows
-    bv = _pick(v, (1024, 512, 256, 128))
-    if bv is None:
-        return None, None
-    bn = next((c for c in (2048, 1024, 512, 256, 128)
-               if n % c == 0
-               and c * (6 * d + 8 * bv) + 6 * d * bv <= 8 * 2 ** 20),
-              None)
-    return bn, bv
+    # big row blocks amortize streaming W AND set the backward's dW-partials
+    # buffer size (nn = n/bn row blocks each emit a DxV f32 partial), so
+    # (bn, bv) are picked JOINTLY to maximize bn — a greedy largest-bv pick
+    # shrinks bn and at pow2 vocabs ballooned the partials to 4x the logits
+    # the kernel avoids (advisor finding, round 2). VMEM budget (16M scoped
+    # limit, double-buffered windows): per row block ~ x(2B) + dx scratch
+    # (4B) over d, plus z/dz chunks (4B each) over bv, plus d×bv w/dw.
+    best = (None, None)
+    for bv in (1024, 512, 256, 128):
+        if v % bv:
+            continue
+        bn = next((c for c in (2048, 1024, 512, 256, 128)
+                   if n % c == 0
+                   and c * (6 * d + 8 * bv) + 6 * d * bv <= 8 * 2 ** 20),
+                  None)
+        if bn is not None and (best[0] is None or bn > best[0]):
+            best = (bn, bv)
+    return best
 
 
 def _fwd(x, w, labels, smooth, ignore_index, interpret):

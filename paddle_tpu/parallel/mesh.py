@@ -146,6 +146,32 @@ class DistributeConfig:
                     return v.shape
             return None
 
+        kinds: Dict[str, str] = {}
+
+        def propose(w, axes, kind):
+            prev = roles.get(w)
+            if prev is None:
+                roles[w] = axes
+                kinds[w] = kind
+                return
+            if prev == axes:
+                return
+            # one param consumed in conflicting roles (e.g. a tied
+            # embedding used as both lookup table and projection weight):
+            # the table role wins — row sharding serves the lookup's
+            # gather AND stays a valid (if transposed) split for the
+            # matmul under GSPMD — and the user is told
+            import warnings
+            prev_kind = kinds.get(w)
+            if kind == "table" and prev_kind != "table":
+                roles[w] = axes
+                kinds[w] = kind
+            warnings.warn(
+                f"auto_shard: parameter {w!r} is consumed in conflicting "
+                f"roles ({prev_kind} vs {kind}); keeping the "
+                f"{kinds[w]} sharding {roles[w]}. Set param_axes to "
+                f"override.", stacklevel=4)
+
         for op in block.ops:
             ins = op.inputs
             if op.type in ("mul", "matmul"):
@@ -154,14 +180,21 @@ class DistributeConfig:
                 # column-parallel: shard the OUTPUT features; XLA/GSPMD
                 # propagates the activation sharding and inserts the
                 # collectives (scaling-book recipe: annotate params, let
-                # the partitioner place the comms)
-                if sh is not None and len(sh) == 2 and sh[1] % size == 0:
-                    roles.setdefault(w, (None, ax))
+                # the partitioner place the comms). A transposed weight
+                # [out, in] keeps its output features on dim 0 — sharding
+                # dim 1 there would split the contraction (still correct
+                # under GSPMD, but silently row-parallel; advisor finding).
+                tr = bool(op.attrs.get("transpose_Y")
+                          or op.attrs.get("transpose_y"))
+                out_dim = 0 if tr else 1
+                if sh is not None and len(sh) == 2 \
+                        and sh[out_dim] % size == 0:
+                    propose(w, (ax, None) if tr else (None, ax), "matmul")
             elif op.type in ("fc", "fused_linear_ce"):
                 w = (ins.get("W") or [None])[0]
                 sh = param_shape(w)
                 if sh is not None and len(sh) == 2 and sh[1] % size == 0:
-                    roles.setdefault(w, (None, ax))
+                    propose(w, (None, ax), "matmul")
             elif op.type in ("lookup_table", "lookup_sparse_table",
                              "fused_embedding_seq_pool"):
                 w = (ins.get("W") or [None])[0]
@@ -169,7 +202,7 @@ class DistributeConfig:
                 # row(vocab)-sharded table — the pserver-sharded-table
                 # capability on ICI (SURVEY §2 #24/#27)
                 if sh is not None and len(sh) == 2 and sh[0] % size == 0:
-                    roles.setdefault(w, (ax, None))
+                    propose(w, (ax, None), "table")
         cache[key] = (_ref(block), len(block.ops), roles)
         return roles
 
