@@ -2,15 +2,21 @@
 
 Mirrors the reference's fluid_benchmark CLI capability
 (reference: benchmark/fluid/fluid_benchmark.py:139 train_parallel — reports
-images/sec or words/sec averaged over steps) on TPU. Prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu_pct": N}.
+images/sec or words/sec averaged over steps) on TPU.
+
+DEFAULT (no --model): the FULL sweep — one JSON line per model row (12
+train + 3 infer) as each finishes, then one aggregate JSON line
+{"metric": "full sweep ...", "value": <headline resnet50 img/s>,
+ "unit": ..., "vs_baseline": N, "mfu_pct": N, "rows": [...]}
+whose rows[] carry the whole table with mfu_pct filled per row.
+`--model X` runs one row; `--headline` is the resnet50-only shortcut.
 
 Headline config: ResNet-50 train bs=128 amp-bf16 nhwc — the BASELINE.md
 north-star row (ResNet-50 MFU on v5e). vs_baseline is img/s over the
 reference's published 2S-Xeon MKL number (81.69 img/s,
 IntelOptimizedPaddle.md:39-46). mfu_pct uses analytic model FLOPs at
 2 FLOPs/MAC with backward = 2x forward (paddle_tpu/utils/flops.py) over
-the chip's peak bf16 FLOP/s.
+the chip's peak bf16 FLOP/s; while/scan sub-blocks count body x trips.
 
 Timing runs device-side: exe.run(..., iterations=chunk) scans the whole
 training step in one dispatch (core/lowering.py run_steps), so host/tunnel
@@ -327,7 +333,7 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet50",
+    ap.add_argument("--model", default=None,
                     choices=["alexnet", "resnet50", "transformer",
                              "transformer_long", "mnist",
                              "stacked_dynamic_lstm", "vgg", "se_resnext",
@@ -345,6 +351,9 @@ def main():
                     help="sweep every model (or a comma list) printing one "
                          "JSON line each; failures print an error line "
                          "and the sweep continues")
+    ap.add_argument("--headline", action="store_true",
+                    help="run only the headline resnet50 row (the pre-r3 "
+                         "default; the default is now the full sweep)")
     ap.add_argument("--infer", action="store_true",
                     help="benchmark the deployment/inference path "
                          "(save_inference_model -> AnalysisPredictor)")
@@ -355,44 +364,72 @@ def main():
                     default=True, help="disable the channels-last layout "
                     "rewrite (contrib.layout)")
     args = ap.parse_args()
+
+    def run_one_subprocess(m, infer=False):
+        # one subprocess per model: a fresh backend per run keeps a
+        # pathological compile (googlenet-style) or OOM from taking
+        # the whole sweep down. Every non-sweep flag forwards.
+        cmd = [sys.executable, __file__, "--model", m]
+        if not args.amp:
+            cmd.append("--no-amp")
+        if not args.nhwc:
+            cmd.append("--no-nhwc")
+        if infer:
+            cmd.append("--infer")
+        if args.batch_size:
+            cmd += ["--batch-size", str(args.batch_size)]
+        if args.steps:
+            cmd += ["--steps", str(args.steps)]
+        if args.batch_merge:
+            cmd += ["--batch-merge", str(args.batch_merge)]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1200)
+            lines = [l for l in r.stdout.splitlines()
+                     if l.startswith("{")]
+            ok = r.returncode == 0 and lines
+            err = r.stderr[-300:]
+        except subprocess.TimeoutExpired:
+            ok, err = False, "timeout after 1200s"
+        if ok:
+            row = json.loads(lines[-1])
+        else:
+            row = {"metric": f"{m} {'infer' if infer else 'train'} "
+                             f"throughput", "value": None, "unit": None,
+                   "vs_baseline": None, "error": err}
+        print(json.dumps(row), flush=True)
+        return row
+
+    import subprocess
     if args.all is not None:
-        import subprocess
         models_ = ([m for m in args.all.split(",") if m] if args.all
                    else sorted(DEFAULT_BATCH_SIZES))
         for m in models_:
-            # one subprocess per model: a fresh backend per run keeps a
-            # pathological compile (googlenet-style) or OOM from taking
-            # the whole sweep down. Every non-sweep flag forwards.
-            cmd = [sys.executable, __file__, "--model", m]
-            if not args.amp:
-                cmd.append("--no-amp")
-            if not args.nhwc:
-                cmd.append("--no-nhwc")
-            if args.infer:
-                cmd.append("--infer")
-            if args.batch_size:
-                cmd += ["--batch-size", str(args.batch_size)]
-            if args.steps:
-                cmd += ["--steps", str(args.steps)]
-            if args.batch_merge:
-                cmd += ["--batch-merge", str(args.batch_merge)]
-            try:
-                r = subprocess.run(cmd, capture_output=True, text=True,
-                                   timeout=1200)
-                lines = [l for l in r.stdout.splitlines()
-                         if l.startswith("{")]
-                ok = r.returncode == 0 and lines
-                err = r.stderr[-300:]
-            except subprocess.TimeoutExpired:
-                ok, err = False, "timeout after 1200s"
-            if ok:
-                print(lines[-1], flush=True)
-            else:
-                print(json.dumps({"metric": f"{m} train throughput",
-                                  "value": None, "unit": None,
-                                  "vs_baseline": None, "error": err}),
-                      flush=True)
+            run_one_subprocess(m, infer=args.infer)
         return
+    if args.model is None and not args.headline and not args.infer:
+        # DEFAULT: the FULL sweep — every train model plus the three
+        # deployment-path rows, one JSON line each as they finish, then
+        # one aggregate line (driver schema + rows[]) so the driver
+        # artifact substantiates the whole table (round-2 verdict item 2;
+        # reference: fluid_benchmark.py:139 reports every model).
+        rows = [run_one_subprocess(m) for m in sorted(DEFAULT_BATCH_SIZES)]
+        rows += [run_one_subprocess(m, infer=True)
+                 for m in ("resnet50", "vgg", "googlenet")]
+        head = next((r for r in rows if r.get("value") is not None
+                     and r["metric"].startswith("resnet50 train")),
+                    next((r for r in rows if r.get("value") is not None),
+                         rows[0]))
+        n_ok = sum(1 for r in rows if r.get("value") is not None)
+        print(json.dumps({
+            "metric": f"full sweep ({n_ok}/{len(rows)} rows; headline: "
+                      f"{head['metric']})",
+            "value": head.get("value"), "unit": head.get("unit"),
+            "vs_baseline": head.get("vs_baseline"),
+            "mfu_pct": head.get("mfu_pct"), "rows": rows}))
+        return
+    if args.model is None:
+        args.model = "resnet50"
     if args.infer:
         infer_bs = {"resnet50": 16, "vgg": 1, "googlenet": 16}
         if args.model not in infer_bs:

@@ -122,6 +122,14 @@ class Executor:
         scope = scope or global_scope()
         fetch_list = fetch_list or []
 
+        # BuildStrategy IR passes run once, right before compilation —
+        # the reference's BuildStrategy::Apply moment (CompiledProgram
+        # carries the strategy; the pass pipeline bumps the program
+        # version so the executable cache recompiles)
+        apply_bs = getattr(program, "_apply_build_strategy", None)
+        if apply_bs is not None:
+            apply_bs(scope)
+
         stacked = isinstance(feed, (list, tuple))
         if stacked:
             if len(feed) != iterations:

@@ -12,24 +12,60 @@ over the global batch, so no explicit ScaleLossGrad op exists.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 from paddle_tpu.parallel.mesh import DistributeConfig, get_default_mesh, make_mesh
 
 
 @dataclass
 class BuildStrategy:
-    """reference: build_strategy.h:34 — accepted knobs; TPU-meaningful ones
-    map onto DistributeConfig, the rest are no-ops under XLA (fusion and
-    memory-reuse passes are the compiler's job here)."""
+    """reference: build_strategy.h:34. The fuse_* knobs select IR passes
+    (fluid/ir_pass.py) that BuildStrategy::Apply-style run over the program
+    before lowering (reference wiring: details/build_strategy.h:113 —
+    CreatePassesFromStrategy). Memory-reuse knobs are XLA's job and no-op.
+    On a training program (post-minimize) only grad-aware passes apply;
+    the rest warn and skip — the reference draws the same line between its
+    BuildStrategy pipeline and the inference Analysis pipeline."""
 
     reduce_strategy: str = "all_reduce"          # kAllReduce | kReduce
     gradient_scale_strategy: str = "coeff_one"   # loss scaling is implicit
     memory_optimize: bool = False
     enable_inplace: bool = False
-    fuse_elewise_add_act_ops: bool = False
+    fuse_elewise_add_act_ops: bool = False       # grad-aware
+    fuse_fc_ops: bool = False                    # mul+add(+relu) → fc
+    fuse_conv_ops: bool = False                  # conv epilogues → conv2d_fusion
+    fuse_seq_ops: bool = False                   # seqpool/seqconv/seq_concat_fc/tfc
+    fuse_rnn_ops: bool = False                   # fc_lstm/fc_gru/embedding_fc_lstm
     debug_graphviz_path: str = ""
+    # explicit pass pipeline prefix (PassBuilder escape hatch, reference
+    # compiler.py BuildStrategy._create_passes_from_strategy)
+    ir_passes: List[str] = field(default_factory=list)
+
+    def pass_names(self) -> List[str]:
+        names = list(self.ir_passes)
+        if self.fuse_elewise_add_act_ops:
+            names.append("fuse_elewise_add_act_pass")
+        # rnn/seq fusions must run BEFORE fc_fuse: their patterns start at
+        # the mul+add gate projection that fc_fuse would consume
+        # (reference pipeline keeps the same order for the same reason)
+        if self.fuse_rnn_ops:
+            names += ["embedding_fc_lstm_fuse_pass", "fc_lstm_fuse_pass",
+                      "fc_gru_fuse_pass"]
+        if self.fuse_seq_ops:
+            names += ["seqconv_eltadd_relu_fuse_pass",
+                      "seqpool_concat_fuse_pass",
+                      "seq_concat_fc_fuse_pass",
+                      "transpose_flatten_concat_fuse_pass"]
+        if self.fuse_conv_ops:
+            names += ["conv_elementwise_add2_act_fuse_pass",
+                      "conv_elementwise_add_act_fuse_pass",
+                      "conv_elementwise_add_fuse_pass"]
+        if self.fuse_fc_ops:
+            names.append("fc_fuse_pass")
+        if self.debug_graphviz_path:
+            names.append("graph_viz_pass")
+        return names
 
 
 @dataclass
@@ -91,3 +127,45 @@ class CompiledProgram:
         axes) — the capability superset of the transpiler modes."""
         self._dist = dist
         return self
+
+    def with_build_strategy(self, build_strategy: BuildStrategy):
+        """Attach a BuildStrategy without data-parallel execution (e.g. a
+        single-chip program that wants the fusion passes)."""
+        self.build_strategy = build_strategy
+        return self
+
+    def _apply_build_strategy(self, scope=None):
+        """Run the strategy's IR-pass pipeline over the program, once —
+        called by the Executor before (re)compiling, the moment the
+        reference runs BuildStrategy::Apply (parallel_executor.cc:191).
+        Scope-dependent folds (conv_bn, conv_affine_channel,
+        embedding_fc_lstm) see the startup-initialized params."""
+        bs = self.build_strategy
+        if bs is None or getattr(self, "_passes_applied", False):
+            return
+        self._passes_applied = True
+        names = bs.pass_names()
+        if not names:
+            return
+        from paddle_tpu.fluid import ir_pass as irp
+        block = self._program.desc.global_block
+        has_vjp = any(op.type == "__vjp__" for op in block.ops)
+        applied = []
+        for name in names:
+            p = irp.get_pass(name)
+            if has_vjp and not getattr(p, "grad_aware", False):
+                import warnings
+                warnings.warn(
+                    f"BuildStrategy: pass {name!r} is not grad-aware and "
+                    f"the program has backward ops — skipped. Apply it "
+                    f"before minimize(), or to the inference program.",
+                    stacklevel=3)
+                continue
+            p.scope = scope
+            if name == "graph_viz_pass":
+                p.path = bs.debug_graphviz_path or None
+            p(irp.Graph(block))
+            applied.append(name)
+        if applied:
+            self._program.desc.bump_version()
+        return applied

@@ -95,9 +95,13 @@ class PatternDetector:
     def __init__(self, graph: Graph):
         self.graph = graph
 
-    def match_chain(self, op_types: List[str], single_use: bool = True):
+    def match_chain(self, op_types: List[str], single_use: bool = True,
+                    ignore_vjp: bool = False):
         """Yield lists of OpDescs [op0, op1, ...] where op_{i}'s first
-        output feeds op_{i+1} and (optionally) has no other consumer."""
+        output feeds op_{i+1} and (optionally) has no other consumer.
+        ignore_vjp=True discounts `__vjp__` consumers in the single-use
+        check — grad-aware passes rewrite those backward ops alongside
+        the forward chain, so they are not 'other users'."""
         matches = []
         for node in self.graph.op_nodes:
             if node.op.type != op_types[0]:
@@ -109,6 +113,9 @@ class PatternDetector:
                 nxt = None
                 for v in out_vars:
                     cons = v.outputs
+                    if ignore_vjp:
+                        cons = [c for c in cons
+                                if c.op.type != "__vjp__"]
                     if single_use and len(cons) != 1:
                         continue
                     if cons and cons[0].op.type == want:
@@ -262,6 +269,8 @@ class ConvBnFusePass(Pass):
 class GraphVizPass(Pass):
     """reference: ir/graph_viz_pass.cc + FLAGS_debug_graphviz_path."""
 
+    grad_aware = True   # read-only diagnostic — safe on any program
+
     path: Optional[str] = None
 
     def apply(self, graph: Graph) -> Graph:
@@ -278,6 +287,8 @@ class GraphVizPass(Pass):
 class GraphToProgramPass(Pass):
     """reference: ir/graph_to_program_pass.cc — the Graph here IS a live
     block view, so the round-trip is the identity."""
+
+    grad_aware = True
 
     def apply(self, graph: Graph) -> Graph:
         return graph
@@ -433,4 +444,514 @@ class EmbeddingFcLstmFusePass(Pass):
             idx = graph.block.ops.index(emb)
             graph.block.ops[idx] = fused
             graph.remove_ops([mul, lstm])
+        return graph
+
+
+def _bias_like(block, name, want_axis=None, axis=None):
+    """True if var `name` is a bias-shaped tensor (≤1 non-unit dim) and,
+    when `want_axis` is given, the elementwise axis attr matches (the NCHW
+    channel-bias convention the conv fusion epilogue implements)."""
+    if name is None:
+        return False
+    vd = block.var(name) if block.has_var(name) else None
+    if vd is None:
+        return False
+    sh = list(vd.shape or [])
+    if len([d for d in sh if d != 1]) > 1:
+        return False
+    if want_axis is not None:
+        if len(sh) == 1:
+            return axis == want_axis
+        # rank>1 (e.g. [1,C,1,1]): the single non-unit dim must sit at
+        # the wanted (channel) slot — a [1,1,1,W] add is not a channel
+        # bias (code-review finding)
+        nonunit = [i for i, d in enumerate(sh) if d != 1]
+        return not nonunit or nonunit[0] == want_axis
+    return True
+
+
+def _chain_feeds(prev, nxt, slot="X"):
+    """prev's first output is nxt's `slot` operand."""
+    return nxt.inputs.get(slot, [None])[0] == _first_out(prev)
+
+
+def _alive(graph, ops):
+    """Pattern matches are computed up front and the graph mutates as
+    matches fuse; two matches can SHARE ops (e.g. both resnet branches end
+    in the same residual add + relu). A match whose ops were already
+    consumed is stale and must be skipped."""
+    cur = {id(o) for o in graph.block.ops}
+    return all(id(o) in cur for o in ops)
+
+
+def _first_out(op):
+    for names in op.outputs.values():
+        if names:
+            return names[0]
+    return None
+
+
+_CONV_ACTS = ("relu", "sigmoid", "tanh")
+
+
+class _ConvEltwiseFuseBase(Pass):
+    """Shared matcher for the conv + elementwise_add [+ residual add]
+    [+ act] → conv2d_fusion family (reference:
+    ir/conv_elementwise_add_fuse_pass.cc, conv_elementwise_add_act_fuse_
+    pass.cc, conv_elementwise_add2_act_fuse_pass.cc). Under XLA the
+    epilogue fuses into the conv anyway; the program-level rewrite exists
+    so serialized inference programs carry one op (smaller programs,
+    fusion-aware transpilers) — same motivation as fc_fuse."""
+
+    with_act = False
+    with_residual = False
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        chain = ["conv2d", "elementwise_add"]
+        if self.with_residual:
+            chain.append("elementwise_add")
+        pats = []
+        if self.with_act:
+            for a in _CONV_ACTS:
+                pats += det.match_chain(chain + [a])
+        else:
+            pats = det.match_chain(chain)
+        fused_ids = set()
+        for ops in pats:
+            conv, add = ops[0], ops[1]
+            if id(conv) in fused_ids or not _alive(graph, ops):
+                continue
+            if conv.attrs.get("data_format", "NCHW") not in ("NCHW",
+                                                             "AnyLayout"):
+                continue   # bias epilogue is channel-dim-1 only
+            conv_out = conv.outputs["Output"][0]
+            if add.inputs.get("X", [None])[0] != conv_out:
+                continue
+            bias = add.inputs.get("Y", [None])[0]
+            if not _bias_like(graph.block, bias, want_axis=1,
+                              axis=add.attrs.get("axis", -1)):
+                continue
+            resid = None
+            rest = ops[2:]
+            if self.with_residual:
+                add2, rest = rest[0], rest[1:]
+                xs = add2.inputs.get("X", [None])[0]
+                ys = add2.inputs.get("Y", [None])[0]
+                prev_out = add.outputs["Out"][0]
+                resid = ys if xs == prev_out else xs
+                if resid is None or resid == prev_out:
+                    continue
+                if _bias_like(graph.block, resid):
+                    continue   # a second per-channel bias, not a residual
+            act = rest[0].type if rest else ""
+            last = rest[0] if rest else (ops[2] if self.with_residual
+                                         else add)
+            ins = {"Input": list(conv.inputs["Input"]),
+                   "Filter": list(conv.inputs["Filter"]),
+                   "Bias": [bias]}
+            if resid:
+                ins["ResidualData"] = [resid]
+            fused = ir.OpDesc(
+                type="conv2d_fusion", inputs=ins,
+                outputs={"Output": [_first_out(last)]},
+                attrs={**conv.attrs, "activation": act or "identity"})
+            # replace at the chain TAIL: every input (incl. a residual
+            # produced between conv and act) is defined by then
+            idx = graph.block.ops.index(ops[-1])
+            graph.block.ops[idx] = fused
+            graph.remove_ops([o for o in ops[:-1]])
+            fused_ids.add(id(conv))
+        return graph
+
+
+@register_pass("conv_elementwise_add_fuse_pass")
+class ConvElementwiseAddFusePass(_ConvEltwiseFuseBase):
+    """reference: ir/conv_elementwise_add_fuse_pass.cc."""
+
+
+@register_pass("conv_elementwise_add_act_fuse_pass")
+class ConvElementwiseAddActFusePass(_ConvEltwiseFuseBase):
+    """reference: ir/conv_elementwise_add_act_fuse_pass.cc."""
+    with_act = True
+
+
+@register_pass("conv_elementwise_add2_act_fuse_pass")
+class ConvElementwiseAdd2ActFusePass(_ConvEltwiseFuseBase):
+    """conv + bias add + residual add + act (reference:
+    ir/conv_elementwise_add2_act_fuse_pass.cc)."""
+    with_act = True
+    with_residual = True
+
+
+@register_pass("conv_affine_channel_fuse_pass")
+class ConvAffineChannelFusePass(Pass):
+    """conv2d + affine_channel → conv2d_fusion with the per-channel scale
+    folded into the filter values (reference:
+    ir/conv_affine_channel_fuse_pass.cc — numeric fold at pass time, so it
+    needs a scope with materialized params, like conv_bn)."""
+
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        import numpy as np
+        if self.scope is None:
+            return graph
+        det = PatternDetector(graph)
+        for conv, ac in det.match_chain(["conv2d", "affine_channel"]):
+            if ac.inputs.get("X", [None])[0] != conv.outputs["Output"][0]:
+                continue
+            if conv.attrs.get("data_format", "NCHW") not in ("NCHW",
+                                                             "AnyLayout"):
+                continue
+            w_name = conv.inputs["Filter"][0]
+            if len(graph.consumers(w_name)) != 1:
+                continue   # folding would corrupt another conv's filter
+            scale_n = ac.inputs["Scale"][0]
+            bias_n = ac.inputs["Bias"][0]
+            wv = self.scope.find_var(w_name)
+            sv = self.scope.find_var(scale_n)
+            if wv is None or sv is None:
+                continue
+            w = np.asarray(wv, np.float32)
+            s = np.asarray(sv, np.float32).reshape(-1, 1, 1, 1)
+            self.scope.set_var(w_name, (w * s).astype(w.dtype))
+            fused = ir.OpDesc(
+                type="conv2d_fusion",
+                inputs={"Input": list(conv.inputs["Input"]),
+                        "Filter": [w_name], "Bias": [bias_n]},
+                outputs={"Output": [ac.outputs["Out"][0]]},
+                attrs={**conv.attrs, "activation": "identity"})
+            idx = graph.block.ops.index(conv)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([ac])
+        return graph
+
+
+@register_pass("fc_gru_fuse_pass")
+class FcGruFusePass(Pass):
+    """mul (gate projection) [+ elementwise_add bias] + dynamic_gru →
+    fusion_gru (reference: ir/fc_gru_fuse_pass.cc) — the GRU mirror of
+    fc_lstm_fuse_pass."""
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        candidates = (det.match_chain(["mul", "elementwise_add",
+                                       "dynamic_gru"])
+                      + det.match_chain(["mul", "dynamic_gru"]))
+        seen = set()
+        for ops in candidates:
+            mul = ops[0]
+            if id(mul) in seen or not _alive(graph, ops):
+                continue
+            gru = ops[-1]
+            add = ops[1] if len(ops) == 3 else None
+            proj_out = (add or mul).outputs["Out"][0]
+            if gru.inputs.get("Input", [None])[0] != proj_out:
+                continue
+            bias = None
+            if add is not None:
+                if gru.inputs.get("Bias"):
+                    continue   # two gate biases — would need a combine op
+                if add.inputs.get("X", [None])[0] != mul.outputs["Out"][0]:
+                    continue
+                bias = add.inputs.get("Y", [None])[0]
+                if not _bias_like(graph.block, bias):
+                    continue
+            elif gru.inputs.get("Bias"):
+                bias = gru.inputs["Bias"][0]
+            ins = {"X": list(mul.inputs["X"]),
+                   "WeightX": list(mul.inputs["Y"]),
+                   "WeightH": list(gru.inputs["Weight"])}
+            if bias:
+                ins["Bias"] = [bias]
+            for slot in ("SeqLens", "H0"):
+                if gru.inputs.get(slot):
+                    ins[slot] = list(gru.inputs[slot])
+            fused = ir.OpDesc(
+                type="fusion_gru", inputs=ins,
+                outputs={"Hidden": list(gru.outputs["Hidden"])},
+                attrs=dict(gru.attrs))
+            idx = graph.block.ops.index(mul)
+            graph.block.ops[idx] = fused
+            graph.remove_ops(([add] if add else []) + [gru])
+            seen.add(id(mul))
+        return graph
+
+
+@register_pass("seqpool_concat_fuse_pass")
+class SeqpoolConcatFusePass(Pass):
+    """N parallel sequence_pool ops feeding one concat →
+    fusion_seqpool_concat (reference: ir/seqpool_concat_fuse_pass.cc)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        for node in list(graph.op_nodes):
+            cat = node.op
+            if cat.type != "concat" or cat.attrs.get("axis", 0) != 1:
+                continue
+            xs = cat.inputs.get("X", [])
+            pools = []
+            for n in xs:
+                prod = graph.producer(n)
+                if (prod is None or prod.op.type != "sequence_pool"
+                        or len(graph.consumers(n)) != 1):
+                    pools = None
+                    break
+                pools.append(prod.op)
+            if not pools or len(pools) < 2:
+                continue
+            ptypes = {str(p.attrs.get("pooltype", "AVERAGE")).upper()
+                      for p in pools}
+            if len(ptypes) != 1 or ptypes & {"MAX", "LAST", "FIRST"}:
+                continue   # fusion op implements SUM/AVERAGE/SQRT only
+            ins = {"X": [p.inputs["X"][0] for p in pools]}
+            lens = [p.inputs.get("SeqLens", [None])[0] for p in pools]
+            if any(l is not None for l in lens):
+                if any(l is None for l in lens):
+                    continue   # mixed masked/unmasked — keep composed
+                ins["SeqLens"] = lens
+            fused = ir.OpDesc(
+                type="fusion_seqpool_concat", inputs=ins,
+                outputs={"Out": list(cat.outputs["Out"])},
+                attrs={"pooltype": ptypes.pop(),
+                       "axis": cat.attrs.get("axis", 1)})
+            idx = graph.block.ops.index(cat)   # tail position: all pool
+            graph.block.ops[idx] = fused       # inputs are defined there
+            graph.remove_ops(pools)
+        return graph
+
+
+@register_pass("transpose_flatten_concat_fuse_pass")
+class TransposeFlattenConcatFusePass(Pass):
+    """N parallel transpose2 + flatten2 chains feeding one concat →
+    fusion_transpose_flatten_concat (reference:
+    ir/transpose_flatten_concat_fuse_pass.cc)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        for node in list(graph.op_nodes):
+            cat = node.op
+            if cat.type != "concat":
+                continue
+            xs = cat.inputs.get("X", [])
+            chains = []
+            for n in xs:
+                fl = graph.producer(n)
+                if (fl is None or fl.op.type != "flatten2"
+                        or len(graph.consumers(n)) != 1):
+                    chains = None
+                    break
+                tr = graph.producer(fl.op.inputs["X"][0])
+                if (tr is None or tr.op.type != "transpose2"
+                        or len(graph.consumers(fl.op.inputs["X"][0])) != 1):
+                    chains = None
+                    break
+                chains.append((tr.op, fl.op))
+            if not chains or len(chains) < 2:
+                continue
+            axes = {tuple(t.attrs.get("axis", [])) for t, _ in chains}
+            flats = {f.attrs.get("axis", 1) for _, f in chains}
+            if len(axes) != 1 or len(flats) != 1:
+                continue
+            fused = ir.OpDesc(
+                type="fusion_transpose_flatten_concat",
+                inputs={"X": [t.inputs["X"][0] for t, _ in chains]},
+                outputs={"Out": list(cat.outputs["Out"])},
+                attrs={"trans_axis": list(axes.pop()),
+                       "flatten_axis": flats.pop(),
+                       "concat_axis": cat.attrs.get("axis", 1)})
+            idx = graph.block.ops.index(cat)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([o for t, f in chains for o in (t, f)])
+        return graph
+
+
+@register_pass("seq_concat_fc_fuse_pass")
+class SeqConcatFcFusePass(Pass):
+    """concat(seq, sequence_expand(v_i)...) + mul [+ bias add] [+ act] →
+    fusion_seqexpand_concat_fc (reference: ir/seq_concat_fc_fuse_pass.cc).
+    Only the unmasked form fuses (a sequence_expand with SeqLens zeroes
+    padded steps; the fused op broadcasts without masking)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        pats = (det.match_chain(["concat", "mul", "elementwise_add",
+                                 "relu"])
+                + det.match_chain(["concat", "mul", "elementwise_add",
+                                   "sigmoid"])
+                + det.match_chain(["concat", "mul", "elementwise_add",
+                                   "tanh"])
+                + det.match_chain(["concat", "mul", "elementwise_add"]))
+        seen = set()
+        for ops in pats:
+            cat, mul = ops[0], ops[1]
+            if id(cat) in seen or not _alive(graph, ops):
+                continue
+            add = ops[2] if len(ops) >= 3 else None
+            act = ops[3].type if len(ops) == 4 else ""
+            if mul.attrs.get("x_num_col_dims", 1) != 2:
+                continue   # fc over [B,T,D] features
+            if mul.inputs.get("X", [None])[0] != cat.outputs["Out"][0]:
+                continue
+            if cat.attrs.get("axis", 0) not in (2, -1):
+                continue
+            bias = None
+            if add is not None:
+                if add.inputs.get("X", [None])[0] != mul.outputs["Out"][0]:
+                    continue
+                bias = add.inputs.get("Y", [None])[0]
+                if not _bias_like(graph.block, bias):
+                    continue
+            xs = cat.inputs.get("X", [])
+            if len(xs) < 2:
+                continue
+            expands, ok = [], True
+            for n in xs[1:]:
+                prod = graph.producer(n)
+                if (prod is None or prod.op.type not in
+                        ("sequence_expand", "sequence_expand_as")
+                        or prod.op.inputs.get("SeqLens")
+                        or len(graph.consumers(n)) != 1):
+                    ok = False
+                    break
+                expands.append(prod.op)
+            if not ok:
+                continue
+            ins = {"X": [xs[0]] + [e.inputs["X"][0] for e in expands],
+                   "FCWeight": list(mul.inputs["Y"])}
+            if bias:
+                ins["FCBias"] = [bias]
+            last = ops[-1]
+            fused = ir.OpDesc(
+                type="fusion_seqexpand_concat_fc", inputs=ins,
+                outputs={"Out": [_first_out(last)]},
+                attrs={"fc_activation": act or "identity"})
+            idx = graph.block.ops.index(last)
+            graph.block.ops[idx] = fused
+            graph.remove_ops(expands + [o for o in ops[:-1]])
+            seen.add(id(cat))
+        return graph
+
+
+@register_pass("is_test_pass")
+class IsTestPass(Pass):
+    """Set is_test=True on ops with train/infer behavioral split
+    (reference: ir/is_test_pass.cc — same op list)."""
+
+    OP_TYPES = ("batch_norm", "dropout", "lrn", "pool2d", "faster_rcnn",
+                "while", "fake_quantize_abs_max",
+                "fake_quantize_range_abs_max", "fake_dequantize_max_abs")
+
+    def apply(self, graph: Graph) -> Graph:
+        for node in graph.op_nodes:
+            if node.op.type in self.OP_TYPES:
+                node.op.attrs = dict(node.op.attrs)
+                node.op.attrs["is_test"] = True
+        return graph
+
+
+@register_pass("infer_clean_graph_pass")
+class InferCleanGraphPass(Pass):
+    """Strip feed/fetch plumbing ops from an inference program
+    (reference: ir/infer_clean_graph_pass.cc)."""
+
+    def apply(self, graph: Graph) -> Graph:
+        drop = [n.op for n in graph.op_nodes
+                if n.op.type in ("feed", "fetch")]
+        if drop:
+            graph.remove_ops(drop)
+        return graph
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add + activation → fused_elemwise_activation, the
+    reference's flagship BuildStrategy training fusion
+    (ir/fuse_elewise_add_act_pass.cc, wired at build_strategy.h:113).
+
+    GRAD-AWARE: on a training program (post-minimize) the two ops'
+    `__vjp__` backward ops are fused into ONE __vjp__ over the fused op —
+    the re-trace derives the fused backward automatically, so unlike the
+    reference there is no hand-written fused grad kernel to maintain. The
+    intermediate gradient var (add-out grad) disappears with its op."""
+
+    grad_aware = True
+    ACTS = ("relu", "sigmoid", "tanh", "gelu")
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        pats = []
+        for a in self.ACTS:
+            pats += det.match_chain(["elementwise_add", a],
+                                    ignore_vjp=True)
+        # map producer-op identity -> its __vjp__ op (match on the
+        # snapshot's outputs: var names identify the fwd op uniquely)
+        vjps = {}
+        for node in graph.op_nodes:
+            if node.op.type == "__vjp__":
+                snap = node.op.attrs.get("fwd_op", {})
+                outs = tuple(sorted((s, tuple(n)) for s, n in
+                                    (snap.get("outputs") or {}).items()))
+                vjps[(snap.get("type"), outs)] = node.op
+
+        def vjp_of(op):
+            outs = tuple(sorted((s, tuple(n))
+                                for s, n in op.outputs.items()))
+            return vjps.get((op.type, outs))
+
+        seen = set()
+        for add, act in pats:
+            if id(add) in seen or not _alive(graph, (add, act)):
+                continue
+            ax = add.attrs.get("axis", -1)
+            xv = add.inputs.get("X", [None])[0]
+            yv = add.inputs.get("Y", [None])[0]
+            xs = (graph.block.var(xv).shape
+                  if xv and graph.block.has_var(xv) else None)
+            ys = (graph.block.var(yv).shape
+                  if yv and graph.block.has_var(yv) else None)
+            # the fused emitter does trailing-aligned jnp.add: only fuse
+            # when the add's axis semantics coincide with that — axis=-1,
+            # or any axis with equal ranks (code-review finding: an
+            # axis=0 leading-aligned add would silently change numerics)
+            if ax != -1 and (xs is None or ys is None
+                             or len(xs or []) != len(ys or [])):
+                continue
+            add_vjp, act_vjp = vjp_of(add), vjp_of(act)
+            if (add_vjp is None) != (act_vjp is None):
+                continue   # partially differentiated — don't touch
+            inter = add.outputs["Out"][0]
+            out = act.outputs["Out"][0]
+            fused = ir.OpDesc(
+                type="fused_elemwise_activation",
+                inputs={"X": list(add.inputs["X"]),
+                        "Y": list(add.inputs["Y"])},
+                outputs={"Out": [out], "IntermediateOut": [inter]},
+                attrs={"functor_list": ["elementwise_add", act.type],
+                       "axis": add.attrs.get("axis", -1)})
+            idx = graph.block.ops.index(add)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([act])
+            if add_vjp is not None:
+                # one __vjp__ over the fused op: FwdIn = fused inputs
+                # (sorted slots X, Y — same flat order as the add's vjp),
+                # OutGrad = the act-out grad, InGrad = the add vjp's
+                # outputs. out_grad_mask follows the fused op's sorted
+                # out layout (IntermediateOut, Out) = (no grad, grad).
+                fused_vjp = ir.OpDesc(
+                    type="__vjp__",
+                    inputs={"FwdIn": list(add.inputs["X"])
+                            + list(add.inputs["Y"]),
+                            "OutGrad": list(act_vjp.inputs["OutGrad"])},
+                    outputs={"InGrad":
+                             list(add_vjp.outputs["InGrad"])},
+                    attrs={"fwd_op": fused.to_dict(),
+                           "fwd_op_index":
+                               act_vjp.attrs["fwd_op_index"],
+                           "in_grad_mask":
+                               list(add_vjp.attrs["in_grad_mask"]),
+                           "out_grad_mask": [False, True]})
+                vidx = graph.block.ops.index(act_vjp)
+                graph.block.ops[vidx] = fused_vjp
+                graph.remove_ops([add_vjp])
+            seen.add(id(add))
         return graph

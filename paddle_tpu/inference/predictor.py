@@ -67,11 +67,43 @@ class PaddlePredictor:
                 params_filename=config.params_file or None,
                 scope=self._scope)
         if config.ir_optim:
-            from paddle_tpu.inference.transpiler import InferenceTranspiler
-            InferenceTranspiler().transpile(program, scope=self._scope)
+            self._run_analysis_passes(program)
         self._program = program
         self._feed_names = feeds
         self._fetch_names = fetches
+
+    # the Analysis pipeline (reference: analysis_predictor.cc Analyzer +
+    # ir_pass_manager — the pass list AnalysisConfig.pass_builder seeds).
+    # Scope-dependent folds (conv_bn via the transpiler, affine_channel,
+    # embedding_fc_lstm) see the loaded params.
+    ANALYSIS_PASSES = [
+        "infer_clean_graph_pass",
+        "is_test_pass",
+        "conv_affine_channel_fuse_pass",
+        "conv_bn_fuse_pass",            # delegates to InferenceTranspiler
+        "conv_elementwise_add2_act_fuse_pass",
+        "conv_elementwise_add_act_fuse_pass",
+        "conv_elementwise_add_fuse_pass",
+        # rnn/seq fusions BEFORE fc_fuse — their patterns start at the
+        # mul+add gate projection that fc_fuse would consume
+        "embedding_fc_lstm_fuse_pass",
+        "fc_lstm_fuse_pass",
+        "fc_gru_fuse_pass",
+        "seqconv_eltadd_relu_fuse_pass",
+        "seqpool_concat_fuse_pass",
+        "seq_concat_fc_fuse_pass",
+        "transpose_flatten_concat_fuse_pass",
+        "fc_fuse_pass",
+    ]
+
+    def _run_analysis_passes(self, program):
+        from paddle_tpu.fluid import ir_pass as irp
+        block = program.desc.global_block
+        for name in self.ANALYSIS_PASSES:
+            p = irp.get_pass(name)
+            p.scope = self._scope
+            p(irp.Graph(block))
+        program.desc.bump_version()
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)

@@ -53,12 +53,46 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch) -> float:
         names = outputs.get(slot) or []
         return _var_shape(block, names[0], batch) if names else None
 
-    if op_type in ("conv2d", "depthwise_conv2d", "conv3d"):
+    if op_type in ("conv2d", "depthwise_conv2d", "conv3d", "conv2d_fusion"):
         out = oshape("Output")
         filt = ishape("Filter")          # [Cout, Cin/g, *k]
         if out is None or filt is None:
             return 0.0
         return 2.0 * _prod(out) * _prod(filt[1:])
+    if op_type in ("sequence_conv", "fusion_seqconv_eltadd_relu"):
+        out = oshape("Out")              # [B, T, M]
+        filt = ishape("Filter")          # [ctxLen*D, M]
+        if out is None or filt is None:
+            return 0.0
+        return 2.0 * _prod(out) * filt[0]
+    if op_type == "fusion_seqexpand_concat_fc":
+        out = oshape("Out")              # [B, T, K]
+        w = ishape("FCWeight")           # [Dcat, K]
+        if out is None or w is None:
+            return 0.0
+        return 2.0 * _prod(out) * w[0]
+    if op_type in ("fusion_lstm", "fused_embedding_fc_lstm"):
+        hid = oshape("Hidden")           # [B, T, D]
+        if hid is None:
+            return 0.0
+        d = hid[-1]
+        bt = _prod(hid[:-1])
+        f = 2.0 * bt * d * 4 * d         # recurrent gate matmuls
+        wx = ishape("WeightX")
+        if wx is not None:               # input projection (fusion_lstm)
+            f += 2.0 * bt * wx[0] * wx[1]
+        return f
+    if op_type == "fusion_gru":
+        hid = oshape("Hidden")
+        if hid is None:
+            return 0.0
+        d = hid[-1]
+        bt = _prod(hid[:-1])
+        f = 2.0 * bt * d * 3 * d
+        wx = ishape("WeightX")
+        if wx is not None:
+            f += 2.0 * bt * wx[0] * wx[1]
+        return f
     if op_type in ("conv2d_transpose", "conv3d_transpose",
                    "depthwise_conv2d_transpose"):
         inp = ishape("Input")            # [N, Cin, *spatial]
@@ -125,23 +159,63 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch) -> float:
     return 0.0
 
 
+def _subblock_trip_count(desc, block, op, batch):
+    """Static trip-count estimate for a sub-block op. scan: the ScanIn
+    leading (time) dim or the `length` attr. while: no static count —
+    use a `max_len`-style attr when present, else 1 (UNDER-counts, which
+    only makes MFU conservative). cond: both branches execute under XLA."""
+    if op.type == "scan":
+        names = op.inputs.get("ScanIn") or []
+        if names:
+            sh = _var_shape(block, names[0], batch)
+            if sh:
+                return sh[0]
+        if op.attrs.get("length"):
+            return int(op.attrs["length"])
+        return 1
+    if op.type == "while":
+        for key in ("max_len", "max_iters", "max_iterations"):
+            if op.attrs.get(key):
+                return int(op.attrs[key])
+        return 1
+    return 1
+
+
+def _op_flops(desc, block, op, batch):
+    if op.type == "__vjp__":
+        fwd = op.attrs.get("fwd_op", {})
+        fop = type("O", (), {"type": fwd.get("type"),
+                             "inputs": fwd.get("inputs", {}),
+                             "outputs": fwd.get("outputs", {}),
+                             "attrs": fwd.get("attrs", {})})()
+        return 2.0 * _op_flops(desc, block, fop, batch)
+    if op.type in ("while", "scan"):
+        trips = _subblock_trip_count(desc, block, op, batch)
+        return trips * _block_flops(desc, int(op.attrs["sub_block"]), batch)
+    if op.type == "cond":
+        total = 0.0
+        for key in ("sub_block_true", "sub_block_false"):
+            idx = op.attrs.get(key, -1)
+            if idx is not None and idx >= 0:
+                total += _block_flops(desc, int(idx), batch)
+        return total
+    return op_fwd_flops(block, op.type, op.inputs, op.outputs,
+                        op.attrs, batch)
+
+
+def _block_flops(desc, block_idx, batch):
+    block = desc.block(block_idx)
+    return sum(_op_flops(desc, block, op, batch) for op in block.ops)
+
+
 def program_flops(program, batch_size: int, block_idx: int = 0) -> float:
     """Total analytic FLOPs for one execution of the program's block:
-    forward ops at 1x, each `__vjp__` backward op at 2x its forward op.
-    Accepts a fluid.Program or a core.ir.ProgramDesc."""
+    forward ops at 1x, each `__vjp__` backward op at 2x its forward op;
+    while/scan sub-blocks count body x trip-count, cond counts both
+    branches (XLA computes both). Accepts a fluid.Program or a
+    core.ir.ProgramDesc."""
     desc = program.desc if hasattr(program, "desc") else program
-    block = desc.block(block_idx)
-    total = 0.0
-    for op in block.ops:
-        if op.type == "__vjp__":
-            fwd = op.attrs.get("fwd_op", {})
-            total += 2.0 * op_fwd_flops(
-                block, fwd.get("type"), fwd.get("inputs", {}),
-                fwd.get("outputs", {}), fwd.get("attrs", {}), batch_size)
-        else:
-            total += op_fwd_flops(block, op.type, op.inputs, op.outputs,
-                                  op.attrs, batch_size)
-    return total
+    return _block_flops(desc, block_idx, batch_size)
 
 
 # peak bf16 matmul FLOP/s by PJRT device_kind (public spec sheets)

@@ -1,0 +1,346 @@
+"""BuildStrategy-wired IR passes (round-2 verdict item 3): the pass
+system is in the EXECUTION path — CompiledProgram carries a BuildStrategy
+whose fuse_* knobs run registered passes before lowering (reference
+wiring: BuildStrategy::Apply, details/build_strategy.h:113), and the
+inference Predictor runs the Analysis pipeline by default
+(analysis_predictor.cc Analyzer). Each new pass gets an op-list assert +
+numeric parity, the reference's test pattern (test_dist_transpiler style).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.fluid.ir_pass import Graph, get_pass
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def _ops(main):
+    return [op.type for op in main.desc.global_block.ops]
+
+
+def _run(main, feed, fetch, scope=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(main, feed=feed, fetch_list=fetch, scope=scope)
+
+
+# ---------------------------------------------------------------- training
+
+def _residual_mlp(seed=11):
+    """Training program with an explicit elementwise_add + relu pair (the
+    fuse_elewise_add_act target) between two branches."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        a = layers.fc(x, 16, bias_attr=False)
+        b = layers.fc(x, 16, bias_attr=False)
+        r = layers.relu(layers.elementwise_add(a, b))
+        y = layers.fc(r, 4, bias_attr=False)
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_build_strategy_grad_aware_fuse_parity():
+    """fuse_elewise_add_act on a TRAINING program: the forward pair fuses,
+    the two __vjp__ ops merge into one, and the loss curve is unchanged."""
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(4, 8).astype(np.float32)} for _ in range(3)]
+
+    main0, startup0, loss0 = _residual_mlp()
+    scope0 = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup0, scope=scope0)
+    base = [float(_l) for f in feeds
+            for (_l,) in [exe.run(main0, feed=f, fetch_list=[loss0],
+                                  scope=scope0)]]
+
+    main1, startup1, loss1 = _residual_mlp()
+    n_vjp_before = _ops(main1).count("__vjp__")
+    scope1 = fluid.Scope()
+    exe.run(startup1, scope=scope1)
+    cp = CompiledProgram(main1).with_build_strategy(
+        BuildStrategy(fuse_elewise_add_act_ops=True))
+    fused = [float(_l) for f in feeds
+             for (_l,) in [exe.run(cp, feed=f, fetch_list=[loss1],
+                                   scope=scope1)]]
+
+    ops = _ops(main1)
+    assert "fused_elemwise_activation" in ops
+    assert ops.count("__vjp__") == n_vjp_before - 1
+    np.testing.assert_allclose(base, fused, rtol=1e-6, atol=1e-7)
+
+
+def test_build_strategy_skips_non_grad_aware_on_training():
+    main, startup, loss = _residual_mlp()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    cp = CompiledProgram(main).with_build_strategy(
+        BuildStrategy(fuse_fc_ops=True))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        exe.run(cp, feed={"x": np.zeros((2, 8), np.float32)},
+                fetch_list=[loss], scope=scope)
+    assert any("not grad-aware" in str(x.message) for x in w)
+    assert "fc" not in _ops(main)          # pass did NOT run
+    assert "mul" in _ops(main)
+
+
+# --------------------------------------------------------------- conv family
+
+def _conv_prog(act, residual=False, seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        out = layers.conv2d(img, 4, 3, padding=1, act=None)
+        if residual:
+            res = layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+            out = layers.elementwise_add(out, res)
+        if act:
+            out = getattr(layers, act)(out)
+        out = layers.mean(out)
+    main._is_test = True
+    return main, startup, out
+
+
+@pytest.mark.parametrize("act,residual,pass_name,want", [
+    (None, False, "conv_elementwise_add_fuse_pass", "identity"),
+    ("relu", False, "conv_elementwise_add_act_fuse_pass", "relu"),
+    ("relu", True, "conv_elementwise_add2_act_fuse_pass", "relu"),
+])
+def test_conv_eltwise_fuse_family(act, residual, pass_name, want):
+    main, startup, out = _conv_prog(act, residual)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"img": np.random.RandomState(1).rand(2, 3, 8, 8)
+            .astype(np.float32)}
+    (before,) = _run(main, feed, [out])
+
+    get_pass(pass_name)(Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "conv2d_fusion" in ops
+    fused = next(o for o in main.desc.global_block.ops
+                 if o.type == "conv2d_fusion")
+    assert fused.attrs["activation"] == want
+    if residual:
+        assert fused.inputs.get("ResidualData")
+    (after,) = _run(main, feed, [out])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_affine_channel_fuse():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 6, 6], dtype="float32")
+        c = layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        h = LayerHelper("ac")
+        scale = h.create_parameter(fluid.ParamAttr(name="ac_s"), shape=[4])
+        bias = h.create_parameter(fluid.ParamAttr(name="ac_b"), shape=[4],
+                                  is_bias=True)
+        out = layers.affine_channel(c, scale, bias)
+        out = layers.mean(out)
+    main._is_test = True
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    scope.set_var("ac_s", np.random.RandomState(2).rand(4)
+                  .astype(np.float32) + 0.5)
+    feed = {"img": np.random.RandomState(3).rand(2, 3, 6, 6)
+            .astype(np.float32)}
+    (before,) = _run(main, feed, [out], scope=scope)
+
+    p = get_pass("conv_affine_channel_fuse_pass")
+    p.scope = scope
+    p(Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "conv2d_fusion" in ops and "affine_channel" not in ops
+    (after,) = _run(main, feed, [out], scope=scope)
+    np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- rnn / seq
+
+def test_fc_gru_fuse():
+    B, T, D = 2, 4, 6
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[T, D], dtype="float32")
+        sl = layers.data(name="sl", shape=[], dtype="int32")
+        proj = layers.fc(x, size=3 * D, num_flatten_dims=2,
+                         bias_attr=False)
+        hid = layers.dynamic_gru(proj, size=D, seq_lens=sl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(B, T, D).astype(np.float32),
+            "sl": np.array([3, 4], np.int32)}
+    (before,) = _run(main, feed, [hid])
+    get_pass("fc_gru_fuse_pass")(Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "fusion_gru" in ops
+    assert "mul" not in ops and "dynamic_gru" not in ops
+    (after,) = _run(main, feed, [hid])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_seqpool_concat_fuse():
+    B, T, D = 2, 5, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data(name="a", shape=[T, D], dtype="float32")
+        b = layers.data(name="b", shape=[T, D], dtype="float32")
+        sl = layers.data(name="sl", shape=[], dtype="int32")
+        pa = layers.sequence_pool(a, "sum", seq_lens=sl)
+        pb = layers.sequence_pool(b, "sum", seq_lens=sl)
+        out = layers.concat([pa, pb], axis=1)
+    rng = np.random.RandomState(1)
+    feed = {"a": rng.rand(B, T, D).astype(np.float32),
+            "b": rng.rand(B, T, D).astype(np.float32),
+            "sl": np.array([4, 5], np.int32)}
+    (before,) = _run(main, feed, [out])
+    get_pass("seqpool_concat_fuse_pass")(Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "fusion_seqpool_concat" in ops
+    assert "sequence_pool" not in ops and "concat" not in ops
+    (after,) = _run(main, feed, [out])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_seqpool_concat_fuse_skips_max_pool():
+    B, T, D = 2, 5, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data(name="a", shape=[T, D], dtype="float32")
+        b = layers.data(name="b", shape=[T, D], dtype="float32")
+        pa = layers.sequence_pool(a, "max")
+        pb = layers.sequence_pool(b, "max")
+        layers.concat([pa, pb], axis=1)
+    get_pass("seqpool_concat_fuse_pass")(Graph(main.desc.global_block))
+    assert "fusion_seqpool_concat" not in _ops(main)
+
+
+def test_transpose_flatten_concat_fuse():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data(name="a", shape=[2, 3, 4], dtype="float32")
+        b = layers.data(name="b", shape=[2, 3, 4], dtype="float32")
+        helper = LayerHelper("tfc")
+        flats = []
+        for v in (a, b):
+            t = helper.create_variable_for_type_inference("float32")
+            helper.append_op("transpose2", inputs={"X": [v]},
+                             outputs={"Out": [t]},
+                             attrs={"axis": [0, 2, 3, 1]})
+            f = helper.create_variable_for_type_inference("float32")
+            helper.append_op("flatten2", inputs={"X": [t]},
+                             outputs={"Out": [f]}, attrs={"axis": 1})
+            flats.append(f)
+        out = layers.concat(flats, axis=1)
+    rng = np.random.RandomState(2)
+    feed = {"a": rng.rand(2, 2, 3, 4).astype(np.float32),
+            "b": rng.rand(2, 2, 3, 4).astype(np.float32)}
+    (before,) = _run(main, feed, [out])
+    get_pass("transpose_flatten_concat_fuse_pass")(
+        Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "fusion_transpose_flatten_concat" in ops
+    assert "transpose2" not in ops and "flatten2" not in ops
+    (after,) = _run(main, feed, [out])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+def test_seq_concat_fc_fuse():
+    B, T, D = 2, 4, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        seq = layers.data(name="seq", shape=[T, D], dtype="float32")
+        v1 = layers.data(name="v1", shape=[3], dtype="float32")
+        v2 = layers.data(name="v2", shape=[2], dtype="float32")
+        e1 = layers.sequence_expand(v1, seq)
+        e2 = layers.sequence_expand(v2, seq)
+        cat = layers.concat([seq, e1, e2], axis=2)
+        out = layers.fc(cat, size=7, num_flatten_dims=2, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(4)
+    feed = {"seq": rng.rand(B, T, D).astype(np.float32),
+            "v1": rng.rand(B, 3).astype(np.float32),
+            "v2": rng.rand(B, 2).astype(np.float32)}
+    (before,) = _run(main, feed, [out])
+    get_pass("seq_concat_fc_fuse_pass")(Graph(main.desc.global_block))
+    main.desc.bump_version()
+    ops = _ops(main)
+    assert "fusion_seqexpand_concat_fc" in ops
+    assert "sequence_expand" not in ops and "concat" not in ops
+    (after,) = _run(main, feed, [out])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------------------- utility passes
+
+def test_is_test_and_infer_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5)
+        layers.mean(d)
+    blk = main.desc.global_block
+    blk.append_op(__import__("paddle_tpu.core.ir", fromlist=["ir"])
+                  .OpDesc(type="feed", outputs={"Out": [x.name]},
+                          attrs={"col": 0}))
+    get_pass("is_test_pass")(Graph(blk))
+    drop = next(o for o in blk.ops if o.type == "dropout")
+    assert drop.attrs.get("is_test") is True
+    assert any(o.type == "feed" for o in blk.ops)
+    get_pass("infer_clean_graph_pass")(Graph(blk))
+    assert not any(o.type in ("feed", "fetch") for o in blk.ops)
+
+
+# -------------------------------------------------- predictor analysis path
+
+def test_predictor_runs_analysis_pipeline(tmp_path):
+    from paddle_tpu.inference.predictor import (AnalysisConfig,
+                                                create_paddle_predictor)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 13
+    with fluid.program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+        c = layers.conv2d(img, 4, 3, padding=1)
+        bn = layers.batch_norm(c, is_test=True)
+        r = layers.relu(bn)
+        f = layers.fc(r, 10, act="relu")
+        out = layers.softmax(f)
+    main._is_test = True
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    feed = {"img": np.random.RandomState(5).rand(2, 3, 8, 8)
+            .astype(np.float32)}
+    (direct,) = _run(main, feed, [out], scope=scope)
+
+    model_dir = str(tmp_path / "m")
+    fluid.io.save_inference_model(model_dir, ["img"], [out], exe,
+                                  main_program=main, scope=scope)
+    pred = create_paddle_predictor(AnalysisConfig(model_dir=model_dir))
+    ops = [op.type for op in pred._program.desc.global_block.ops]
+    # the analysis pipeline fused the conv epilogue and the fc
+    assert "conv2d_fusion" in ops or "fc" in ops
+    assert "batch_norm" not in ops              # folded by conv_bn
+    (served,) = pred.run(feed)
+    np.testing.assert_allclose(direct, served, rtol=1e-4, atol=1e-5)
