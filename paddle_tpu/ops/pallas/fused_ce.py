@@ -56,8 +56,11 @@ def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
         zsum_scr[:] = jnp.zeros_like(zsum_scr)
         zlab_scr[:] = jnp.zeros_like(zlab_scr)
 
-    x = x_ref[...].astype(jnp.float32)                 # [BN, D]
-    w = w_ref[...].astype(jnp.float32)                 # [D, BV]
+    # operands stay in their storage dtype (bf16 in production) — the MXU
+    # accumulates in fp32 via preferred_element_type; an fp32 upcast here
+    # ran the dots at the fp32 MXU rate (~6x slower, advisor-era bug)
+    x = x_ref[...]                                     # [BN, D]
+    w = w_ref[...]                                     # [D, BV]
     z = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     m = m_scr[:]
@@ -110,12 +113,16 @@ def _bwd_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, dw_ref,
     def _():
         dx_scr[:] = jnp.zeros_like(dx_scr)
 
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)
+    x = x_ref[...]
+    w = w_ref[...]
     z = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
     dz = _dlogits(z, lse_ref[...], lab_ref[...], g_ref[...], j,
                   bn, bv, smooth, ignore_index, vocab)
+    # dz in the storage dtype for the two grad matmuls (standard mixed-
+    # precision: fp32 softmax, low-precision grad operands, fp32 accum);
+    # exact for fp32 inputs (tests), bf16-rate MXU in production
+    dz = dz.astype(x.dtype)
     dx_scr[:] = dx_scr[:] + jax.lax.dot_general(
         dz, w, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)            # [BN, D]

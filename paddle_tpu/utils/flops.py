@@ -33,25 +33,38 @@ def _resolve(shape, batch):
     return [batch if d == -1 else int(d) for d in shape]
 
 
-def _var_shape(block, name, batch):
-    if not name or not block.has_var(name):
+def _var_shape(block, name, batch, desc=None):
+    """Resolve a var's shape, chaining to PARENT blocks when `desc` is
+    given — sub-block ops (while/scan bodies) consume parameters that
+    live in the global block (LayerHelper always creates params there),
+    and without the chain their matmuls would count 0 FLOPs."""
+    if not name:
         return None
-    v = block.var(name)
-    if v.shape is None:
-        return None
-    return _resolve(v.shape, batch)
+    b = block
+    while b is not None:
+        if b.has_var(name):
+            v = b.var(name)
+            if v.shape is None:
+                return None
+            return _resolve(v.shape, batch)
+        if desc is None or b.parent_idx is None or b.parent_idx < 0 \
+                or b.parent_idx == b.idx:
+            return None
+        b = desc.block(b.parent_idx)
+    return None
 
 
-def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch) -> float:
+def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
+                 desc=None) -> float:
     """Forward FLOPs of one op (2 FLOPs per multiply-accumulate)."""
 
     def ishape(slot):
         names = inputs.get(slot) or []
-        return _var_shape(block, names[0], batch) if names else None
+        return _var_shape(block, names[0], batch, desc) if names else None
 
     def oshape(slot):
         names = outputs.get(slot) or []
-        return _var_shape(block, names[0], batch) if names else None
+        return _var_shape(block, names[0], batch, desc) if names else None
 
     if op_type in ("conv2d", "depthwise_conv2d", "conv3d", "conv2d_fusion"):
         out = oshape("Output")
@@ -167,7 +180,7 @@ def _subblock_trip_count(desc, block, op, batch):
     if op.type == "scan":
         names = op.inputs.get("ScanIn") or []
         if names:
-            sh = _var_shape(block, names[0], batch)
+            sh = _var_shape(block, names[0], batch, desc)
             if sh:
                 return sh[0]
         if op.attrs.get("length"):
@@ -200,7 +213,7 @@ def _op_flops(desc, block, op, batch):
                 total += _block_flops(desc, int(idx), batch)
         return total
     return op_fwd_flops(block, op.type, op.inputs, op.outputs,
-                        op.attrs, batch)
+                        op.attrs, batch, desc=desc)
 
 
 def _block_flops(desc, block_idx, batch):
