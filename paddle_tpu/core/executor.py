@@ -98,7 +98,7 @@ class Executor:
             feed_var_name: str = "feed", fetch_var_name: str = "fetch",
             scope: Optional[Scope] = None, return_numpy: bool = True,
             use_program_cache: bool = True, iterations: int = 1,
-            stacked_feed: bool = False):
+            stacked_feed=False):
         """reference: executor.py:447 — same signature contract.
 
         iterations > 1 runs that many steps in ONE device-side loop
@@ -112,9 +112,12 @@ class Executor:
 
         stacked_feed=True declares that `feed` is a DICT whose arrays
         already carry the leading [iterations] axis (e.g. a device-built
-        batch-per-step tensor) — no host-side stacking. NOTE for
-        stateless (inference) programs: a RESIDENT batch reused across
-        the scan is loop-invariant and XLA computes the step once;
+        batch-per-step tensor) — no host-side stacking. A LIST of feed
+        names stacks only those (fresh per-step labels/ids over a
+        resident image batch — avoids both the memorize-the-batch
+        training artifact and the cost of stacking large float feeds).
+        NOTE for stateless (inference) programs: a RESIDENT batch reused
+        across the scan is loop-invariant and XLA computes the step once;
         benchmark such programs with per-step data (stacked feeds)."""
         if program is None:
             from paddle_tpu.fluid import framework as fw
@@ -145,15 +148,27 @@ class Executor:
                         for n in feed[0]}
         elif stacked_feed:
             if iterations <= 1:
-                raise ValueError("stacked_feed=True requires iterations>1")
-            for n, v in (feed or {}).items():
+                raise ValueError("stacked_feed requires iterations>1")
+            if stacked_feed is True:
+                check = (feed or {}).items()
+            else:
+                if isinstance(stacked_feed, str):
+                    stacked_feed = [stacked_feed]
+                missing = [n for n in stacked_feed if n not in (feed or {})]
+                if missing:
+                    raise ValueError(
+                        f"stacked_feed names {missing} are not in the "
+                        f"feed dict (feeds: {sorted(feed or {})})")
+                check = [(n, feed[n]) for n in stacked_feed]
+            for n, v in check:
                 shape = np.shape(v)
                 if not shape or shape[0] != iterations:
                     raise ValueError(
                         f"stacked_feed: {n!r} leading dim "
                         f"{shape[0] if shape else '<scalar>'} != "
                         f"iterations {iterations}")
-            stacked = True
+            stacked = True if stacked_feed is True else \
+                sorted(set(stacked_feed))
         feed = feed or {}
 
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
@@ -173,10 +188,14 @@ class Executor:
             sh = cb.feed_sharding(name)
             return NamedSharding(cb.dist.mesh, P(None, *sh.spec))
 
+        def is_stacked(name):
+            return stacked is True or (isinstance(stacked, list)
+                                       and name in stacked)
+
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
-            if stacked and multi_host:
+            if is_stacked(name) and multi_host:
                 sh = stacked_sharding(name)
                 if isinstance(val, jax.Array):
                     # mirror the single-step global-array contract below:
@@ -235,7 +254,7 @@ class Executor:
                     val = val.astype(want)
                 sh = None
                 if dist_mode:
-                    sh = (stacked_sharding(name) if stacked
+                    sh = (stacked_sharding(name) if is_stacked(name)
                           else cb.feed_sharding(name))
                 if sh is not None:
                     val = jax.device_put(val, sh)

@@ -242,9 +242,10 @@ class CompiledBlock:
         self._step_fn = fn            # un-jitted (dist-wrapped) single step
         self._jit_kwargs = jit_kwargs
         self.fn = jax.jit(fn, **jit_kwargs)
-        self._multi_cache: Dict[Tuple[int, bool], Any] = {}
+        # key: (iterations, True | tuple of stacked feed names)
+        self._multi_cache: Dict[Tuple[int, Any], Any] = {}
 
-    def _multi_fn(self, iterations: int, stacked: bool):
+    def _multi_fn(self, iterations: int, stacked):
         """jitted N-step executable: scans the single-step fn over donated
         state in ONE dispatch — the TPU analogue of the reference's C++
         interpreter hot loop (framework/executor.cc:448 runs the op list
@@ -252,23 +253,32 @@ class CompiledBlock:
         per-dispatch host+tunnel cost — which scales with the number of
         param buffers — is paid once per N steps, not once per step).
 
-        stacked=True scans feeds with a leading [iterations] axis (one
-        batch per step); stacked=False reuses one resident batch. Fetches
-        come back stacked per step ([iterations, ...])."""
-        key = (iterations, stacked)
+        `stacked` is True (every feed carries a leading [iterations] axis,
+        one batch per step), False (one resident batch reused), or an
+        iterable of feed NAMES — only those scan per-step while the rest
+        stay resident (e.g. fresh labels over a resident image batch).
+        Fetches come back stacked per step ([iterations, ...])."""
+        snames = (frozenset() if isinstance(stacked, bool)
+                  else frozenset(stacked))
+        key = (iterations, stacked if isinstance(stacked, bool)
+               else tuple(sorted(snames)))
         cached = self._multi_cache.get(key)
         if cached is not None:
             return cached
         step_fn = self._step_fn
+        all_stacked = stacked is True
 
         def fn(state, consts, feeds, seed0):
+            sf = {n: v for n, v in feeds.items()
+                  if all_stacked or n in snames}
+            rf = {n: v for n, v in feeds.items() if n not in sf}
             # the step fn returns state_names ∪ created_persistable; the
             # scan carry must have the same structure, so seed the carry
             # with zero placeholders for persistables first CREATED by this
             # block (they're written before read, so the zeros never leak)
             if self.sig.created_persistable:
-                feeds0 = (jax.tree_util.tree_map(lambda x: x[0], feeds)
-                          if stacked else feeds)
+                feeds0 = {**rf, **jax.tree_util.tree_map(
+                    lambda x: x[0], sf)}
                 _, out_sd = jax.eval_shape(step_fn, state, consts, feeds0,
                                            seed0)
                 state = dict(state)
@@ -278,14 +288,12 @@ class CompiledBlock:
                                              out_sd[n].dtype)
 
             def body(carry, xs):
-                i, feed_i = xs
+                i, sf_i = xs
                 fetches, new_state = step_fn(carry, consts,
-                                             feed_i if stacked else feeds,
-                                             seed0 + i)
+                                             {**rf, **sf_i}, seed0 + i)
                 return new_state, tuple(fetches)
             idx = jnp.arange(iterations, dtype=jnp.uint32)
-            xs = (idx, feeds if stacked else None)
-            new_state, fetches = jax.lax.scan(body, state, xs)
+            new_state, fetches = jax.lax.scan(body, state, (idx, sf))
             return list(fetches), new_state
 
         jit_kwargs = dict(self._jit_kwargs)
@@ -295,7 +303,8 @@ class CompiledBlock:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 mesh = self.dist.mesh
                 feed_sh = {
-                    n: NamedSharding(mesh, P(None, *sh.spec))
+                    n: (NamedSharding(mesh, P(None, *sh.spec))
+                        if (all_stacked or n in snames) else sh)
                     for n, sh in feed_sh.items()}
             jit_kwargs["in_shardings"] = (state_sh, const_sh, feed_sh, repl)
         jitted = jax.jit(fn, **jit_kwargs)
@@ -303,10 +312,11 @@ class CompiledBlock:
         return jitted
 
     def run_steps(self, scope, feeds: Dict[str, Any], step_seed0: int,
-                  iterations: int, stacked: bool = False):
+                  iterations: int, stacked=False):
         """Run `iterations` training steps in one device-side loop.
         `feeds` maps name -> array (resident batch, reused every step) or,
-        with stacked=True, name -> array with a leading [iterations] axis.
+        with stacked=True (or the name listed in a stacked iterable),
+        name -> array with a leading [iterations] axis.
         Returns per-step stacked fetches. Reference capability: amortized
         multi-step execution (executor.cc:448 interpreter loop,
         threaded_ssa_graph_executor.cc)."""

@@ -79,6 +79,24 @@ def _conv2d(ctx, ins, attrs):
     x = _nhwc_in(x, attrs)
     dn = ("NHWC", "OIHW", "NHWC") if attrs.get("__nhwc__") \
         else ("NCHW", "OIHW", "NCHW")
+    ig = w.shape[1]                  # input channels per group
+    if 1 < groups and ig < 16 and groups <= 64:
+        # lane-starved grouped conv (e.g. SE-ResNeXt cardinality 32 with
+        # 4-8 channels/group): the MXU contracts only `ig` of its 128
+        # lanes per group — measured 2-3% MXU efficiency, ~1 ms per conv
+        # on v5e. Lower to a DENSE conv with a block-diagonal kernel:
+        # 'groups'x the nominal FLOPs but at dense-conv efficiency, which
+        # wins for ig < 16 (model FLOPs for MFU still count the grouped
+        # formula — implementation FLOPs are excluded by convention).
+        # The eye-mask product keeps AD exact: off-block grad leakage is
+        # zeroed by the same mask in the vjp.
+        o = w.shape[0]
+        og = o // groups
+        eye = jnp.eye(groups, dtype=w.dtype)
+        w_g = w.reshape((groups, og) + w.shape[1:])
+        dense = w_g[:, :, None] * eye[:, None, :, None, None, None]
+        w = dense.reshape((o, groups * ig) + w.shape[2:])
+        groups = 1
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -189,8 +207,15 @@ def _pool2d(ctx, ins, attrs):
         padding[d] = (pads[i], pads[i])
     window, strides4, padding = tuple(window), tuple(strides4), tuple(padding)
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+        # backward goes through XLA's select_and_scatter (first-max tie
+        # rule, matching math/pooling.cc MaxPool2dGradFunctor). An
+        # unrolled shifted-window custom-vjp formulation was measured
+        # in-model on v5e and REJECTED: resnet50 2726->2128 img/s,
+        # googlenet 5782->2327 (9 dilated pad+add passes do not fuse).
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                    strides4, padding)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
         if attrs.get("exclusive", True) and (pads[0] or pads[1]):

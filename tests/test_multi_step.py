@@ -170,3 +170,62 @@ def test_iterations_under_mesh():
     (losses,) = exe.run(cp, feed=b, fetch_list=[loss], iterations=4)
     assert losses.shape == (4,)
     assert losses[-1] < losses[0]
+
+
+def test_partial_stacked_feed_matches_single_steps():
+    """stacked_feed=[names]: listed feeds scan per-step while the rest
+    stay resident — exact parity with N single steps (the bench uses this
+    to rotate labels over a resident image batch)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 21
+        startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[6], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="int64")
+            h = layers.fc(x, 8, act="relu")
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                layers.fc(h, 4), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(8, 6).astype(np.float32)
+    ys = rng.randint(0, 4, (4, 8, 1)).astype(np.int64)
+
+    main1, startup1, loss1 = build()
+    scope1 = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup1, scope=scope1)
+    singles = [float(exe.run(main1, feed={"x": xb, "y": ys[i]},
+                             fetch_list=[loss1], scope=scope1)[0])
+               for i in range(4)]
+
+    main2, startup2, loss2 = build()
+    scope2 = fluid.Scope()
+    exe.run(startup2, scope=scope2)
+    (stacked,) = exe.run(main2, feed={"x": xb, "y": ys},
+                         fetch_list=[loss2], scope=scope2,
+                         iterations=4, stacked_feed=["y"])
+    np.testing.assert_allclose(singles, np.asarray(stacked).ravel(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partial_stacked_feed_validates_names():
+    import numpy as np
+    import pytest
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3], dtype="float32")
+        loss = layers.mean(layers.fc(x, 2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(ValueError, match="not in the feed dict"):
+        exe.run(main, feed={"x": np.zeros((2, 3), np.float32)},
+                fetch_list=[loss], iterations=2, stacked_feed=["nope"])
