@@ -29,3 +29,14 @@ def synthetic_classification(n, feature_shape, n_classes, seed):
         int(np.prod(feature_shape)), n_classes)
     y = np.argmax(x.reshape(n, -1) @ proj, axis=1).astype(np.int64)
     return x, y
+
+
+def data_file(subdir: str, *names):
+    """First existing raw-data file under DATA_HOME/subdir/ from `names`
+    (the reference's download-cache layout, dataset/common.py download()),
+    or None — callers fall back to the npz cache, then synthetic data."""
+    for name in names:
+        path = os.path.join(DATA_HOME, subdir, name)
+        if os.path.exists(path):
+            return path
+    return None

@@ -1,19 +1,156 @@
 """CoNLL-2005 SRL reader (reference: python/paddle/dataset/conll05.py —
 get_dict() returning (word_dict, verb_dict, label_dict), get_embedding(),
-test() yielding (word, ctx_n2..ctx_p2, verb, mark, label) sequences)."""
+test() yielding (word, ctx_n2..ctx_p2, verb, mark, label) sequences).
+
+Real format (reference conll05.py:76-202): a test tar with gzipped
+`words` / `props` members — words one token per line, props the
+bracketed SRL columns ("(A0*", "*", "*)", "(V*)") with blank lines
+ending sentences; labels convert to B-/I-/O; the 9-tuple framing
+replicates reader_creator's verb context windows. Dict files (wordDict/
+verbDict/targetDict one entry per line) live next to the tar under
+DATA_HOME/conll05st/. Divergence: load_label_dict iterates the role set
+SORTED (the reference iterates a Python set, i.e. unspecified order).
+"""
 
 from __future__ import annotations
+
+import gzip
+import tarfile
 
 import numpy as np
 
 from paddle_tpu.dataset import common
+
+UNK_IDX = 0
 
 WORD_VOCAB = 44068
 VERB_VOCAB = 3162
 LABEL_COUNT = 67        # B-/I-/O tags over 33 roles
 
 
+def load_dict(path):
+    """{line: index} (reference conll05.py:68 load_dict)."""
+    d = {}
+    with open(path) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def load_label_dict(path):
+    """B-/I- role pairs then O (reference conll05.py:48 load_label_dict;
+    roles sorted here for determinism)."""
+    tags = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith(("B-", "I-")):
+                tags.add(line[2:])
+    d = {}
+    for tag in sorted(tags):
+        d["B-" + tag] = len(d)
+        d["I-" + tag] = len(d)
+    d["O"] = len(d)
+    return d
+
+
+def corpus_reader(tar_path, words_name="conll05st-release/test.wsj/"
+                  "words/test.wsj.words.gz",
+                  props_name="conll05st-release/test.wsj/"
+                  "props/test.wsj.props.gz"):
+    """Yield (sentence words, predicate, B-/I-/O labels) per proposition
+    (reference conll05.py:76-147 corpus_reader — the bracket-column
+    decoding)."""
+
+    def reader():
+        with tarfile.open(tar_path) as tf:
+            wf = gzip.GzipFile(fileobj=tf.extractfile(words_name))
+            pf = gzip.GzipFile(fileobj=tf.extractfile(props_name))
+            sentences, one_seg = [], []
+            for word, prop in zip(wf, pf):
+                word = word.decode("utf-8").strip()
+                cols = prop.decode("utf-8").strip().split()
+                if not cols:                       # sentence boundary
+                    labels = []
+                    for i in range(len(one_seg[0]) if one_seg else 0):
+                        labels.append([row[i] for row in one_seg])
+                    if labels:
+                        verbs = [x for x in labels[0] if x != "-"]
+                        for i, lbl in enumerate(labels[1:]):
+                            cur, in_br, seq = "O", False, []
+                            for l in lbl:
+                                if l == "*" and not in_br:
+                                    seq.append("O")
+                                elif l == "*" and in_br:
+                                    seq.append("I-" + cur)
+                                elif l == "*)":
+                                    seq.append("I-" + cur)
+                                    in_br = False
+                                elif "(" in l and ")" in l:
+                                    cur = l[1:l.find("*")]
+                                    seq.append("B-" + cur)
+                                    in_br = False
+                                elif "(" in l:
+                                    cur = l[1:l.find("*")]
+                                    seq.append("B-" + cur)
+                                    in_br = True
+                                else:
+                                    raise RuntimeError(
+                                        f"unexpected label {l!r}")
+                            yield sentences, verbs[i], seq
+                    sentences, one_seg = [], []
+                else:
+                    sentences.append(word)
+                    one_seg.append(cols)
+    return reader
+
+
+def reader_creator(corpus, word_dict, predicate_dict, label_dict):
+    """The reference's 9-tuple framing (conll05.py:150-202): verb context
+    window ids broadcast over the sentence + the +-2 mark vector."""
+
+    def reader():
+        for sentence, predicate, labels in corpus():
+            n = len(sentence)
+            v = labels.index("B-V")
+            mark = [0] * n
+            ctx = {}
+            for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                  (0, "0", None), (1, "p1", "eos"),
+                                  (2, "p2", "eos")):
+                j = v + off
+                if 0 <= j < n:
+                    mark[j] = 1
+                    ctx[key] = sentence[j]
+                else:
+                    ctx[key] = pad
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            rows = [word_idx]
+            for key in ("n2", "n1", "0", "p1", "p2"):
+                rows.append([word_dict.get(ctx[key], UNK_IDX)] * n)
+            rows.append([predicate_dict.get(predicate)] * n)
+            rows.append(mark)
+            rows.append([label_dict.get(l) for l in labels])
+            yield tuple(rows)
+    return reader
+
+
+def _real_files():
+    tar = common.data_file("conll05st", "conll05st-tests.tar.gz",
+                           "conll05st.tar.gz")
+    wd = common.data_file("conll05st", "wordDict.txt")
+    vd = common.data_file("conll05st", "verbDict.txt")
+    td = common.data_file("conll05st", "targetDict.txt")
+    if tar and wd and vd and td:
+        return tar, wd, vd, td
+    return None
+
+
 def get_dict():
+    real = _real_files()
+    if real:
+        _, wd, vd, td = real
+        return load_dict(wd), load_dict(vd), load_label_dict(td)
     word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
     verb_dict = {f"v{i}": i for i in range(VERB_VOCAB)}
     label_dict = {f"l{i}": i for i in range(LABEL_COUNT)}
@@ -51,4 +188,9 @@ def _reader(n, seed):
 
 
 def test():
+    real = _real_files()
+    if real:
+        tar, wd, vd, td = real
+        return reader_creator(corpus_reader(tar), load_dict(wd),
+                              load_dict(vd), load_label_dict(td))
     return _reader(512, 111)

@@ -1,7 +1,20 @@
 """Oxford-102 flowers reader (reference: python/paddle/dataset/flowers.py —
-train()/test()/valid() yielding (3x224x224 float image, int label))."""
+train()/test()/valid() yielding (flattened 3x224x224 float image, label)).
+
+Real format (reference flowers.py:78-140): 102flowers.tgz of
+jpg/image_%05d.jpg files, imagelabels.mat ('labels' row vector, 1-based)
+and setid.mat ('trnid'/'valid'/'tstid' index rows) — scipy.io.loadmat +
+PIL decode, resize-256 / center-crop-224 / BGR mean subtract
+([103.94, 116.78, 123.68], image.py simple_transform). Divergences:
+deterministic center crop for train too (the reference random-crops +
+random-flips in train mode), and no batch-pickle cache layer. Raw files
+at DATA_HOME/flowers/.
+"""
 
 from __future__ import annotations
+
+import io
+import tarfile
 
 import numpy as np
 
@@ -9,10 +22,56 @@ from paddle_tpu.dataset import common
 
 N_CLASSES = 102
 IMG_SHAPE = (3, 224, 224)
+MEAN_BGR = (103.94, 116.78, 123.68)
+# reference flowers.py:39-46: train/test/valid read the tstid/trnid/valid
+# index sets respectively (deliberately crossed: the 'train' reader uses
+# the larger tstid split)
+SPLIT_KEY = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+
+def transform_image(img, crop=224, resize=256):
+    """PIL image -> flattened CHW float32, BGR mean-subtracted (the
+    reference's simple_transform via load_image_bytes, deterministic
+    center crop)."""
+    img = img.convert("RGB")
+    w, h = img.size
+    scale = resize / min(w, h)
+    img = img.resize((max(crop, int(round(w * scale))),
+                      max(crop, int(round(h * scale)))))
+    w, h = img.size
+    left, top = (w - crop) // 2, (h - crop) // 2
+    img = img.crop((left, top, left + crop, top + crop))
+    arr = np.asarray(img, dtype=np.float32)       # HWC RGB
+    bgr = arr[:, :, ::-1] - np.array(MEAN_BGR, np.float32)
+    return bgr.transpose(2, 0, 1).ravel()         # CHW flattened
+
+
+def parse_archives(data_tgz, label_mat, setid_mat, split):
+    """Yield (flattened image, 0-based label) for the split's index set
+    (reference flowers.py reader_creator: labels[i-1] over setid rows)."""
+    import scipy.io as scio
+    from PIL import Image
+    labels = scio.loadmat(label_mat)["labels"][0]
+    indexes = scio.loadmat(setid_mat)[SPLIT_KEY[split]][0]
+    wanted = {f"jpg/image_{i:05d}.jpg": int(labels[i - 1])
+              for i in indexes}
+    with tarfile.open(data_tgz) as tar:
+        for m in tar.getmembers():
+            lbl = wanted.get(m.name)
+            if lbl is None:
+                continue
+            img = Image.open(io.BytesIO(tar.extractfile(m).read()))
+            yield transform_image(img), int(lbl) - 1
 
 
 def _reader(split, n, seed):
     def reader():
+        tgz = common.data_file("flowers", "102flowers.tgz")
+        lab = common.data_file("flowers", "imagelabels.mat")
+        ids = common.data_file("flowers", "setid.mat")
+        if tgz and lab and ids:
+            yield from parse_archives(tgz, lab, ids, split)
+            return
         data = common.cached_npz(f"flowers_{split}")
         if data is not None:
             xs, ys = data["x"], data["y"]

@@ -21,7 +21,60 @@ _N_TRAIN = 2000
 _N_TEST = 200
 
 
+
+def word_count(lines, word_freq=None):
+    """reference imikolov.py:40-50 — <s>/<e> counted once per line."""
+    from collections import defaultdict
+    if word_freq is None:
+        word_freq = defaultdict(int)
+    for l in lines:
+        for w in l.split():
+            word_freq[w] += 1
+        word_freq["<s>"] += 1
+        word_freq["<e>"] += 1
+    return word_freq
+
+
+def _tar():
+    return common.data_file("imikolov", "simple-examples.tgz")
+
+
+TRAIN_MEMBER = "./simple-examples/data/ptb.train.txt"
+TEST_MEMBER = "./simple-examples/data/ptb.valid.txt"
+
+
+def parse_tar(tar_path, member):
+    """PTB sentences (token lists) from the simple-examples tar."""
+    import tarfile
+    with tarfile.open(tar_path) as tf:
+        for line in tf.extractfile(member):
+            yield line.decode("utf-8").strip().split()
+
+
+def build_dict_real(tar_path, min_word_freq=50):
+    """reference imikolov.py:52-76 build_dict: words with freq >=
+    min_word_freq sorted by (-freq, word); <unk> removed then appended
+    last."""
+    freq = word_count(
+        (" ".join(w) for w in parse_tar(tar_path, TEST_MEMBER)),
+        word_count((" ".join(w)
+                    for w in parse_tar(tar_path, TRAIN_MEMBER))))
+    freq.pop("<unk>", None)
+    kept = sorted([kv for kv in freq.items() if kv[1] >= min_word_freq],
+                  key=lambda kv: (-kv[1], kv[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(kept)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
 def _sentences(split: str, n: int, seed: int):
+    tar = _tar()
+    if tar is not None:
+        member = TRAIN_MEMBER if split == "train" else TEST_MEMBER
+        # token STRINGS — reader_creator maps them through word_idx
+        # (yield from, not return: this is a generator function)
+        yield from parse_tar(tar, member)
+        return
     data = common.cached_npz(f"imikolov_{split}")
     if data is not None:
         for row in data["sents"]:
@@ -56,8 +109,13 @@ def _vocab_size():
 
 def build_dict(min_word_freq=50):
     """reference: imikolov.py:53 — word -> contiguous index, '<unk>' last.
-    The corpus is integer-coded; the dict maps token ids (as strings,
-    mirroring the word->idx contract) plus '<unk>'/'<e>' above them."""
+    Real corpus (simple-examples.tgz present): frequency-filtered PTB
+    vocabulary (build_dict_real). Synthetic fallback: the corpus is
+    integer-coded; the dict maps token ids (as strings, mirroring the
+    word->idx contract) plus '<unk>'/'<e>' above them."""
+    tar = _tar()
+    if tar is not None:
+        return build_dict_real(tar, min_word_freq)
     vocab = _vocab_size()
     word_idx = {str(i): i for i in range(vocab)}
     word_idx["<e>"] = len(word_idx)
@@ -70,9 +128,16 @@ def reader_creator(split, word_idx, n, data_type=DataType.NGRAM,
     """reference: imikolov.py:83 — NGRAM yields n-word sliding windows,
     SEQ yields (input_seq, shifted target_seq)."""
     end = word_idx["<e>"]
+    unk = word_idx.get("<unk>", end)
 
     def reader():
         for sent in _sentences(split, n_sents, seed):
+            # real-corpus sentences are token strings; map through
+            # word_idx like the reference (imikolov.py reader: UNK for
+            # out-of-vocabulary). Synthetic/cached sentences are already
+            # integer-coded.
+            sent = [word_idx.get(w, unk) if isinstance(w, str) else w
+                    for w in sent]
             if data_type == DataType.NGRAM:
                 assert n > -1, "Invalid gram length"
                 s = sent + [end]

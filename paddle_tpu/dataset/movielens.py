@@ -1,9 +1,21 @@
 """MovieLens-1M reader (reference: python/paddle/dataset/movielens.py —
 get_movie_title_dict, max_movie_id, max_user_id, max_job_id, age_table,
 train()/test() yielding [user_id, gender, age, job, movie_id, categories,
-title, rating])."""
+title, rating]).
+
+Real format (reference movielens.py:100-170): the ml-1m.zip with
+`::`-separated movies.dat (MovieID::Title (Year)::Cat|Cat),
+users.dat (UserID::Gender::Age::Job::zip) and ratings.dat
+(UserID::MovieID::Rating::ts); rating rescales to rating*2-5; the
+title's trailing "(Year)" is stripped; the train/test split hashes each
+rating row with a seeded RNG at test_ratio=0.1 (movielens.py:155). Raw
+zip at DATA_HOME/movielens/ml-1m.zip.
+"""
 
 from __future__ import annotations
+
+import re
+import zipfile
 
 import numpy as np
 
@@ -36,11 +48,21 @@ def age_table():
     return list(AGES)
 
 
+def _zip():
+    return common.data_file("movielens", "ml-1m.zip")
+
+
 def movie_categories():
+    zp = _zip()
+    if zp is not None:
+        return _real_dicts(zp)[0]
     return {c: i for i, c in enumerate(CATEGORIES)}
 
 
 def get_movie_title_dict():
+    zp = _zip()
+    if zp is not None:
+        return _real_dicts(zp)[1]
     return {f"w{i}": i for i in range(_TITLE_VOCAB)}
 
 
@@ -65,8 +87,72 @@ def _rows(split, n, seed):
     return rows
 
 
+def parse_zip(zip_path):
+    """(movies, users, ratings) from the ml-1m zip: movies {id: (title
+    words lower, [category names])}, users {id: (is_male, age_idx, job)},
+    ratings [(uid, mid, rating*2-5)] — reference framing
+    (movielens.py:112-160)."""
+    title_pat = re.compile(r"^(.*)\((\d+)\)$")
+    movies, users, ratings = {}, {}, []
+    with zipfile.ZipFile(zip_path) as z:
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f:
+                mid, title, cats = \
+                    line.decode("latin-1").strip().split("::")
+                m = title_pat.match(title)
+                title = m.group(1) if m else title
+                movies[int(mid)] = (title, cats.split("|"))
+        with z.open("ml-1m/users.dat") as f:
+            for line in f:
+                uid, gender, age, job, _ = \
+                    line.decode("latin-1").strip().split("::")
+                users[int(uid)] = (gender == "M",
+                                   AGES.index(int(age)), int(job))
+        with z.open("ml-1m/ratings.dat") as f:
+            for line in f:
+                uid, mid, r, _ = line.decode("latin-1").strip().split("::")
+                ratings.append((int(uid), int(mid), float(r) * 2 - 5.0))
+    return movies, users, ratings
+
+
+def _real_dicts(zip_path):
+    """(category dict, title-word dict) in the first-seen order
+    real_reader emits — shared so vocab-sizing helpers agree with the
+    reader's ids."""
+    movies, _, _ = parse_zip(zip_path)
+    cat_dict, title_dict = {}, {}
+    for title, cats in movies.values():
+        for c in cats:
+            cat_dict.setdefault(c, len(cat_dict))
+        for w in title.split():
+            title_dict.setdefault(w.lower(), len(title_dict))
+    return cat_dict, title_dict
+
+
+def real_reader(zip_path, is_test, test_ratio=0.1, rand_seed=0):
+    """Yield the reference row framing: [uid, gender(0=M), age_idx, job,
+    movie_id, [category ids], [title word ids], [rating*2-5]]; the split
+    draws one uniform per rating row (movielens.py __reader__)."""
+    movies, users, ratings = parse_zip(zip_path)
+    cat_dict, title_dict = _real_dicts(zip_path)
+    rng = np.random.RandomState(rand_seed)
+    for uid, mid, rating in ratings:
+        if (rng.random_sample() < test_ratio) != bool(is_test):
+            continue
+        is_male, age_idx, job = users[uid]
+        title, cats = movies[mid]
+        yield (uid, 0 if is_male else 1, age_idx, job, mid,
+               [cat_dict[c] for c in cats],
+               [title_dict[w.lower()] for w in title.split()],
+               [rating])
+
+
 def _reader(split, n, seed):
     def reader():
+        zp = _zip()
+        if zp is not None:
+            yield from real_reader(zp, is_test=(split == "test"))
+            return
         for row in _rows(split, n, seed):
             yield row
     return reader

@@ -1,7 +1,12 @@
 """MQ2007 learning-to-rank reader (reference:
 python/paddle/dataset/mq2007.py — LETOR 4.0 query/document relevance with
 pointwise/pairwise/listwise generators). Synthetic query groups stand in
-when no cached data exists (zoo convention, dataset/common.py)."""
+when no cached data exists (zoo convention, dataset/common.py).
+
+Real format (reference mq2007.py:92-105 Query.one_line_parse_): LETOR
+lines "rel qid:N 1:v 2:v ... 46:v #docid = ..." grouped by qid; files
+DATA_HOME/MQ2007/{train,test}.txt.
+"""
 
 from __future__ import annotations
 
@@ -14,8 +19,41 @@ _N_QUERIES_TRAIN = 120
 _N_QUERIES_TEST = 30
 
 
+def parse_letor(path):
+    """Yield (labels [D], features [D, 46]) per qid group from a LETOR
+    file (consecutive same-qid lines form one query, matching the
+    reference's sequential QueryList loader)."""
+    cur_qid, labels, feats = None, [], []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = float(parts[0])
+            qid = int(parts[1].split(":")[1])
+            vec = np.zeros(FEATURE_DIM, np.float32)
+            for p in parts[2:]:
+                k, v = p.split(":")
+                vec[int(k) - 1] = float(v)
+            if cur_qid is not None and qid != cur_qid and labels:
+                yield (np.asarray(labels, np.float32),
+                       np.asarray(feats, np.float32))
+                labels, feats = [], []
+            cur_qid = qid
+            labels.append(rel)
+            feats.append(vec)
+    if labels:
+        yield (np.asarray(labels, np.float32),
+               np.asarray(feats, np.float32))
+
+
 def _query_groups(split: str, n_queries: int, seed: int):
     """Yield (labels [D], features [D, 46]) per query."""
+    raw = common.data_file("MQ2007", f"{split}.txt")
+    if raw is not None:
+        yield from parse_letor(raw)
+        return
     data = common.cached_npz(f"mq2007_{split}")
     if data is not None:
         for labels, feats in zip(data["labels"], data["features"]):

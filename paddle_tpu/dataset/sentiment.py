@@ -1,22 +1,89 @@
 """Movie-review sentiment reader (reference:
 python/paddle/dataset/sentiment.py — NLTK movie_reviews; get_word_dict(),
-train()/test() yielding (word-id list, 0/1 label))."""
+train()/test() yielding (word-id list, 0/1 label)).
+
+Real format: the NLTK movie_reviews corpus layout —
+DATA_HOME/corpora/movie_reviews/{neg,pos}/*.txt, whitespace-pretokenized
+— parsed directly (no nltk import needed). get_word_dict sorts words by
+descending corpus frequency (sentiment.py:57-75); samples interleave
+neg/pos (sort_files, :78-89); train = first NUM_TRAINING_INSTANCES of
+the interleaved list, test = the rest.
+"""
 
 from __future__ import annotations
+
+import functools
+import glob
+import os
 
 import numpy as np
 
 from paddle_tpu.dataset import common
 
 VOCAB = 5147
+NUM_TRAINING_INSTANCES = 1600
+
+
+def _corpus_dir():
+    d = os.path.join(common.DATA_HOME, "corpora", "movie_reviews")
+    return d if os.path.isdir(d) else None
+
+
+def _files(root, cat):
+    return sorted(glob.glob(os.path.join(root, cat, "*.txt")))
+
+
+def _words(path):
+    with open(path, encoding="latin-1") as f:
+        return [w.lower() for w in f.read().split()]
+
+
+@functools.lru_cache(maxsize=4)
+def build_word_dict(root):
+    """[(word, id)] by descending frequency (reference get_word_dict)."""
+    from collections import defaultdict
+    freq = defaultdict(int)
+    for cat in ("neg", "pos"):
+        for p in _files(root, cat):
+            for w in _words(p):
+                freq[w] += 1
+    ordered = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    return [(w, i) for i, (w, _) in enumerate(ordered)]
+
+
+@functools.lru_cache(maxsize=4)
+def load_sentiment_data(root):
+    """Interleaved neg/pos (word ids, 0/1) samples (reference
+    load_sentiment_data + sort_files)."""
+    ids = dict(build_word_dict(root))
+    neg, pos = _files(root, "neg"), _files(root, "pos")
+    data = []
+    for n, p in zip(neg, pos):
+        data.append(([ids[w] for w in _words(n)], 0))
+        data.append(([ids[w] for w in _words(p)], 1))
+    return data
 
 
 def get_word_dict():
+    """{word: id} — ONE return type on both the real-corpus and
+    fallback paths (the reference returns a sorted (word, id) list;
+    dict(get_word_dict()) of that is this)."""
+    root = _corpus_dir()
+    if root:
+        return dict(build_word_dict(root))
     return {f"w{i}": i for i in range(VOCAB)}
 
 
 def _reader(split, n, seed):
     def reader():
+        root = _corpus_dir()
+        if root:
+            data = load_sentiment_data(root)
+            sel = (data[:NUM_TRAINING_INSTANCES] if split == "train"
+                   else data[NUM_TRAINING_INSTANCES:])
+            for ids, y in sel:
+                yield ids, y
+            return
         data = common.cached_npz(f"sentiment_{split}")
         if data is not None:
             for ids, y in zip(data["ids"], data["y"]):

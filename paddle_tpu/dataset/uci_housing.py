@@ -1,5 +1,11 @@
 """UCI housing reader (reference: python/paddle/dataset/uci_housing.py —
-13-feature regression; the fit_a_line book test's dataset)."""
+13-feature regression; the fit_a_line book test's dataset).
+
+Real format (reference uci_housing.py:69-85 load_data): housing.data of
+whitespace-separated 14-column rows; features normalize to
+(x - avg) / (max - min) computed over the WHOLE file; first 80% of rows
+train, rest test. Raw file at DATA_HOME/uci_housing/housing.data.
+"""
 
 from __future__ import annotations
 
@@ -7,9 +13,31 @@ import numpy as np
 
 from paddle_tpu.dataset import common
 
+FEATURE_NUM = 14
+
+
+def load_data(path, feature_num=FEATURE_NUM, ratio=0.8):
+    """(train rows, test rows) with the reference's normalization."""
+    data = np.fromfile(path, sep=" ")
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maxs, mins = data.max(axis=0), data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
 
 def _reader(split: str, n: int, seed: int):
     def reader():
+        raw = common.data_file("uci_housing", "housing.data")
+        if raw is not None:
+            tr, te = load_data(raw)
+            rows = tr if split == "train" else te
+            for row in rows:
+                yield (row[:-1].astype(np.float32),
+                       row[-1:].astype(np.float32))
+            return
         data = common.cached_npz(f"uci_housing_{split}")
         if data is not None:
             xs, ys = data["x"], data["y"]

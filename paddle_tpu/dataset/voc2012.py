@@ -1,19 +1,59 @@
 """PASCAL VOC2012 segmentation reader (reference:
 python/paddle/dataset/voc2012.py — train()/test()/val() yielding
-(3xHxW image, HxW label mask))."""
+(image, label mask) pairs in HWC order).
+
+Real format (reference voc2012.py:46-67): the VOCtrainval tar —
+ImageSets/Segmentation/{trainval,train,val}.txt name lists, JPEGImages/
+*.jpg, SegmentationClass/*.png — decoded with PIL into numpy arrays.
+Raw tar at DATA_HOME/voc2012/VOCtrainval_11-May-2012.tar.
+"""
 
 from __future__ import annotations
+
+import io
+import tarfile
 
 import numpy as np
 
 from paddle_tpu.dataset import common
 
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def parse_tar(tar_path, sub_name):
+    """Yield (HWC uint8 image, HW label mask) like the reference's
+    reader_creator (voc2012.py:46)."""
+    from PIL import Image
+    with tarfile.open(tar_path) as tar:
+        members = {m.name: m for m in tar.getmembers()}
+        for line in tar.extractfile(members[SET_FILE.format(sub_name)]):
+            name = line.decode("utf-8").strip()
+            if not name:
+                continue
+            img = Image.open(io.BytesIO(
+                tar.extractfile(members[DATA_FILE.format(name)]).read()))
+            lbl = Image.open(io.BytesIO(
+                tar.extractfile(members[LABEL_FILE.format(name)]).read()))
+            yield np.array(img), np.array(lbl)
+
 N_CLASSES = 21
-IMG_SHAPE = (3, 128, 128)     # reference images vary; synthetic fixed size
+# HWC like the reference reader (real images vary in size; synthetic is
+# a fixed 128x128) — both branches of the reader emit (HWC image, HW mask)
+IMG_SHAPE = (128, 128, 3)
+
+
+# reference split names: train()->trainval, test()->train, val()->val
+_SUB = {"train": "trainval", "test": "train", "val": "val"}
 
 
 def _reader(split, n, seed):
     def reader():
+        tar = common.data_file("voc2012", "VOCtrainval_11-May-2012.tar")
+        if tar is not None:
+            yield from parse_tar(tar, _SUB[split])
+            return
         data = common.cached_npz(f"voc2012_{split}")
         if data is not None:
             for x, y in zip(data["x"], data["y"]):
@@ -22,8 +62,8 @@ def _reader(split, n, seed):
         rng = np.random.RandomState(seed)
         for _ in range(n):
             img = rng.rand(*IMG_SHAPE).astype(np.float32)
-            # blocky learnable mask: argmax over channel thresholds
-            mask = (img[0] * N_CLASSES).astype(np.int64) % N_CLASSES
+            # blocky learnable mask: derived from the red channel
+            mask = (img[:, :, 0] * N_CLASSES).astype(np.int64) % N_CLASSES
             yield img, mask
     return reader
 

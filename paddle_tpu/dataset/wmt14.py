@@ -1,8 +1,18 @@
 """WMT14 en-fr reader (reference: python/paddle/dataset/wmt14.py —
 train(dict_size)/test(dict_size) yielding (src_ids, trg_ids, trg_ids_next)
-with <s>/<e>/<unk> framing)."""
+with <s>/<e>/<unk> framing).
+
+Real format (reference wmt14.py:56-115): a .tgz whose members end in
+`src.dict` / `trg.dict` (one token per line; first `dict_size` lines
+used) and train/test corpus files of tab-separated "src\ttrg" sentence
+pairs; pairs longer than 80 tokens are dropped. Raw tar is looked up at
+DATA_HOME/wmt14/wmt14.tgz; offline falls back to npz cache, then
+deterministic synthetic data.
+"""
 
 from __future__ import annotations
+
+import tarfile
 
 import numpy as np
 
@@ -11,11 +21,69 @@ from paddle_tpu.dataset import common
 START = 0        # <s>
 END = 1          # <e>
 UNK = 2          # <unk>
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+MAX_LEN = 80
 
 
-def _reader(split, dict_size, n, seed):
+def read_tar_dicts(tar_path, dict_size):
+    """{word: id} for source and target from the tar's *src.dict /
+    *trg.dict members (reference wmt14.py __read_to_dict)."""
+
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.decode("utf-8").strip()] = i
+        return out
+
+    with tarfile.open(tar_path, mode="r") as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        if len(src_name) != 1 or len(trg_name) != 1:
+            raise ValueError(
+                f"{tar_path}: expected exactly one src.dict and one "
+                f"trg.dict member, got {src_name} / {trg_name}")
+        src = to_dict(f.extractfile(src_name[0]), dict_size)
+        trg = to_dict(f.extractfile(trg_name[0]), dict_size)
+    return src, trg
+
+
+def parse_tar(tar_path, file_suffix, dict_size):
+    """Yield (src_ids, trg_ids, trg_ids_next) from corpus members ending
+    in `file_suffix` (reference wmt14.py reader_creator: START+words+END
+    source framing, >80-token pairs dropped)."""
+    src_dict, trg_dict = read_tar_dicts(tar_path, dict_size)
+    with tarfile.open(tar_path, mode="r") as f:
+        names = [m.name for m in f if m.name.endswith(file_suffix)]
+        for name in names:
+            for line in f.extractfile(name):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [src_dict.get(w, UNK)
+                           for w in [START_MARK] + parts[0].split()
+                           + [END_MARK]]
+                trg_ids = [trg_dict.get(w, UNK) for w in parts[1].split()]
+                if len(src_ids) > MAX_LEN or len(trg_ids) > MAX_LEN:
+                    continue
+                yield (src_ids, [trg_dict[START_MARK]] + trg_ids,
+                       trg_ids + [trg_dict[END_MARK]])
+
+
+def _reader(split, dict_size, n, seed, tar_path=None, use_tar=True):
+    suffix = "train" if "train" in split else "test"
+
     def reader():
-        data = common.cached_npz(f"wmt14_{split}_{dict_size}")
+        tar = tar_path if tar_path is not None else (
+            common.data_file("wmt14", "wmt14.tgz", "dev+test.tgz")
+            if use_tar else None)
+        if tar is not None:
+            yield from parse_tar(tar, suffix, dict_size)
+            return
+        data = common.cached_npz(f"{split}_{dict_size}")
         if data is not None:
             pairs = list(zip(data["src"], data["trg"]))
         else:
@@ -36,8 +104,25 @@ def _reader(split, dict_size, n, seed):
 
 
 def train(dict_size=30000):
-    return _reader("train", dict_size, 2048, 80)
+    return _reader("wmt14_train", dict_size, 2048, 80)
 
 
 def test(dict_size=30000):
-    return _reader("test", dict_size, 256, 81)
+    return _reader("wmt14_test", dict_size, 256, 81)
+
+
+def get_dict(dict_size, reverse=False):
+    """reference wmt14.py get_dict: the tar dicts when present, else the
+    synthetic id-named vocabulary."""
+    tar = common.data_file("wmt14", "wmt14.tgz", "dev+test.tgz")
+    if tar is not None:
+        src, trg = read_tar_dicts(tar, dict_size)
+        if reverse:
+            return ({v: k for k, v in src.items()},
+                    {v: k for k, v in trg.items()})
+        return src, trg
+    d = {i: f"tok_{i}" for i in range(dict_size)}
+    if reverse:
+        return d, dict(d)
+    rd = {v: k for k, v in d.items()}
+    return rd, dict(rd)

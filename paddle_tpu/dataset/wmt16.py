@@ -1,27 +1,111 @@
 """WMT16 en-de reader (reference: python/paddle/dataset/wmt16.py —
-train/test/validation(src_dict_size, trg_dict_size, src_lang) with BPE
-dicts; same (src, trg, trg_next) framing as wmt14)."""
+train/test/validation(src_dict_size, trg_dict_size, src_lang) yielding
+(src_ids, trg_ids, trg_ids_next)).
+
+Real format (reference wmt16.py:63-147): a .tar.gz with members
+wmt16/{train,val,test} of tab-separated "en\tde" pairs. The per-language
+dictionary is BUILT from the train corpus (wmt16.py:66-84 __build_dict):
+<s>, <e>, <unk> first, then words by descending frequency up to
+dict_size. Raw tar at DATA_HOME/wmt16/wmt16.tar.gz; offline falls back
+to the wmt14-style synthetic reader.
+"""
 
 from __future__ import annotations
 
-from paddle_tpu.dataset import wmt14
+import functools
+import tarfile
+from collections import defaultdict
+
+from paddle_tpu.dataset import common, wmt14
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+@functools.lru_cache(maxsize=16)
+def build_dict(tar_path, dict_size, lang, corpus_member="wmt16/train"):
+    """{word: id} built from the train corpus: the three marks first,
+    then words by descending frequency (reference wmt16.py __build_dict;
+    ties keep first-seen order like the reference's stable sort)."""
+    freq = defaultdict(int)
+    col = 0 if lang == "en" else 1
+    with tarfile.open(tar_path, mode="r") as f:
+        for line in f.extractfile(corpus_member):
+            parts = line.decode("utf-8").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[col].split():
+                freq[w] += 1
+    words = [w for w, _ in sorted(freq.items(), key=lambda kv: -kv[1])]
+    vocab = [START_MARK, END_MARK, UNK_MARK] + words[:max(dict_size - 3, 0)]
+    return {w: i for i, w in enumerate(vocab)}
+
+
+def parse_tar(tar_path, member, src_dict_size, trg_dict_size,
+              src_lang="en"):
+    """Yield (src_ids, trg_ids, trg_ids_next) (reference wmt16.py
+    reader_creator: START+src+END framing, marks shared across langs)."""
+    src_dict = build_dict(tar_path, src_dict_size, src_lang)
+    trg_lang = "de" if src_lang == "en" else "en"
+    trg_dict = build_dict(tar_path, trg_dict_size, trg_lang)
+    start_id, end_id, unk_id = (src_dict[START_MARK], src_dict[END_MARK],
+                                src_dict[UNK_MARK])
+    src_col = 0 if src_lang == "en" else 1
+    with tarfile.open(tar_path, mode="r") as f:
+        for line in f.extractfile(member):
+            parts = line.decode("utf-8").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src_ids = [start_id] + [src_dict.get(w, unk_id)
+                                    for w in parts[src_col].split()] \
+                + [end_id]
+            trg_ids = [trg_dict.get(w, unk_id)
+                       for w in parts[1 - src_col].split()]
+            yield (src_ids, [start_id] + trg_ids, trg_ids + [end_id])
+
+
+def _tar():
+    return common.data_file("wmt16", "wmt16.tar.gz", "wmt16.tgz")
+
+
+def _reader(member, synth_name, src_dict_size, trg_dict_size, src_lang,
+            n, seed):
+    def reader():
+        tar = _tar()
+        if tar is not None:
+            yield from parse_tar(tar, member, src_dict_size,
+                                 trg_dict_size, src_lang)
+            return
+        # use_tar=False: a wmt14 tar on disk must NOT masquerade as
+        # WMT16 en-de data — fall to the synthetic generator only
+        yield from wmt14._reader(synth_name,
+                                 min(src_dict_size, trg_dict_size),
+                                 n, seed, use_tar=False)()
+    return reader
 
 
 def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
-    return wmt14._reader("wmt16_train", min(src_dict_size, trg_dict_size),
-                         2048, 90)
+    return _reader("wmt16/train", "wmt16_train", src_dict_size,
+                   trg_dict_size, src_lang, 2048, 90)
 
 
 def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
-    return wmt14._reader("wmt16_test", min(src_dict_size, trg_dict_size),
-                         256, 91)
+    return _reader("wmt16/test", "wmt16_test", src_dict_size,
+                   trg_dict_size, src_lang, 256, 91)
 
 
 def validation(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
-    return wmt14._reader("wmt16_val", min(src_dict_size, trg_dict_size),
-                         256, 92)
+    return _reader("wmt16/val", "wmt16_val", src_dict_size,
+                   trg_dict_size, src_lang, 256, 92)
 
 
 def get_dict(lang, dict_size, reverse=False):
-    d = {i: f"{lang}_tok_{i}" for i in range(dict_size)}
-    return {v: k for k, v in d.items()} if reverse else d
+    tar = _tar()
+    if tar is not None:
+        d = build_dict(tar, dict_size, lang)
+    else:
+        d = {f"{lang}_tok_{i}": i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d

@@ -175,7 +175,8 @@ def test_dataset_zoo_breadth():
     assert y in (0, 1) and len(ids) >= 1
 
     img, mask = next(dataset.voc2012.train()())
-    assert img.shape == (3, 128, 128) and mask.shape == (128, 128)
+    # HWC like the reference reader (voc2012.py:46 docstring)
+    assert img.shape == (128, 128, 3) and mask.shape == (128, 128)
 
 
 def test_async_checkpointer_roundtrip(tmp_path):
