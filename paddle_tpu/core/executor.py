@@ -77,6 +77,8 @@ class Executor:
         if dist is None:
             return None
         return (dist.mesh, dist.data_axis, dist.model_axis, dist.sp_axis,
+                getattr(dist, "pp_axis", None),
+                getattr(dist, "ep_axis", None),
                 tuple(sorted((k, tuple(v))
                              for k, v in (dist.param_axes or {}).items())),
                 dist.reduce_strategy, getattr(dist, "auto_shard", True))

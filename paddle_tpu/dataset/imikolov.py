@@ -136,8 +136,11 @@ def reader_creator(split, word_idx, n, data_type=DataType.NGRAM,
             # word_idx like the reference (imikolov.py reader: UNK for
             # out-of-vocabulary). Synthetic/cached sentences are already
             # integer-coded.
-            sent = [word_idx.get(w, unk) if isinstance(w, str) else w
-                    for w in sent]
+            if sent and isinstance(sent[0], str):
+                # real corpus: the reference's framing (imikolov.py:83)
+                # is [<s>] + words + [<e>] with UNK for OOV
+                sent = [word_idx.get("<s>", unk)] + \
+                    [word_idx.get(w, unk) for w in sent]
             if data_type == DataType.NGRAM:
                 assert n > -1, "Invalid gram length"
                 s = sent + [end]

@@ -14,6 +14,7 @@ zip at DATA_HOME/movielens/ml-1m.zip.
 
 from __future__ import annotations
 
+import functools
 import re
 import zipfile
 
@@ -87,6 +88,7 @@ def _rows(split, n, seed):
     return rows
 
 
+@functools.lru_cache(maxsize=2)
 def parse_zip(zip_path):
     """(movies, users, ratings) from the ml-1m zip: movies {id: (title
     words lower, [category names])}, users {id: (is_male, age_idx, job)},

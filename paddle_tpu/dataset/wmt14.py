@@ -83,7 +83,8 @@ def _reader(split, dict_size, n, seed, tar_path=None, use_tar=True):
         if tar is not None:
             yield from parse_tar(tar, suffix, dict_size)
             return
-        data = common.cached_npz(f"{split}_{dict_size}")
+        data = (common.cached_npz(f"{split}_{dict_size}")
+                or common.cached_npz(f"wmt14_{split}_{dict_size}"))
         if data is not None:
             pairs = list(zip(data["src"], data["trg"]))
         else:

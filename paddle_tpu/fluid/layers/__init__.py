@@ -39,6 +39,8 @@ from paddle_tpu.fluid.layers.ops import (  # noqa: F401
     tanh_shrink, selu, hard_shrink, soft_shrink, softshrink,
     thresholded_relu, brelu, stanh, maxout, flatten, space_to_depth,
     l1_norm)
+from paddle_tpu.fluid.layers.parallel import (  # noqa: F401
+    Pipeline, switch_moe)
 from paddle_tpu.fluid.layers import detection  # noqa: F401
 from paddle_tpu.fluid.layers.detection import (  # noqa: F401
     anchor_generator, bipartite_match, box_coder, density_prior_box,

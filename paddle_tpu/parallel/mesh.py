@@ -63,6 +63,13 @@ class DistributeConfig:
     # dim here (ring attention / Ulysses — parallel/ring_attention.py);
     # long-context capability beyond the reference's LoD story
     sp_axis: Optional[str] = "sp"
+    # pipeline-parallel axis: fluid.layers.Pipeline sections shard one
+    # stage per rank and run the GPipe schedule (parallel/pipeline.py);
+    # n_microbatches is the Pipeline layer's default
+    pp_axis: Optional[str] = None
+    # expert-parallel axis: fluid.layers.switch_moe expert weights shard
+    # here with all-to-all token dispatch (parallel/moe.py)
+    ep_axis: Optional[str] = None
     # param sharding rules: {param name regex: PartitionSpec-like tuple};
     # overrides per-var dist hints recorded by layers
     param_axes: Dict[str, tuple] = field(default_factory=dict)
@@ -134,10 +141,7 @@ class DistributeConfig:
             return weakref.ref(b, lambda _r, _c=cache, _k=key:
                                _c.pop(_k, None))
         roles: Dict[str, tuple] = {}
-        ax, size = self._model_axis_size()
-        if not self.auto_shard or not ax or size <= 1:
-            cache[key] = (_ref(block), len(block.ops), roles)
-            return roles
+        kinds: Dict[str, str] = {}
 
         def param_shape(n):
             if n and block.has_var(n):
@@ -146,7 +150,36 @@ class DistributeConfig:
                     return v.shape
             return None
 
-        kinds: Dict[str, str] = {}
+        def axis_ok(a):
+            return (a and self.mesh is not None
+                    and a in self.mesh.axis_names
+                    and self.mesh.shape[a] > 1)
+
+        # structural pp/ep roles first (independent of model_axis): a
+        # pipeline section's stacked stage params shard one stage per pp
+        # rank; switch_moe expert weights shard over ep (GateW replicates)
+        if self.auto_shard:
+            for op in block.ops:
+                if op.type == "pipeline" and axis_ok(self.pp_axis):
+                    for n in op.inputs.get("Params", []):
+                        sh = param_shape(n)
+                        if sh:
+                            roles[n] = (self.pp_axis,) + \
+                                (None,) * (len(sh) - 1)
+                            kinds[n] = "pipeline"
+                elif op.type == "moe_ffn" and axis_ok(self.ep_axis):
+                    for slot in ("W1", "B1", "W2", "B2"):
+                        n = (op.inputs.get(slot) or [None])[0]
+                        sh = param_shape(n)
+                        if sh:
+                            roles[n] = (self.ep_axis,) + \
+                                (None,) * (len(sh) - 1)
+                            kinds[n] = "moe"
+
+        ax, size = self._model_axis_size()
+        if not self.auto_shard or not ax or size <= 1:
+            cache[key] = (_ref(block), len(block.ops), roles)
+            return roles
 
         def propose(w, axes, kind):
             prev = roles.get(w)

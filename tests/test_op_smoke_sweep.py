@@ -84,6 +84,10 @@ CONTEXT_OPS = {
     # end-to-end by test_machine_translation_train_and_beam_decode
     "attention_gru_beam_decode": ("test_beam_search.py",
                                   "machine_translation"),
+    # pp/ep sections: sub-block + mesh context (fluid.layers.Pipeline /
+    # switch_moe), trained end-to-end over a pp x ep mesh
+    "pipeline": "test_parallel_layers.py",
+    "moe_ffn": "test_parallel_layers.py",
 }
 
 

@@ -1,0 +1,145 @@
+"""PP/EP from the fluid Program API (round-2 verdict item 5): a
+pipelined + mixture-of-experts model builds with fluid.layers, trains
+through CompiledProgram.with_sharding over a pp x ep mesh, and matches
+the sequential lowering of the SAME program (reference bar: every
+parallelism mode reachable from the user program,
+distribute_transpiler.py:276 — PP/EP are TPU-first extensions)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.parallel import DistributeConfig, make_mesh
+
+D = 16
+
+
+def _build(capacity_factor=8.0, seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[D], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pipe = layers.Pipeline(n_stages=2, n_microbatches=4)
+        with pipe.stage(x) as h:
+            h1 = layers.fc(h, D, bias_attr=False, act="tanh")
+            pipe.set_output(h1)
+        moe_out, aux = layers.switch_moe(
+            pipe.output, n_experts=4, d_ff=32,
+            capacity_factor=capacity_factor)
+        pred = layers.fc(moe_out, 1, bias_attr=False)
+        mse = layers.mean(layers.square(pred - y))
+        loss = mse + layers.mean(aux) * 0.01
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, mse, loss
+
+
+def _feeds(n):
+    rng = np.random.RandomState(0)
+    w = np.random.RandomState(1).rand(D, 1)
+    out = []
+    for _ in range(n):
+        x = rng.rand(8, D).astype(np.float32)
+        out.append({"x": x, "y": (x @ w).astype(np.float32)})
+    return out
+
+
+# ops exercised end-to-end here (smoke-sweep CONTEXT_OPS contract):
+# `pipeline` and `moe_ffn`
+
+
+def test_pipeline_param_gets_stage_dim():
+    main, startup, _, _ = _build()
+    blk = main.desc.global_block
+    pipe_op = next(op for op in blk.ops if op.type == "pipeline")
+    for n in pipe_op.inputs["Params"]:
+        assert blk.var(n).shape[0] == 2          # leading [n_stages]
+        sblk = startup.desc.global_block
+        init_op = next(o for o in sblk.ops if n in o.output_names())
+        assert init_op.attrs["shape"][0] == 2
+
+
+def test_pipeline_moe_sequential_vs_mesh_parity():
+    """The SAME program lowered sequentially (no mesh) and over a
+    pp x ep mesh computes the same losses step by step. The tiny
+    tolerance absorbs the aux-loss estimator difference (per-shard
+    fraction products pmean'd vs one global product) and collective
+    reassociation."""
+    feeds = _feeds(3)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main, startup, mse, loss = _build()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    base = [float(exe.run(main, feed=f, fetch_list=[mse], scope=scope)[0])
+            for f in feeds]
+
+    main2, startup2, mse2, loss2 = _build()
+    mesh = make_mesh({"pp": 2, "ep": 2, "dp": 2})
+    dist = DistributeConfig(mesh=mesh, data_axis=None, model_axis=None,
+                            sp_axis=None, pp_axis="pp", ep_axis="ep")
+    cp = fluid.CompiledProgram(main2).with_sharding(dist)
+    scope2 = fluid.Scope()
+    exe.run(startup2, scope=scope2)
+    dist_losses = [float(exe.run(cp, feed=f, fetch_list=[mse2],
+                                 scope=scope2)[0]) for f in feeds]
+    np.testing.assert_allclose(base, dist_losses, rtol=5e-3, atol=1e-4)
+
+
+def test_pipelined_moe_model_trains_on_mesh():
+    """Verdict item 5 'done' condition: a 2-stage pipelined model TRAINS
+    via the Program API over the mesh — loss decreases."""
+    feeds = _feeds(25)
+    main, startup, mse, loss = _build()
+    mesh = make_mesh({"pp": 2, "ep": 2, "dp": 2})
+    dist = DistributeConfig(mesh=mesh, data_axis=None, model_axis=None,
+                            sp_axis=None, pp_axis="pp", ep_axis="ep")
+    cp = fluid.CompiledProgram(main).with_sharding(dist)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    losses = [float(exe.run(cp, feed=f, fetch_list=[mse], scope=scope)[0])
+              for f in feeds]
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5]), losses
+
+
+def test_pipeline_body_validation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[D], dtype="float32")
+        pipe = layers.Pipeline(n_stages=2, n_microbatches=2)
+        with pytest.raises(ValueError, match="set_output"):
+            with pipe.stage(x) as h:
+                layers.relu(h)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[D], dtype="float32")
+        other = layers.fc(x, D, bias_attr=False)   # non-param ancestor
+        pipe = layers.Pipeline(n_stages=2, n_microbatches=2)
+        with pytest.raises(ValueError, match="only close over parameters"):
+            with pipe.stage(x) as h:
+                pipe.set_output(layers.elementwise_add(h, other))
+
+
+def test_switch_moe_dense_routing_grads():
+    """Off-mesh dense fallback: trains and the aux loss pushes routing
+    toward balance (finite grads through the dispatch/combine)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[D], dtype="float32")
+        y_out, aux = layers.switch_moe(x, n_experts=4, d_ff=8,
+                                       capacity_factor=2.0)
+        loss = layers.mean(layers.square(y_out)) + layers.mean(aux)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, D).astype(np.float32)}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+    for _ in range(10):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(l0) and float(lv) < l0
