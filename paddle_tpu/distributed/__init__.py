@@ -103,3 +103,6 @@ class fleet:
                 jnp.zeros((len(devs),)), NamedSharding(mesh, P("all"))).sum()
         )()
         jax.block_until_ready(x)
+
+from paddle_tpu.distributed.async_pserver import (  # noqa: E402,F401
+    AsyncPServer, AsyncTrainerClient)

@@ -1,0 +1,174 @@
+"""Async-SGD parameter server emulation.
+
+Reference: listen_and_serv_op.cc RunAsyncLoop (:217-268) — the async
+pserver mode runs NO barriers: each gradient that arrives from any
+trainer immediately executes its own prepared optimizer subgraph
+(grad_to_prepared_ctx, :268) against the shared parameter state, and
+trainers pull whatever parameter values are current. DC-ASGD remains a
+documented drop (docs/migration.md).
+
+TPU-native shape: the pserver half of the DistributeTranspiler split
+(fluid/transpiler.py get_pserver_program) runs HOST-side here — async
+parameter updates have no ICI analogue (SURVEY §7 hard-part 4: "emulate
+(host loop) vs document-divergence"), so this is the emulate path: a
+per-gradient pruned program applied under a lock (the reference
+serializes per-grad queues the same way, :241 blocking queues), served
+over a `multiprocessing.connection` listener — the control-plane RPC
+survivor the SURVEY anticipates (§5 distributed backend: "a small RPC
+service, the only place an RPC stack survives").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.fluid import framework
+
+GRAD_SUFFIX = "@GRAD"
+
+
+class AsyncPServer:
+    """Barrier-free parameter server over a transpiled pserver program.
+
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=ep, sync_mode=False)
+        ps = AsyncPServer(t.get_pserver_program(ep),
+                          t.get_startup_program(ep))
+        ps.serve(("127.0.0.1", port))     # background thread
+        ...
+        ps.stop()
+    """
+
+    def __init__(self, pserver_program, startup_program, scope=None):
+        from paddle_tpu.core.executor import CPUPlace, Executor
+        from paddle_tpu.core.scope import Scope
+        self.scope = scope if scope is not None else Scope()
+        self.exe = Executor(CPUPlace())
+        self.exe.run(startup_program, scope=self.scope)
+        self.program = pserver_program
+        self._lock = threading.Lock()
+        self._grad_progs: Dict[str, framework.Program] = {}
+        self._listener = None
+        self._threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+        self.n_applied = 0
+
+    # -- per-grad prepared subgraphs (RunAsyncLoop :268) -------------------
+
+    def _prog_for(self, gname: str) -> framework.Program:
+        prog = self._grad_progs.get(gname)
+        if prog is not None:
+            return prog
+        from paddle_tpu.fluid.transpiler import prune_to_program
+        src = self.program.desc.global_block
+        reached = {gname}
+        kept = []
+        for op in src.ops:
+            if set(op.input_names()) & reached:
+                kept.append(op)
+                reached.update(op.output_names())
+        prog = prune_to_program(src, kept)
+        self._grad_progs[gname] = prog
+        return prog
+
+    def apply_grad(self, gname: str, value) -> None:
+        """Run `gname`'s optimizer subgraph immediately — no barrier, no
+        aggregation across trainers (async-SGD semantics)."""
+        prog = self._prog_for(gname)
+        with self._lock:
+            self.exe.run(prog, feed={gname: np.asarray(value)},
+                         fetch_list=[], scope=self.scope)
+            self.n_applied += 1
+
+    def get_params(self, names: List[str]) -> Dict[str, np.ndarray]:
+        with self._lock:
+            return {n: np.asarray(self.scope.find_var(n)) for n in names}
+
+    # -- the RPC surface ---------------------------------------------------
+
+    def serve(self, address, authkey: bytes = b"paddle_tpu"):
+        from multiprocessing.connection import Listener
+        self._listener = Listener(tuple(address), authkey=authkey)
+
+        def accept_loop():
+            while not self._stopping.is_set():
+                try:
+                    conn = self._listener.accept()
+                except (OSError, EOFError):
+                    break
+                t = threading.Thread(target=self._client_loop,
+                                     args=(conn,), daemon=True)
+                t.start()
+                self._threads.append(t)
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self._listener.address
+
+    def _client_loop(self, conn):
+        try:
+            while True:
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "push":
+                    _, name, value = msg
+                    self.apply_grad(name, value)
+                    conn.send(("ok",))
+                elif kind == "pull":
+                    conn.send(("params", self.get_params(msg[1])))
+                elif kind == "stop":
+                    conn.send(("ok",))
+                    self._stopping.set()
+                    break
+                else:
+                    conn.send(("err", f"unknown message {kind!r}"))
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class AsyncTrainerClient:
+    """Trainer-side connection: push gradients the moment the backward
+    produces them, pull current params whenever convenient — no barriers
+    (reference trainer half in async mode: send without send_barrier,
+    distribute_transpiler.py sync_mode=False)."""
+
+    def __init__(self, address, authkey: bytes = b"paddle_tpu"):
+        from multiprocessing.connection import Client
+        self._conn = Client(tuple(address), authkey=authkey)
+
+    def push_grad(self, name: str, value) -> None:
+        self._conn.send(("push", name, np.asarray(value)))
+        kind, *rest = self._conn.recv()
+        if kind != "ok":
+            raise RuntimeError(f"push_grad {name}: {rest}")
+
+    def pull(self, names: List[str]) -> Dict[str, np.ndarray]:
+        self._conn.send(("pull", list(names)))
+        kind, payload = self._conn.recv()
+        if kind != "params":
+            raise RuntimeError(f"pull: {payload}")
+        return payload
+
+    def stop_server(self):
+        try:
+            self._conn.send(("stop",))
+            self._conn.recv()
+        except (EOFError, OSError):
+            pass
+
+    def close(self):
+        self._conn.close()

@@ -61,6 +61,9 @@ class Pipeline:
     @contextlib.contextmanager
     def stage(self, x):
         parent_block = self.program.current_block()
+        pre_existing = {n for n, v in
+                        self.program.desc.global_block.vars.items()
+                        if v.is_parameter}
         sub = self.program.create_block()
         stage_in = self.program.current_block().create_var(
             name=unique_name.generate("pipeline_stage_in"),
@@ -88,10 +91,26 @@ class Pipeline:
                 f"reads non-parameter vars {others} — feed them through "
                 f"the stage activation instead")
         # prepend the stage dim to every body parameter, in the main
-        # program AND its startup initializer (each stage owns its slice)
+        # program AND its startup initializer (each stage owns its slice).
+        # Only params CREATED INSIDE the stage body may be stacked: a
+        # pre-existing/shared parameter would corrupt its other consumers
+        # (and a param read by two Pipeline sections would double-stack)
+        shared = [n for n in params if n in pre_existing]
+        if shared:
+            raise ValueError(
+                f"Pipeline stage body reuses parameters created outside "
+                f"the stage: {shared} — stage parameters must be created "
+                f"inside the stage body (they get a leading [n_stages] "
+                f"dim that other consumers cannot see)")
         startup = framework.default_startup_program()
         for n in params:
             v = parent_block.var_recursive(n)
+            if (v.desc.attrs or {}).get("__pipeline_stacked__"):
+                raise ValueError(
+                    f"parameter {n!r} already belongs to another Pipeline "
+                    f"section")
+            v.desc.attrs = dict(v.desc.attrs or {})
+            v.desc.attrs["__pipeline_stacked__"] = True
             v.desc.shape = [self.n_stages] + list(v.desc.shape)
             sblk = startup.desc.global_block
             if sblk.has_var(n):

@@ -33,6 +33,26 @@ OPTIMIZE_OP_TYPES = {
 GRAD_SUFFIX = "@GRAD"
 
 
+def prune_to_program(src_block, kept_ops) -> "framework.Program":
+    """New Program holding copies of `kept_ops` (descs) plus every var
+    they touch — the shared prune-and-copy core of the pserver-side
+    program builders (reference: get_pserver_program :654 builds the
+    optimize block the same way)."""
+    prog = framework.Program()
+    blk = prog.desc.global_block
+    needed = set()
+    for op in kept_ops:
+        needed.update(op.input_names())
+        needed.update(op.output_names())
+    for n in sorted(needed):
+        if src_block.has_var(n):
+            blk.add_var(ir.VarDesc.from_dict(src_block.var(n).to_dict()))
+    for op in kept_ops:
+        blk.append_op(ir.OpDesc.from_dict(op.to_dict()))
+    prog.desc.bump_version()
+    return prog
+
+
 class PSDispatcher:
     """reference: transpiler/ps_dispatcher.py PSDispatcher."""
 
@@ -181,23 +201,11 @@ class DistributeTranspiler:
         src = self.origin_program.desc.global_block
         my_params = {p for p, ep in self.param_placement.items()
                      if ep == endpoint or not self.pserver_endpoints}
-        prog = framework.Program()
-        blk = prog.desc.global_block
         ops = [src.ops[i] for i in self._opt_idx]
         my_ops = [op for op in ops
                   if not op.inputs.get("Param")
                   or set(op.inputs["Param"]) & my_params]
-        needed = set()
-        for op in my_ops:
-            needed.update(op.input_names())
-            needed.update(op.output_names())
-        for n in sorted(needed):
-            if src.has_var(n):
-                blk.add_var(ir.VarDesc.from_dict(src.var(n).to_dict()))
-        for op in my_ops:
-            blk.append_op(ir.OpDesc.from_dict(op.to_dict()))
-        prog.desc.bump_version()
-        return prog
+        return prune_to_program(src, my_ops)
 
     def get_startup_program(self, endpoint: str, pserver_program=None):
         """Startup pruned to the persistables this endpoint owns

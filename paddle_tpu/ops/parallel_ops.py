@@ -18,15 +18,11 @@ from paddle_tpu.core.registry import first, register_op
 
 def _axis(ctx, attr_name):
     """The configured mesh axis named by DistributeConfig.<attr_name>,
-    when it exists on the mesh with size > 1; else None (fall back to the
-    single-device lowering)."""
-    dist = ctx.dist
-    ax = getattr(dist, attr_name, None) if dist is not None else None
-    mesh = ctx.mesh
-    if (mesh is not None and ax and ax in mesh.axis_names
-            and mesh.shape[ax] > 1):
-        return ax
-    return None
+    when active (DistributeConfig.axis_active — the shared validity
+    rule); else None (fall back to the single-device lowering)."""
+    if ctx.dist is None or ctx.mesh is None:
+        return None
+    return ctx.dist.axis_active(attr_name)
 
 
 @register_op("pipeline", ref="TPU-first extension (GPipe over the pp mesh "
@@ -73,7 +69,7 @@ def _pipeline(ctx, ins, attrs):
     def body(h, p_slice):
         return stage_fn(p_slice, h), None
 
-    y, _ = lax.scan(body, x, stacked)
+    y, _ = lax.scan(body, x, stacked, length=n_stages)
     return {"Out": [y]}
 
 

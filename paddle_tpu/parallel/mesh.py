@@ -88,6 +88,16 @@ class DistributeConfig:
     # Explicit param_axes regexes and per-var dist hints take priority.
     auto_shard: bool = True
 
+    def axis_active(self, attr_name: str) -> Optional[str]:
+        """The mesh axis named by this config's `attr_name` field when it
+        exists on the mesh with size > 1, else None — the ONE validity
+        rule shared by role derivation and the pp/ep op lowerings."""
+        ax = getattr(self, attr_name, None)
+        if (ax and self.mesh is not None and ax in self.mesh.axis_names
+                and self.mesh.shape[ax] > 1):
+            return ax
+        return None
+
     def _axes_for(self, name: str, block=None):
         """Resolve the PartitionSpec-like axes tuple for a scope var, or
         None for replicated. Priority: explicit param_axes regex > the
@@ -150,24 +160,19 @@ class DistributeConfig:
                     return v.shape
             return None
 
-        def axis_ok(a):
-            return (a and self.mesh is not None
-                    and a in self.mesh.axis_names
-                    and self.mesh.shape[a] > 1)
-
         # structural pp/ep roles first (independent of model_axis): a
         # pipeline section's stacked stage params shard one stage per pp
         # rank; switch_moe expert weights shard over ep (GateW replicates)
         if self.auto_shard:
             for op in block.ops:
-                if op.type == "pipeline" and axis_ok(self.pp_axis):
+                if op.type == "pipeline" and self.axis_active("pp_axis"):
                     for n in op.inputs.get("Params", []):
                         sh = param_shape(n)
                         if sh:
                             roles[n] = (self.pp_axis,) + \
                                 (None,) * (len(sh) - 1)
                             kinds[n] = "pipeline"
-                elif op.type == "moe_ffn" and axis_ok(self.ep_axis):
+                elif op.type == "moe_ffn" and self.axis_active("ep_axis"):
                     for slot in ("W1", "B1", "W2", "B2"):
                         n = (op.inputs.get(slot) or [None])[0]
                         sh = param_shape(n)

@@ -1,0 +1,67 @@
+"""Async-SGD trainer worker (spawned by test_async_pserver.py): computes
+gradients locally, pushes each one to the AsyncPServer WITHOUT barriers,
+pulls current params between steps — the reference trainer half in
+sync_mode=False (distribute_transpiler async mode)."""
+
+import json
+import os
+import sys
+
+# launched as `python tests/async_worker.py` — sys.path[0] is tests/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.fluid as fluid                     # noqa: E402
+from paddle_tpu.distributed import AsyncTrainerClient  # noqa: E402
+from paddle_tpu.fluid.transpiler import DistributeTranspiler  # noqa: E402
+from paddle_tpu import models                        # noqa: E402
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    steps = int(os.environ["PADDLE_TEST_STEPS"])
+    host, port = os.environ["PADDLE_PSERVER"].rsplit(":", 1)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 3
+    startup.random_seed = 3
+    with fluid.program_guard(main_p, startup):
+        loss, _, feed_specs = models.deepfm.build(
+            is_train=True, num_fields=4, vocab_size=64, embed_dim=8,
+            lr=1e-2)
+
+    t = DistributeTranspiler()
+    t.transpile(rank, program=main_p, pservers=f"{host}:{port}",
+                trainers=int(os.environ["PADDLE_TRAINERS_NUM"]),
+                sync_mode=False, startup_program=startup)
+    trainer_prog = t.get_trainer_program()
+    params, grads = t.params, t.send_vars
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)     # local init; params replaced by pulls
+
+    client = AsyncTrainerClient((host, int(port)))
+    rng = np.random.RandomState(100 + rank)
+    proj = np.random.RandomState(7).rand(4)
+    losses = []
+    for _ in range(steps):
+        for n, v in client.pull(params).items():
+            scope.set_var(n, v)
+        ids = rng.randint(0, 64, size=(16, 4, 1)).astype("int64")
+        label = (ids[:, 0, 0] % 2).astype("float32")[:, None]
+        outs = exe.run(trainer_prog, feed={"feat_ids": ids, "label": label},
+                       fetch_list=[loss.name] + grads, scope=scope)
+        losses.append(float(np.asarray(outs[0]).reshape(())))
+        for g, val in zip(grads, outs[1:]):
+            client.push_grad(g, np.asarray(val))
+    client.close()
+    print("RESULT " + json.dumps({"losses": losses}))
+
+
+if __name__ == "__main__":
+    main()

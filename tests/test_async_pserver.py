@@ -1,0 +1,156 @@
+"""Async-SGD pserver emulation (round-2 verdict item 7): the
+RunAsyncLoop capability (reference listen_and_serv_op.cc:217-268) —
+per-gradient optimizer subgraphs applied with NO trainer barriers —
+behind the existing DistributeTranspiler split, exercised by a DeepFM
+config across two real OS processes. DC-ASGD stays a documented drop
+(docs/migration.md)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import AsyncPServer, AsyncTrainerClient
+from paddle_tpu.fluid.transpiler import DistributeTranspiler
+from paddle_tpu import models
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_deepfm(seed=3):
+    from paddle_tpu.fluid import unique_name
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = seed
+    startup.random_seed = seed
+    # identical param names on every build (the worker process builds the
+    # same program): reset the unique-name counters per build
+    with unique_name.guard():
+        with fluid.program_guard(main_p, startup):
+            loss, _, feed_specs = models.deepfm.build(
+                is_train=True, num_fields=4, vocab_size=64, embed_dim=8,
+                lr=1e-2)
+    return main_p, startup, loss
+
+
+def _batch(rng, proj, n=16):
+    ids = rng.randint(0, 64, size=(n, 4, 1)).astype("int64")
+    label = (ids[:, 0, 0] % 2).astype("float32")[:, None]
+    return ids, label
+
+
+def test_async_apply_grad_updates_params_without_barrier():
+    """In-process: one pushed gradient immediately moves the parameter —
+    no second trainer, no barrier (RunAsyncLoop semantics)."""
+    main_p, startup, loss = _build_deepfm()
+    ep = "127.0.0.1:0"
+    t = DistributeTranspiler()
+    t.transpile(0, program=main_p, pservers=ep, trainers=2,
+                sync_mode=False, startup_program=startup)
+    ps_prog = t.get_pserver_program(ep)
+    ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog))
+    assert t.send_vars, "transpiler found no gradient send targets"
+    g = t.send_vars[0]
+    pname = next(p for p in t.params if g == p + "@GRAD")
+    before = ps.get_params([pname])[pname].copy()
+    gval = np.ones(before.shape, np.float32) * 0.5
+    ps.apply_grad(g, gval)
+    after = ps.get_params([pname])[pname]
+    assert not np.allclose(before, after)
+    assert ps.n_applied == 1
+
+
+def test_deepfm_two_process_async_converges():
+    """Two trainer OS processes hammer one AsyncPServer without barriers;
+    the served parameters converge: the final evaluation loss lands
+    within tolerance of a single-process synchronous run's."""
+    steps = 40
+    main_p, startup, loss = _build_deepfm()
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    t = DistributeTranspiler()
+    t.transpile(0, program=main_p, pservers=ep, trainers=2,
+                sync_mode=False, startup_program=startup)
+    ps_prog = t.get_pserver_program(ep)
+    ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog))
+    ps.serve(("127.0.0.1", port))
+
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith(("PADDLE_", "XLA_FLAGS"))}
+    workers = []
+    for rank in range(2):
+        env = dict(env_base)
+        env["PADDLE_PSERVER"] = ep
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = "2"
+        env["PADDLE_TEST_STEPS"] = str(steps)
+        workers.append(subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "async_worker.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(__file__)), env=env,
+            text=True))
+    first_losses = {}
+    try:
+        for rank, w in enumerate(workers):
+            out, err = w.communicate(timeout=420)
+            assert w.returncode == 0, f"rank {rank} failed:\n{err[-3000:]}"
+            line = [l for l in out.splitlines()
+                    if l.startswith("RESULT ")][-1]
+            first_losses[rank] = json.loads(line[len("RESULT "):])["losses"]
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        ps.stop()
+    assert ps.n_applied >= 2 * steps * len(t.send_vars) * 0.9
+
+    # evaluate the async-trained params vs a synchronous baseline
+    def eval_loss(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(999)
+        proj = np.random.RandomState(7).rand(4)
+        ids, label = _batch(rng, proj, n=64)
+        eval_p, eval_s, eval_l = _build_deepfm()
+        (lv,) = exe.run(eval_p, feed={"feat_ids": ids, "label": label},
+                        fetch_list=[eval_l], scope=scope)
+        return float(np.asarray(lv).reshape(()))
+
+    # async-served params -> fresh scope
+    async_scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    m2, s2, _ = _build_deepfm()
+    exe.run(s2, scope=async_scope)
+    for n, v in ps.get_params(t.params).items():
+        async_scope.set_var(n, v)
+    async_loss = eval_loss(async_scope)
+
+    # synchronous single-process baseline, same data distribution
+    m3, s3, l3 = _build_deepfm()
+    sync_scope = fluid.Scope()
+    exe.run(s3, scope=sync_scope)
+    rng = np.random.RandomState(100)
+    proj = np.random.RandomState(7).rand(4)
+    init_loss = None
+    for _ in range(steps):
+        ids, label = _batch(rng, proj)
+        (lv,) = exe.run(m3, feed={"feat_ids": ids, "label": label},
+                        fetch_list=[l3], scope=sync_scope)
+        if init_loss is None:
+            init_loss = float(np.asarray(lv).reshape(()))
+    sync_loss = eval_loss(sync_scope)
+
+    assert np.isfinite(async_loss)
+    assert async_loss < init_loss, (async_loss, init_loss)
+    # async staleness costs some quality; the tolerance bounds it
+    assert abs(async_loss - sync_loss) < 0.25, (async_loss, sync_loss)
