@@ -27,7 +27,7 @@ import numpy as np
 
 from paddle_tpu.fluid import framework
 
-GRAD_SUFFIX = "@GRAD"
+from paddle_tpu.fluid.transpiler import GRAD_SUFFIX
 
 
 class AsyncPServer:
@@ -64,12 +64,29 @@ class AsyncPServer:
             return prog
         from paddle_tpu.fluid.transpiler import prune_to_program
         src = self.program.desc.global_block
-        reached = {gname}
-        kept = []
-        for op in src.ops:
-            if set(op.input_names()) & reached:
-                kept.append(op)
-                reached.update(op.output_names())
+
+        def closure(seeds):
+            reached = set(seeds)
+            kept_ids = set()
+            for op in src.ops:
+                if set(op.input_names()) & reached:
+                    kept_ids.add(id(op))
+                    reached.update(op.output_names())
+            return kept_ids
+
+        # prelude = pserver ops NOT downstream of any gradient (the
+        # LR-scheduler / global-step chain the transpiler moved here);
+        # they run with EVERY per-grad apply — under async there is no
+        # global step, so the schedule advances once per gradient
+        # application (each arriving grad is one async update). Dropping
+        # them would freeze the LR at its startup value (review finding).
+        produced = {n for op in src.ops for n in op.output_names()}
+        all_grads = {n for op in src.ops for n in op.input_names()
+                     if GRAD_SUFFIX in n and n not in produced}
+        grad_downstream = closure(all_grads)
+        mine = closure({gname})
+        kept = [op for op in src.ops
+                if id(op) in mine or id(op) not in grad_downstream]
         prog = prune_to_program(src, kept)
         self._grad_progs[gname] = prog
         return prog
@@ -85,7 +102,15 @@ class AsyncPServer:
 
     def get_params(self, names: List[str]) -> Dict[str, np.ndarray]:
         with self._lock:
-            return {n: np.asarray(self.scope.find_var(n)) for n in names}
+            out = {}
+            for n in names:
+                v = self.scope.find_var(n)
+                if v is None:
+                    raise KeyError(
+                        f"parameter {n!r} is not served by this pserver "
+                        f"(placed on another endpoint?)")
+                out[n] = np.asarray(v)
+            return out
 
     # -- the RPC surface ---------------------------------------------------
 
@@ -116,10 +141,19 @@ class AsyncPServer:
                 kind = msg[0]
                 if kind == "push":
                     _, name, value = msg
-                    self.apply_grad(name, value)
+                    try:
+                        self.apply_grad(name, value)
+                    except Exception as e:      # reply, don't kill the conn
+                        conn.send(("err", f"push {name!r}: {e!r}"))
+                        continue
                     conn.send(("ok",))
                 elif kind == "pull":
-                    conn.send(("params", self.get_params(msg[1])))
+                    try:
+                        params = self.get_params(msg[1])
+                    except Exception as e:
+                        conn.send(("err", f"pull: {e!r}"))
+                        continue
+                    conn.send(("params", params))
                 elif kind == "stop":
                     conn.send(("ok",))
                     self._stopping.set()
@@ -158,10 +192,10 @@ class AsyncTrainerClient:
 
     def pull(self, names: List[str]) -> Dict[str, np.ndarray]:
         self._conn.send(("pull", list(names)))
-        kind, payload = self._conn.recv()
+        kind, *rest = self._conn.recv()
         if kind != "params":
-            raise RuntimeError(f"pull: {payload}")
-        return payload
+            raise RuntimeError(f"pull: {rest}")
+        return rest[0]
 
     def stop_server(self):
         try:

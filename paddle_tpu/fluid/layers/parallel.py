@@ -102,15 +102,12 @@ class Pipeline:
                 f"the stage: {shared} — stage parameters must be created "
                 f"inside the stage body (they get a leading [n_stages] "
                 f"dim that other consumers cannot see)")
+        # (the pre_existing check above also rules out a param shared
+        # between two Pipeline sections — the second section would see it
+        # as pre-existing)
         startup = framework.default_startup_program()
         for n in params:
             v = parent_block.var_recursive(n)
-            if (v.desc.attrs or {}).get("__pipeline_stacked__"):
-                raise ValueError(
-                    f"parameter {n!r} already belongs to another Pipeline "
-                    f"section")
-            v.desc.attrs = dict(v.desc.attrs or {})
-            v.desc.attrs["__pipeline_stacked__"] = True
             v.desc.shape = [self.n_stages] + list(v.desc.shape)
             sblk = startup.desc.global_block
             if sblk.has_var(n):

@@ -47,7 +47,6 @@ def main():
 
     client = AsyncTrainerClient((host, int(port)))
     rng = np.random.RandomState(100 + rank)
-    proj = np.random.RandomState(7).rand(4)
     losses = []
     for _ in range(steps):
         for n, v in client.pull(params).items():

@@ -43,7 +43,7 @@ def _build_deepfm(seed=3):
     return main_p, startup, loss
 
 
-def _batch(rng, proj, n=16):
+def _batch(rng, n=16):
     ids = rng.randint(0, 64, size=(n, 4, 1)).astype("int64")
     label = (ids[:, 0, 0] % 2).astype("float32")[:, None]
     return ids, label
@@ -119,8 +119,7 @@ def test_deepfm_two_process_async_converges():
     def eval_loss(scope):
         exe = fluid.Executor(fluid.CPUPlace())
         rng = np.random.RandomState(999)
-        proj = np.random.RandomState(7).rand(4)
-        ids, label = _batch(rng, proj, n=64)
+        ids, label = _batch(rng, n=64)
         eval_p, eval_s, eval_l = _build_deepfm()
         (lv,) = exe.run(eval_p, feed={"feat_ids": ids, "label": label},
                         fetch_list=[eval_l], scope=scope)
@@ -140,10 +139,9 @@ def test_deepfm_two_process_async_converges():
     sync_scope = fluid.Scope()
     exe.run(s3, scope=sync_scope)
     rng = np.random.RandomState(100)
-    proj = np.random.RandomState(7).rand(4)
     init_loss = None
     for _ in range(steps):
-        ids, label = _batch(rng, proj)
+        ids, label = _batch(rng)
         (lv,) = exe.run(m3, feed={"feat_ids": ids, "label": label},
                         fetch_list=[l3], scope=sync_scope)
         if init_loss is None:

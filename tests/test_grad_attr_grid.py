@@ -1,0 +1,291 @@
+"""Numeric-gradient grid over ATTR-DEPENDENT branches (round-2 verdict
+item 8): the single `__vjp__` design makes per-op grad bugs structurally
+unlikely, but padding modes, strides, dilation, groups, axis cases and
+interpolation flags each take different code paths inside an emitter —
+this parametrized grid puts a central-difference check on every such
+branch of the highest-risk ops (reference pattern: OpTest check_grad,
+unittests/op_test.py:414, run across attr variants per op file)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad
+
+
+def _r(*shape, seed=0, lo=-0.5, hi=0.5):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+# ------------------------------------------------------------------ conv2d
+
+CONV2D_GRID = [
+    # (stride, padding, dilation, groups)
+    (1, 0, 1, 1),
+    (2, 0, 1, 1),
+    (1, 1, 1, 1),
+    (2, 1, 1, 1),
+    (1, 2, 1, 1),
+    (1, 0, 2, 1),
+    (2, 1, 2, 1),
+    (1, 1, 1, 2),
+    (1, 0, 1, 4),
+    (2, 2, 2, 1),
+]
+
+
+@pytest.mark.parametrize("stride,pad,dil,groups", CONV2D_GRID)
+def test_grad_conv2d_attr_grid(stride, pad, dil, groups):
+    cin, cout, k = 4, 4, 3
+    check_grad("conv2d",
+               {"Input": {"x": _r(2, cin, 8, 8)},
+                "Filter": {"w": _r(cout, cin // groups, k, k, seed=1)}},
+               attrs={"strides": [stride, stride],
+                      "paddings": [pad, pad],
+                      "dilations": [dil, dil], "groups": groups},
+               out_slot="Output", rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1), (2, 0), (1, 1)])
+def test_grad_conv2d_transpose_attr_grid(stride, pad):
+    check_grad("conv2d_transpose",
+               {"Input": {"x": _r(2, 3, 5, 5)},
+                "Filter": {"w": _r(3, 4, 3, 3, seed=1)}},
+               attrs={"strides": [stride, stride], "paddings": [pad, pad],
+                      "dilations": [1, 1], "groups": 1},
+               out_slot="Output", rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 1), (2, 1)])
+def test_grad_depthwise_conv2d_attr_grid(stride, pad):
+    check_grad("depthwise_conv2d",
+               {"Input": {"x": _r(2, 4, 6, 6)},
+                "Filter": {"w": _r(4, 1, 3, 3, seed=1)}},
+               attrs={"strides": [stride, stride], "paddings": [pad, pad],
+                      "dilations": [1, 1]},
+               out_slot="Output", rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("stride,pad,dil", [(1, 0, 1), (2, 1, 1),
+                                            (1, 1, 2)])
+def test_grad_conv3d_attr_grid(stride, pad, dil):
+    check_grad("conv3d",
+               {"Input": {"x": _r(1, 2, 5, 5, 5)},
+                "Filter": {"w": _r(3, 2, 3, 3, 3, seed=1)}},
+               attrs={"strides": [stride] * 3, "paddings": [pad] * 3,
+                      "dilations": [dil] * 3},
+               out_slot="Output", rtol=2e-2, atol=5e-4)
+
+
+# ------------------------------------------------------------------ pooling
+
+POOL_GRID = [
+    # (ptype, k, stride, pad, exclusive, global)
+    ("max", 2, 2, 0, True, False),
+    ("max", 3, 2, 1, True, False),
+    ("max", 3, 1, 1, True, False),
+    ("max", 2, 2, 0, True, True),
+    ("avg", 2, 2, 0, True, False),
+    ("avg", 3, 2, 1, True, False),
+    ("avg", 3, 2, 1, False, False),
+    ("avg", 3, 1, 1, True, False),
+    ("avg", 2, 2, 0, True, True),
+]
+
+
+@pytest.mark.parametrize("ptype,k,stride,pad,excl,glob", POOL_GRID)
+def test_grad_pool2d_attr_grid(ptype, k, stride, pad, excl, glob):
+    # distinct, well-separated values: a max-pool kink inside the
+    # central-difference stencil would corrupt the numeric grad
+    rng = np.random.RandomState(0)
+    x = np.linspace(-1, 1, 2 * 3 * 6 * 6).astype(np.float32)
+    x = rng.permutation(x).reshape(2, 3, 6, 6)
+    check_grad("pool2d", {"X": {"x": x}},
+               attrs={"pooling_type": ptype, "ksize": [k, k],
+                      "strides": [stride, stride], "paddings": [pad, pad],
+                      "exclusive": excl, "global_pooling": glob},
+               rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("ptype,stride", [("max", 2), ("avg", 2),
+                                          ("avg", 1)])
+def test_grad_pool3d_attr_grid(ptype, stride):
+    check_grad("pool3d", {"X": {"x": _r(1, 2, 4, 4, 4)}},
+               attrs={"pooling_type": ptype, "ksize": [2, 2, 2],
+                      "strides": [stride] * 3, "paddings": [0, 0, 0]},
+               rtol=2e-2, atol=5e-4)
+
+
+# ------------------------------------------------------------------ padding
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "edge"])
+@pytest.mark.parametrize("pads", [[1, 1, 1, 1], [0, 2, 1, 0]])
+def test_grad_pad2d_attr_grid(mode, pads):
+    check_grad("pad2d", {"X": {"x": _r(2, 3, 5, 5)}},
+               attrs={"paddings": pads, "mode": mode, "pad_value": 0.5},
+               rtol=2e-2, atol=5e-4)
+
+
+# -------------------------------------------------------------- interpolate
+
+@pytest.mark.parametrize("op", ["bilinear_interp", "nearest_interp"])
+@pytest.mark.parametrize("oh,ow", [(8, 8), (3, 7), (1, 5)])
+def test_grad_interp_attr_grid(op, oh, ow):
+    check_grad(op, {"X": {"x": _r(2, 2, 5, 5)}},
+               attrs={"out_h": oh, "out_w": ow},
+               rtol=2e-2, atol=5e-4)
+
+
+# ---------------------------------------------------------- slice / strided
+
+SLICE_GRID = [
+    ([0], [1], [3]),
+    ([1], [0], [2]),
+    ([0, 2], [0, 1], [2, 4]),
+    ([2], [-3], [-1]),          # negative starts/ends
+    ([1], [2], [100]),          # end past the dim clamps
+]
+
+
+@pytest.mark.parametrize("axes,starts,ends", SLICE_GRID)
+def test_grad_slice_attr_grid(axes, starts, ends):
+    check_grad("slice", {"Input": {"x": _r(3, 4, 5)}},
+               attrs={"axes": axes, "starts": starts, "ends": ends},
+               rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("offsets", [[0, 0, 0], [1, 1, 2]])
+def test_grad_crop_attr_grid(offsets):
+    check_grad("crop", {"X": {"x": _r(3, 4, 5)}},
+               attrs={"offsets": offsets, "shape": [2, 2, 2]},
+               rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("times", [[2, 1, 1], [1, 2, 3]])
+def test_grad_expand_attr_grid(times):
+    check_grad("expand", {"X": {"x": _r(2, 3, 2)}},
+               attrs={"expand_times": times}, rtol=2e-2, atol=5e-4)
+
+
+# ------------------------------------------------------------------ reduces
+
+@pytest.mark.parametrize("op", ["reduce_sum", "reduce_mean", "reduce_max",
+                                "reduce_min", "reduce_prod"])
+@pytest.mark.parametrize("dim,keep", [([0], False), ([1], True),
+                                      ([0, 2], False)])
+def test_grad_reduce_attr_grid(op, dim, keep):
+    # reduce_max/min route grads only to the argmax; use distinct values
+    x = np.linspace(-1, 1, 2 * 3 * 4).reshape(2, 3, 4).astype(np.float32)
+    check_grad(op, {"X": {"x": x}},
+               attrs={"dim": dim, "keep_dim": keep},
+               rtol=2e-2, atol=5e-4)
+
+
+def test_grad_reduce_all_attr():
+    check_grad("reduce_sum", {"X": {"x": _r(2, 3)}},
+               attrs={"reduce_all": True}, rtol=2e-2, atol=5e-4)
+
+
+# ------------------------------------------------------- elementwise / axis
+
+@pytest.mark.parametrize("op", ["elementwise_add", "elementwise_mul",
+                                "elementwise_sub", "elementwise_div"])
+@pytest.mark.parametrize("axis,yshape", [(-1, (2, 3, 4)), (0, (2,)),
+                                         (1, (3,))])
+def test_grad_elementwise_broadcast_grid(op, axis, yshape):
+    ylo, yhi = (0.5, 1.5) if op == "elementwise_div" else (-0.5, 0.5)
+    check_grad(op, {"X": {"x": _r(2, 3, 4)},
+                    "Y": {"y": _r(*yshape, seed=1, lo=ylo, hi=yhi)}},
+               attrs={"axis": axis}, rtol=2e-2, atol=5e-4)
+
+
+# ------------------------------------------------------------------ matmuls
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_grad_matmul_transpose_grid(tx, ty):
+    xs = (4, 3) if tx else (3, 4)
+    ys = (5, 4) if ty else (4, 5)
+    check_grad("matmul", {"X": {"x": _r(*xs)}, "Y": {"y": _r(*ys, seed=1)}},
+               attrs={"transpose_X": tx, "transpose_Y": ty},
+               rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("ncol", [1, 2])
+def test_grad_mul_num_col_dims_grid(ncol):
+    check_grad("mul", {"X": {"x": _r(2, 3, 4)},
+                       "Y": {"y": _r(12 if ncol == 1 else 4, 5, seed=1)}},
+               attrs={"x_num_col_dims": ncol}, rtol=2e-2, atol=5e-4)
+
+
+# ---------------------------------------------------------------- axis ops
+
+@pytest.mark.parametrize("axis", [-1, 0, 1])
+def test_grad_softmax_axis_grid(axis):
+    check_grad("softmax", {"X": {"x": _r(3, 4, 5)}},
+               attrs={"axis": axis}, rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("axis", [1, 2])
+def test_grad_layer_norm_axis_grid(axis):
+    d = (4, 5) if axis == 1 else (5,)
+    import numpy as _np
+    size = int(_np.prod(d)) if axis == 1 else 5
+    check_grad("layer_norm",
+               {"X": {"x": _r(3, 4, 5)},
+                "Scale": {"s": _r(size, seed=1, lo=0.5, hi=1.5)},
+                "Bias": {"b": _r(size, seed=2)}},
+               attrs={"begin_norm_axis": axis},
+               out_slot="Y", extra_out_slots=("Mean", "Variance"),
+               rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("groups", [1, 2, 4])
+def test_grad_group_norm_groups_grid(groups):
+    check_grad("group_norm",
+               {"X": {"x": _r(2, 4, 3, 3, lo=-1.5, hi=1.5)},
+                "Scale": {"s": _r(4, seed=1, lo=0.5, hi=1.5)},
+                "Bias": {"b": _r(4, seed=2)}},
+               attrs={"groups": groups, "epsilon": 1e-5},
+               out_slot="Y", extra_out_slots=("Mean", "Variance"),
+               rtol=5e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("perm", [[1, 0, 2], [2, 1, 0], [0, 2, 1]])
+def test_grad_transpose_perm_grid(perm):
+    check_grad("transpose", {"X": {"x": _r(2, 3, 4)}},
+               attrs={"axis": perm}, rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("mode", ["all", "channel", "element"])
+def test_grad_prelu_mode_grid(mode):
+    shape = {"all": (1,), "channel": (3,), "element": (2, 3, 4, 4)}[mode]
+    # keep x away from 0 (prelu kink) for the central difference
+    x = _r(2, 3, 4, 4)
+    x = np.where(np.abs(x) < 0.1, 0.2, x).astype(np.float32)
+    check_grad("prelu",
+               {"X": {"x": x},
+                "Alpha": {"a": _r(*shape, seed=1, lo=0.1, hi=0.4)}},
+               attrs={"mode": mode}, rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_grad_concat_axis_grid(axis):
+    check_grad("concat",
+               {"X": {"a": _r(2, 3, 4), "b": _r(2, 3, 4, seed=1)}},
+               attrs={"axis": axis}, rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_grad_stack_axis_grid(axis):
+    check_grad("stack",
+               {"X": {"a": _r(2, 3), "b": _r(2, 3, seed=1)}},
+               attrs={"axis": axis}, out_slot="Y",
+               rtol=2e-2, atol=5e-4)
+
+
+@pytest.mark.parametrize("pads", [[0, 1, 0, 1], [1, 0, 2, 0]])
+def test_grad_pad_attr_grid(pads):
+    check_grad("pad", {"X": {"x": _r(3, 4)}},
+               attrs={"paddings": pads, "pad_value": 0.25},
+               rtol=2e-2, atol=5e-4)
