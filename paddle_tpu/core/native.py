@@ -32,15 +32,18 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
-def _build(srcs, out: str) -> None:
+def _compile(extra_flags, srcs, out: str) -> None:
+    """Atomic g++ compile: per-process tmp output then os.replace, so
+    concurrent cold builds never clobber each other mid-write."""
     os.makedirs(os.path.dirname(out), exist_ok=True)
-    tmp = f"{out}.tmp.{os.getpid()}.so"   # per-process: concurrent cold
-                                          # builds must not clobber each
-                                          # other mid-write
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           *srcs, "-o", tmp, "-lz"]
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", *extra_flags, *srcs, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     os.replace(tmp, out)
+
+
+def _build(srcs, out: str) -> None:
+    _compile(["-fPIC", "-shared", "-pthread"], list(srcs) + ["-lz"], out)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -104,9 +107,12 @@ def lib() -> ctypes.CDLL:
         if _LIB is not None:
             return _LIB
         root = _repo_root()
+        # standalone executables (own main(), extra headers) are built
+        # by their dedicated helpers, not into the shared library
+        standalone = {"stablehlo_runner.cc"}
         srcs = [os.path.join(root, "csrc", f)
                 for f in sorted(os.listdir(os.path.join(root, "csrc")))
-                if f.endswith(".cc")]
+                if f.endswith(".cc") and f not in standalone]
         out = os.path.join(root, "paddle_tpu", "_native",
                            "libpaddle_tpu_native.so")
         try:
@@ -134,3 +140,33 @@ def take_buffer(ptr, size: int) -> bytes:
     data = ctypes.string_at(ptr, size)
     lib().ptpu_buf_free(ptr)
     return data
+
+
+def build_stablehlo_runner(out_path=None) -> str:
+    """Build csrc/stablehlo_runner.cc — the NON-PYTHON consumer of the
+    StableHLO export (reference capability: the C++ predictor,
+    inference/api/paddle_api.h). Needs the PJRT C API header, found in
+    the environment's tensorflow include tree (or XLA_INCLUDE_DIR)."""
+    root = _repo_root()
+    src = os.path.join(root, "csrc", "stablehlo_runner.cc")
+    out = out_path or os.path.join(root, "paddle_tpu", "_native",
+                                   "stablehlo_runner")
+    if os.path.exists(out) and os.path.getmtime(out) >= \
+            os.path.getmtime(src):
+        return out
+    include = os.environ.get("XLA_INCLUDE_DIR")
+    if not include:
+        import sysconfig
+        cands = [os.path.join(sysconfig.get_paths()["purelib"],
+                              "tensorflow", "include")]
+        for cand in cands:
+            if os.path.exists(os.path.join(cand, "xla", "pjrt", "c",
+                                           "pjrt_c_api.h")):
+                include = cand
+                break
+    if not include:
+        raise NativeUnavailable(
+            "pjrt_c_api.h not found — set XLA_INCLUDE_DIR to a tree "
+            "containing xla/pjrt/c/pjrt_c_api.h")
+    _compile(["-I", include], [src, "-ldl"], out)
+    return out

@@ -85,6 +85,10 @@ class AsyncPServer:
                      if GRAD_SUFFIX in n and n not in produced}
         grad_downstream = closure(all_grads)
         mine = closure({gname})
+        if not mine:
+            raise KeyError(
+                f"gradient {gname!r} feeds no optimizer op on this "
+                f"pserver (placed on another endpoint?)")
         kept = [op for op in src.ops
                 if id(op) in mine or id(op) not in grad_downstream]
         prog = prune_to_program(src, kept)

@@ -67,3 +67,54 @@ def export_stablehlo(dirname: str, feed_shapes: Dict[str, Tuple],
     with open(out_path, "w") as f:
         f.write(text)
     return out_path, ser_path
+
+
+def write_runner_bundle(bundle_dir: str, stablehlo_path: str,
+                        feed_arrays: Dict[str, np.ndarray]):
+    """Self-contained bundle for the NON-PYTHON serving consumer
+    (csrc/stablehlo_runner.cc — the reference's C++ predictor capability,
+    inference/api/paddle_api.h): the StableHLO module, a serialized
+    CompileOptionsProto, and a manifest + raw input tensors in the
+    executable's argument order (jax.export flattens the feed dict in
+    sorted-key order)."""
+    os.makedirs(bundle_dir, exist_ok=True)
+    import shutil
+    shutil.copy(stablehlo_path, os.path.join(bundle_dir,
+                                             "model.stablehlo"))
+    from jax._src.lib import xla_client
+    with open(os.path.join(bundle_dir, "compile_options.pb"), "wb") as f:
+        f.write(xla_client.CompileOptions().SerializeAsString())
+    lines = []
+    for i, name in enumerate(sorted(feed_arrays)):
+        arr = np.ascontiguousarray(feed_arrays[name])
+        fname = f"in_{i}.bin"
+        arr.tofile(os.path.join(bundle_dir, fname))
+        dims = " ".join(str(d) for d in arr.shape)
+        lines.append(f"input {name} {arr.dtype.name} {arr.ndim} "
+                     f"{dims} {fname}".replace("  ", " "))
+    with open(os.path.join(bundle_dir, "manifest.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    _write_plugin_options(bundle_dir)
+    return bundle_dir
+
+
+def _write_plugin_options(bundle_dir: str):
+    """PJRT client create-options for the runner (options.txt). The TPU
+    tunnel plugin needs topology/session parameters; mirror the ones the
+    in-process registration uses, with a FRESH session id (the terminal's
+    session lock is keyed by it). Other PJRT plugins (CPU) need none —
+    the file is simply empty when no tunnel topology is configured."""
+    import uuid
+    lines = []
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if gen:
+        rc = 1 if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1" else 0
+        lines += [f"i remote_compile {rc}",
+                  "i local_only 0",
+                  "i priority 0",
+                  f"s topology {gen}:1x1x1",
+                  "i n_slices 1",
+                  f"s session_id {uuid.uuid4()}",
+                  "i rank 4294967295"]
+    with open(os.path.join(bundle_dir, "options.txt"), "w") as f:
+        f.write("\n".join(lines) + ("\n" if lines else ""))
