@@ -108,3 +108,37 @@ def test_check_nan_inf_flag(monkeypatch):
     out = exe.run(main, feed={"x": np.array([[1.0, 2.0]], np.float32)},
                   fetch_list=[y.name])
     assert np.isfinite(out[0]).all()
+
+
+def test_kube_gen_job_yaml():
+    """Cluster fan-out template (round-2 verdict item 10; reference:
+    benchmark/fluid/kube_gen_job.py): generated yaml carries an Indexed
+    Job + headless Service with the PADDLE_* env convention."""
+    import sys
+    import os
+    import yaml
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import kube_gen_job as kg
+    args = kg.parse_args(["--jobname", "tj", "--trainers", "4",
+                          "--image", "img:1", "--tpu", "4",
+                          "--tpu-topology", "2x2",
+                          "--entry", "python t.py",
+                          "--env", "FLAGS_check_nan_inf=1"])
+    svc, job = kg.gen_all(args)
+    # round-trip through yaml like kubectl would consume it
+    svc, job = yaml.safe_load(yaml.safe_dump(svc)), \
+        yaml.safe_load(yaml.safe_dump(job))
+    assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 4
+    pod = job["spec"]["template"]["spec"]
+    assert pod["subdomain"] == "tj"
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["PADDLE_COORDINATOR"]["value"] == "tj-0.tj:9876"
+    assert env["PADDLE_TRAINERS_NUM"]["value"] == "4"
+    assert "job-completion-index" in str(env["PADDLE_TRAINER_ID"])
+    assert env["FLAGS_check_nan_inf"]["value"] == "1"
+    res = pod["containers"][0]["resources"]["limits"]
+    assert res["google.com/tpu"] == "4"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"

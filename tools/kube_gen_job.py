@@ -1,0 +1,150 @@
+"""Kubernetes job-spec generator for multi-host training.
+
+Capability parity with the reference's cluster fan-out
+(reference: benchmark/fluid/kube_gen_job.py — pserver+trainer
+ReplicaSets parameterized by --jobname/--trainers/--pservers/--entry;
+templates in benchmark/fluid/kube_templates/__init__.py).
+
+TPU-native form: there are NO pserver pods (mesh sharding + ICI
+collectives replace them, SURVEY §2 parallelism table) — the job is an
+**Indexed Job** of N identical trainer pods plus a headless Service for
+the coordination-service rendezvous. Each pod gets the SAME env
+convention tools/launch.py provides locally (PADDLE_COORDINATOR /
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM), so the training script is
+identical on a laptop and on the cluster:
+`paddle_tpu.distributed.init_parallel_env()` with no arguments.
+
+    python tools/kube_gen_job.py --jobname myjob --trainers 4 \
+        --image gcr.io/me/train:latest --tpu 4 \
+        --entry "python train.py --lr 0.1" > job.yaml
+    kubectl apply -f job.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List
+
+
+def gen_service(jobname: str, port: int) -> dict:
+    """Headless service giving pod 0 a stable DNS name — the
+    coordination-service endpoint (the reference exposed pserver
+    endpoints the same way, kube_templates pserver services)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": jobname},
+        "spec": {
+            "clusterIP": "None",
+            # publish pod DNS records before readiness: later-index pods
+            # must resolve <job>-0.<job> while pod 0 may still be
+            # Pending on a partially full cluster
+            "publishNotReadyAddresses": True,
+            "selector": {"job-name": jobname},
+            "ports": [{"name": "coordinator", "port": port}],
+        },
+    }
+
+
+def gen_job(jobname: str, image: str, trainers: int, entry: str,
+            port: int = 9876, cpu: int = 4, memory_gi: int = 8,
+            tpu: int = 0, tpu_topology: str = "",
+            env: dict | None = None) -> dict:
+    """Indexed Job: completion index = trainer rank (the reference's
+    PADDLE_TRAINER_ID convention, kube_gen_job.py envs)."""
+    container_env = [
+        # rank 0's pod has the stable DNS name <job>-0.<svc>
+        {"name": "PADDLE_COORDINATOR",
+         "value": f"{jobname}-0.{jobname}:{port}"},
+        {"name": "PADDLE_TRAINERS_NUM", "value": str(trainers)},
+        {"name": "PADDLE_TRAINER_ID",
+         "valueFrom": {"fieldRef": {"fieldPath":
+             "metadata.annotations['batch.kubernetes.io/"
+             "job-completion-index']"}}},
+    ]
+    for k, v in (env or {}).items():
+        container_env.append({"name": k, "value": str(v)})
+    resources = {
+        "requests": {"cpu": str(cpu), "memory": f"{memory_gi}Gi"},
+        "limits": {"cpu": str(cpu), "memory": f"{memory_gi}Gi"},
+    }
+    if tpu:
+        # TPU device plugin resource (cloud TPU k8s convention); the
+        # reference requested nvidia.com/gpu the same way
+        resources["limits"]["google.com/tpu"] = str(tpu)
+        resources["requests"]["google.com/tpu"] = str(tpu)
+    pod_spec = {
+        "subdomain": jobname,          # members resolve via the service
+        "restartPolicy": "Never",
+        "containers": [{
+            "name": "trainer",
+            "image": image,
+            "command": ["/bin/sh", "-c", entry],
+            "env": container_env,
+            "ports": [{"containerPort": port}],
+            "resources": resources,
+        }],
+    }
+    if tpu_topology:
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-topology": tpu_topology}
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": jobname},
+        "spec": {
+            "completions": trainers,
+            "parallelism": trainers,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,
+            "template": {
+                "metadata": {"labels": {"job-name": jobname}},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def gen_all(args) -> List[dict]:
+    for kv in (args.env or []):
+        if "=" not in kv:
+            raise SystemExit(
+                f"kube_gen_job: --env expects K=V, got {kv!r}")
+    env = dict(kv.split("=", 1) for kv in (args.env or []))
+    return [
+        gen_service(args.jobname, args.port),
+        gen_job(args.jobname, args.image, args.trainers, args.entry,
+                port=args.port, cpu=args.cpu, memory_gi=args.memory,
+                tpu=args.tpu, tpu_topology=args.tpu_topology, env=env),
+    ]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Generate a Kubernetes training-job yaml "
+                    "(reference: benchmark/fluid/kube_gen_job.py)")
+    p.add_argument("--jobname", default="paddlejob")
+    p.add_argument("--image", default="paddle-tpu:latest")
+    p.add_argument("--trainers", type=int, default=1)
+    p.add_argument("--entry", default="python train.py")
+    p.add_argument("--port", type=int, default=9876)
+    p.add_argument("--cpu", type=int, default=4)
+    p.add_argument("--memory", type=int, default=8,
+                   help="per-pod memory (Gi)")
+    p.add_argument("--tpu", type=int, default=0,
+                   help="TPU chips per pod (google.com/tpu resource)")
+    p.add_argument("--tpu-topology", default="",
+                   help="gke-tpu-topology node selector, e.g. 2x4")
+    p.add_argument("--env", action="append", metavar="K=V",
+                   help="extra container env (repeatable)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    import yaml
+    docs = gen_all(parse_args(argv))
+    print(yaml.safe_dump_all(docs, sort_keys=False))
+
+
+if __name__ == "__main__":
+    main()
