@@ -8,6 +8,8 @@ from paddle_tpu.contrib import layout  # noqa: F401
 from paddle_tpu.contrib import mixed_precision  # noqa: F401
 from paddle_tpu.contrib import recompute  # noqa: F401
 from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib.memory_usage import (  # noqa: F401
+    memory_usage, memory_usage_gb)
 from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
 
 from paddle_tpu.contrib.quantize_transpiler import QuantizeTranspiler  # noqa: F401

@@ -183,7 +183,7 @@ def build(is_train: bool = True, src_vocab: int = 32000,
           d_inner: int = 2048, n_head: int = 8, n_layer: int = 6,
           dropout: float = 0.1, lr: float = 1e-4, warmup: int = 4000,
           label_smooth_eps: float = 0.1, fused_attention: bool = False,
-          fused_head: bool = False):
+          fused_head: bool = False, lr_scheduler: str = "const"):
     """Transformer-base training graph (Vaswani config: 512/2048/8/6).
 
     fused_head routes the loss through layers.fused_linear_cross_entropy
@@ -224,8 +224,25 @@ def build(is_train: bool = True, src_vocab: int = 32000,
             layers.softmax_with_cross_entropy(flat_logits, flat_label)
     loss = layers.mean(loss_vec)
     if is_train:
-        # Adam + fixed LR for round 1 (Noam warmup scheduler in a later round)
-        fluid.optimizer.Adam(learning_rate=lr, beta1=0.9,
+        if lr_scheduler == "noam":
+            # the Vaswani schedule: lr * d_model^-0.5 * min(n^-0.5,
+            # n * warmup^-1.5). NOTE: under "noam", `lr` is the Noam
+            # MULTIPLIER (conventionally ~1.0-2.0), not an absolute
+            # rate — the default 1e-4 would freeze training at ~7e-8
+            if lr < 1e-2:
+                raise ValueError(
+                    f"lr_scheduler='noam' interprets lr as the Noam "
+                    f"multiplier (use ~1.0); lr={lr} would give a peak "
+                    f"rate of ~{lr * d_model ** -0.5 * warmup ** -0.5:.1e}")
+            from paddle_tpu.fluid.learning_rate_scheduler import noam_decay
+            rate = noam_decay(d_model, warmup, learning_rate=lr)
+        elif lr_scheduler == "const":
+            rate = lr
+        else:
+            raise ValueError(
+                f"unknown lr_scheduler {lr_scheduler!r} "
+                f"(expected 'const' or 'noam')")
+        fluid.optimizer.Adam(learning_rate=rate, beta1=0.9,
                              beta2=0.997, epsilon=1e-9).minimize(loss)
     feed_specs = {"src_ids": ([-1, max_len, 1], "int64"),
                   "tgt_ids": ([-1, max_len, 1], "int64"),

@@ -487,3 +487,50 @@ def test_recompute_rewrite_gradient_parity():
     l1, w1 = build(True)
     assert l0 == l1
     np.testing.assert_array_equal(w0, w1)
+
+
+def test_memory_usage_estimator():
+    """contrib memory_usage (reference: contrib/memory_usage_calc.py) —
+    parameters + persistables + an activation band, batch dim resolved."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import memory_usage
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[256], dtype="float32")
+        h = fluid.layers.fc(x, 512)          # W [256,512] + b [512]
+        fluid.layers.mean(h)
+    u = memory_usage(main, batch_size=64, optimizer_slots=0)
+    w_bytes = 256 * 512 * 4 + 512 * 4
+    assert u["parameters"] == w_bytes
+    # activations include x [64,256] and h [64,512]
+    assert u["activations"] >= (64 * 256 + 64 * 512) * 4
+    assert u["total_low"] <= u["total_high"]
+    # batch scaling: doubling the batch grows activations, not params
+    u2 = memory_usage(main, batch_size=128, optimizer_slots=0)
+    assert u2["parameters"] == u["parameters"]
+    assert u2["activations"] > u["activations"]
+
+
+def test_transformer_noam_schedule_trains():
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        loss, _, feed_specs = models.transformer.build(
+            is_train=True, src_vocab=64, tgt_vocab=64, max_len=8,
+            d_model=32, d_inner=64, n_head=4, n_layer=1,
+            lr_scheduler="noam", warmup=10, lr=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randint(0, 64, [2 if d == -1 else d for d in sh])
+            .astype(dt) for n, (sh, dt) in feed_specs.items()}
+    vals = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+            for _ in range(4)]
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[-1] < vals[0]        # warmup lr tiny but nonzero

@@ -1,0 +1,64 @@
+"""Sharded test runner (reference capability: tools/test_runner.py +
+the cmake py_test registration that shards/parallelizes the suite,
+unittests/CMakeLists.txt; hang detection per tools/check_ctest_hung.py).
+
+Splits the test FILES deterministically across N shards (sorted order,
+round-robin) and runs each shard as one pytest invocation with a hard
+timeout — a stuck test kills the shard with a named report instead of
+hanging CI.
+
+    python tools/test_runner.py --shards 4 --shard 1
+    python tools/test_runner.py --only test_book test_models
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import subprocess
+import sys
+
+
+def shard_files(all_files, shards, shard):
+    return [f for i, f in enumerate(sorted(all_files))
+            if i % shards == shard]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=1)
+    ap.add_argument("--shard", type=int, default=0)
+    ap.add_argument("--timeout", type=int, default=2400,
+                    help="whole-shard timeout in seconds")
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="test module names (without .py) to run instead")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests_dir = os.path.join(root, "tests")
+    if args.only:
+        files = [os.path.join(tests_dir, f"{m}.py") for m in args.only]
+        missing = [f for f in files if not os.path.exists(f)]
+        if missing:
+            sys.exit(f"test_runner: no such test files: {missing}")
+    else:
+        files = shard_files(glob.glob(os.path.join(tests_dir, "test_*.py")),
+                            args.shards, args.shard)
+    if not files:
+        print("test_runner: empty shard, nothing to do")
+        return 0
+    rel = [os.path.relpath(f, root) for f in files]
+    print(f"test_runner: shard {args.shard}/{args.shards} -> "
+          f"{len(rel)} files")
+    cmd = [sys.executable, "-m", "pytest", "-q", *rel]
+    try:
+        r = subprocess.run(cmd, cwd=root, timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        sys.exit(f"test_runner: shard exceeded {args.timeout}s "
+                 f"(hung test among: {rel})")
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
