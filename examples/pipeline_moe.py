@@ -68,7 +68,7 @@ def main():
         (lv,) = exe.run(run_target, feed={"x": xb, "y": xb @ w},
                         fetch_list=[loss])
         if step % 10 == 0 or step == 39:
-            print(f"step {step:2d}  loss {float(np.asarray(lv)):.4f}")
+            print(f"step {step:2d}  loss {float(np.asarray(lv).reshape(())):.4f}")
 
 
 if __name__ == "__main__":

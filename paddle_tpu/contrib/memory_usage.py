@@ -44,18 +44,26 @@ def memory_usage(program, batch_size: int, optimizer_slots: int = 0):
       straight sum.
     """
     desc = program.desc if hasattr(program, "desc") else program
-    block = desc.global_block
     persistent = 0
     activations = 0
     params = 0
-    for v in block.vars.values():
-        b = _var_bytes(v, batch_size)
-        if v.persistable:
-            persistent += b
-            if getattr(v, "is_parameter", False):
-                params += b
-        else:
-            activations += b
+    seen = set()
+    # every block: while/RNN bodies and Pipeline stages hold their own
+    # activation vars (one live iteration under lax.scan/while — the
+    # stacked scan outputs live in the PARENT block, so counting each
+    # sub-block var once keeps the bound honest)
+    for block in desc.blocks:
+        for v in block.vars.values():
+            if (block.idx, v.name) in seen:
+                continue
+            seen.add((block.idx, v.name))
+            b = _var_bytes(v, batch_size)
+            if v.persistable:
+                persistent += b
+                if getattr(v, "is_parameter", False):
+                    params += b
+            else:
+                activations += b
     est_opt_state = params * optimizer_slots
     persistent_total = persistent + est_opt_state
     return {

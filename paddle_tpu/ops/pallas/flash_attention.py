@@ -33,12 +33,15 @@ def _block_visible(causal, kb, bk, q_last):
     return (kb * bk) < q_last
 
 
-def _masked_scores(q, k, causal, qb, j, bq, bk, q_off):
+def _masked_scores(q, k, scale, causal, qb, j, bq, bk, q_off):
     """Scaled q·kᵀ with the causal iota mask — the single source of the
     mask convention shared by the forward and both backward kernels
-    (forward/backward desync here would corrupt gradients silently)."""
+    (forward/backward desync here would corrupt gradients silently).
+    Operands stay in their storage dtype (bf16 under AMP — an fp32
+    upcast before the dot runs the MXU at the fp32 rate, ~6x slower);
+    accumulation is fp32 and the scale applies post-dot in fp32."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         qpos = (q_off + qb * bq +
                 jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
@@ -112,10 +115,10 @@ def _fwd_kernel(*args, bq, bk, nk, causal, scale, q_off, dropout_p):
 
     @pl.when(visible)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
-        s = _masked_scores(q, k, causal, qb, j, bq, bk, q_off)
+        q = q_ref[0]                                      # [BQ, D]
+        k = k_ref[0]                                      # [BK, D]
+        v = v_ref[0]
+        s = _masked_scores(q, k, scale, causal, qb, j, bq, bk, q_off)
         m = m_scr[:]
         l = l_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -128,7 +131,7 @@ def _fwd_kernel(*args, bq, bk, nk, causal, scale, q_off, dropout_p):
             pv = p * _block_keep_mask(seed_ref[0], bh, qb, j, bq, bk,
                                       q_off, dropout_p)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-            pv, v, preferred_element_type=jnp.float32)
+            pv.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -273,11 +276,11 @@ def _dq_kernel(*args, bq, bk, nk, causal, scale, q_off, has_glse,
 
     @pl.when(visible)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
-        s = _masked_scores(q, k, causal, qb, j, bq, bk, q_off)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = _masked_scores(q, k, scale, causal, qb, j, bq, bk, q_off)
         p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -287,7 +290,8 @@ def _dq_kernel(*args, bq, bk, nk, causal, scale, q_off, has_glse,
         corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
         ds = p * (dp - corr)
         dq_scr[:] = dq_scr[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32) * scale
+            ds.astype(k.dtype), k,
+            preferred_element_type=jnp.float32) * scale
 
     @pl.when(j == nk - 1)
     def _():
@@ -323,11 +327,11 @@ def _dkv_kernel(*args, bq, bk, nq, causal, scale, q_off, has_glse,
 
     @pl.when(visible)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
-        s = _masked_scores(q, k, causal, i, kb, bq, bk, q_off)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        s = _masked_scores(q, k, scale, causal, i, kb, bq, bk, q_off)
         p = jnp.exp(s - lse_ref[0])                       # [BQ, BK]
         pm = p
         if dropout_p > 0:
@@ -335,7 +339,7 @@ def _dkv_kernel(*args, bq, bk, nq, causal, scale, q_off, has_glse,
                                     q_off, dropout_p)
             pm = p * mask
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            pm, g, (((0,), (0,)), ((), ())),
+            pm.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (p·m)ᵀ·dO [BK, D]
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -344,8 +348,8 @@ def _dkv_kernel(*args, bq, bk, nq, causal, scale, q_off, has_glse,
         corr = delta_ref[0] - (glse_ref[0] if has_glse else 0.0)
         ds = p * (dp - corr)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # dsᵀ·(scale·Q)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # scale·dsᵀ·Q
 
     @pl.when(i == nq - 1)
     def _():

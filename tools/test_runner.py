@@ -34,6 +34,9 @@ def main(argv=None):
     ap.add_argument("--only", nargs="*", default=None,
                     help="test module names (without .py) to run instead")
     args = ap.parse_args(argv)
+    if not (0 <= args.shard < args.shards):
+        ap.error(f"--shard must be in [0, {args.shards}) — got "
+                 f"{args.shard} (shards are 0-based)")
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tests_dir = os.path.join(root, "tests")
