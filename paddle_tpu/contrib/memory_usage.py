@@ -49,14 +49,14 @@ def memory_usage(program, batch_size: int, optimizer_slots: int = 0):
     params = 0
     seen = set()
     # every block: while/RNN bodies and Pipeline stages hold their own
-    # activation vars (one live iteration under lax.scan/while — the
-    # stacked scan outputs live in the PARENT block, so counting each
-    # sub-block var once keeps the bound honest)
+    # activation vars (one live iteration under lax.scan/while). A name
+    # declared in several blocks (a sub-block shadowing or re-declaring
+    # its parent's var) counts ONCE — dedup by NAME across blocks
     for block in desc.blocks:
         for v in block.vars.values():
-            if (block.idx, v.name) in seen:
+            if v.name in seen:
                 continue
-            seen.add((block.idx, v.name))
+            seen.add(v.name)
             b = _var_bytes(v, batch_size)
             if v.persistable:
                 persistent += b
