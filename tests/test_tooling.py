@@ -142,3 +142,17 @@ def test_kube_gen_job_yaml():
     res = pod["containers"][0]["resources"]["limits"]
     assert res["google.com/tpu"] == "4"
     assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+
+def test_api_spec_matches():
+    """API-stability gate (reference: paddle/fluid/API.spec +
+    tools/diff_api.py in CI): the committed spec matches the live API;
+    intentional changes must regenerate it (--update)."""
+    import sys
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import diff_api
+    assert os.path.exists(diff_api.SPEC_PATH)
+    removed, added = diff_api.spec_diff()
+    assert not removed and not added, (removed[:10], added[:10])

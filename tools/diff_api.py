@@ -1,0 +1,134 @@
+"""Public-API spec dump + diff.
+
+Capability parity with the reference's API-stability gate
+(reference: paddle/fluid/API.spec checked by tools/diff_api.py in CI —
+a PR changing any public signature must update the spec explicitly).
+
+    python tools/diff_api.py --update     # regenerate tools/api_spec.txt
+    python tools/diff_api.py              # diff current API vs the spec
+"""
+
+from __future__ import annotations
+
+import argparse
+import inspect
+import os
+import sys
+
+# runnable as `python tools/diff_api.py` — put the repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "api_spec.txt")
+
+MODULES = [
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.optimizer",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.metrics",
+    "paddle_tpu.fluid.evaluator",
+    "paddle_tpu.fluid.profiler",
+    "paddle_tpu.fluid.transpiler",
+    "paddle_tpu.fluid.compiler",
+    "paddle_tpu.fluid.learning_rate_scheduler",
+    "paddle_tpu.parallel",
+    "paddle_tpu.distributed",
+    "paddle_tpu.inference",
+    "paddle_tpu.dataset",
+    "paddle_tpu.reader",
+    "paddle_tpu.contrib",
+]
+
+
+def _sig(obj):
+    import re
+    try:
+        text = str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+    # scrub memory addresses from default-value reprs (non-deterministic
+    # across processes)
+    return re.sub(r" at 0x[0-9a-f]+", "", text)
+
+
+def _foreign(mod_name, obj):
+    """True for names merely imported into the module from outside the
+    package (dataclasses.field, numpy, ...) — not OUR public API."""
+    owner = getattr(obj, "__module__", None)
+    if owner is None:
+        return False
+    return not (owner.startswith("paddle_tpu") or owner == mod_name)
+
+
+def dump_api():
+    """['module.name SIGNATURE'] for every public callable/class in the
+    spec'd modules (the reference dumped the same shape into API.spec)."""
+    import importlib
+    lines = []
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        public = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(public)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj) or _foreign(mod_name,
+                                                               obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{mod_name}.{name} class{_sig(obj)}")
+                for mname, raw in sorted(vars(obj).items()):
+                    if mname.startswith("_") and mname != "__init__":
+                        continue
+                    if isinstance(raw, property):
+                        lines.append(f"{mod_name}.{name}.{mname} property")
+                    elif isinstance(raw, (classmethod, staticmethod)):
+                        lines.append(
+                            f"{mod_name}.{name}.{mname} "
+                            f"{_sig(raw.__func__)}")
+                    elif callable(raw):
+                        lines.append(
+                            f"{mod_name}.{name}.{mname} {_sig(raw)}")
+            elif callable(obj):
+                lines.append(f"{mod_name}.{name} {_sig(obj)}")
+    return sorted(set(lines))
+
+
+def spec_diff(current_lines=None):
+    """(removed, added) between the committed spec and the live API —
+    the ONE comparison both the CLI and the CI test use."""
+    cur = set(current_lines if current_lines is not None else dump_api())
+    want = {l.rstrip("\n") for l in open(SPEC_PATH) if l.strip()}
+    return sorted(want - cur), sorted(cur - want)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the committed spec from the current API")
+    args = ap.parse_args(argv)
+    lines = dump_api()
+    if args.update:
+        with open(SPEC_PATH, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} API entries to {SPEC_PATH}")
+        return 0
+    if not os.path.exists(SPEC_PATH):
+        sys.exit(f"no spec at {SPEC_PATH}; run with --update first")
+    removed, added = spec_diff(lines)
+    for l in removed:
+        print(f"- {l}")
+    for l in added:
+        print(f"+ {l}")
+    if removed or added:
+        print(f"\nAPI drift: {len(removed)} removed/changed, "
+              f"{len(added)} added. If intentional, run "
+              f"`python tools/diff_api.py --update` and commit the spec.")
+        return 1
+    print(f"API matches spec ({len(lines)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
