@@ -4,8 +4,24 @@ Reference: listen_and_serv_op.cc RunAsyncLoop (:217-268) — the async
 pserver mode runs NO barriers: each gradient that arrives from any
 trainer immediately executes its own prepared optimizer subgraph
 (grad_to_prepared_ctx, :268) against the shared parameter state, and
-trainers pull whatever parameter values are current. DC-ASGD remains a
-documented drop (docs/migration.md).
+trainers pull whatever parameter values are current.
+
+DC-ASGD (delay-compensated async SGD) rides the same loop (`dc_asgd=
+True`): the server keeps one parameter backup per trainer, refreshed
+every time that trainer pulls the parameter (reference
+request_handler_impl.cc:96-106 copies `param` to
+`param.trainer_%d_bak` on every GET), and compensates each arriving
+gradient for its staleness with the Taylor term
+
+    dc = grad + lambda * (param - param_bak[trainer_id]) * grad * grad
+
+before running the optimizer subgraph (reference
+distribute_transpiler.py:1595 _append_dc_asgd_ops — elementwise
+sub/mul/mul/add chain; the reference applies the term unscaled, a
+`TODO(typhoonzero): append scale` marks the missing lambda, so
+`dc_lambda` defaults to the reference's implicit 1.0). Backups start
+at the startup-program value, exactly the reference's startup `assign`
+param -> param_bak (distribute_transpiler.py:977-985).
 
 TPU-native shape: the pserver half of the DistributeTranspiler split
 (fluid/transpiler.py get_pserver_program) runs HOST-side here — async
@@ -42,7 +58,8 @@ class AsyncPServer:
         ps.stop()
     """
 
-    def __init__(self, pserver_program, startup_program, scope=None):
+    def __init__(self, pserver_program, startup_program, scope=None,
+                 dc_asgd: Optional[bool] = None, dc_lambda: float = 1.0):
         from paddle_tpu.core.executor import CPUPlace, Executor
         from paddle_tpu.core.scope import Scope
         self.scope = scope if scope is not None else Scope()
@@ -55,6 +72,22 @@ class AsyncPServer:
         self._threads: List[threading.Thread] = []
         self._stopping = threading.Event()
         self.n_applied = 0
+        if dc_asgd is None:
+            # the transpiler stamps the flag on the program it hands out
+            # (DistributeTranspilerConfig.enable_dc_asgd), so configuring
+            # the transpiler alone is sufficient — reference behavior
+            dc_asgd = bool(getattr(pserver_program, "_dc_asgd", False))
+        self.dc_asgd = dc_asgd
+        self.dc_lambda = float(dc_lambda)
+        # (trainer_id, param_name) -> backup; misses fall back to the
+        # startup value (reference startup assign, transpiler :977-985)
+        self._param_bak: Dict[tuple, np.ndarray] = {}
+        self._init_params: Dict[str, np.ndarray] = {}
+        if dc_asgd:
+            for name in startup_program.desc.global_block.vars:
+                v = self.scope.find_var(name)
+                if v is not None:
+                    self._init_params[name] = np.array(v, copy=True)
 
     # -- per-grad prepared subgraphs (RunAsyncLoop :268) -------------------
 
@@ -95,16 +128,40 @@ class AsyncPServer:
         self._grad_progs[gname] = prog
         return prog
 
-    def apply_grad(self, gname: str, value) -> None:
+    def _compensate(self, gname: str, g: np.ndarray,
+                    trainer_id: int) -> np.ndarray:
+        """DC-ASGD Taylor compensation (distribute_transpiler.py:1595):
+        dc = g + lambda * (param - param_bak[trainer]) * g * g."""
+        pname = gname.split(GRAD_SUFFIX)[0]
+        v = self.scope.find_var(pname)
+        if v is None:        # grad without a served param: apply as-is
+            return g
+        w = np.asarray(v)
+        bak = self._param_bak.get((trainer_id, pname))
+        if bak is None:
+            bak = self._init_params.get(pname)
+        if bak is None or bak.shape != w.shape:
+            return g
+        return g + self.dc_lambda * (w - bak) * g * g
+
+    def apply_grad(self, gname: str, value,
+                   trainer_id: Optional[int] = None) -> None:
         """Run `gname`'s optimizer subgraph immediately — no barrier, no
-        aggregation across trainers (async-SGD semantics)."""
+        aggregation across trainers (async-SGD semantics). Under
+        `dc_asgd` the fed gradient is staleness-compensated first; a push
+        without a trainer id skips compensation (there is no backup to
+        compensate against — mirrors get_params)."""
         prog = self._prog_for(gname)
         with self._lock:
-            self.exe.run(prog, feed={gname: np.asarray(value)},
+            g = np.asarray(value)
+            if self.dc_asgd and trainer_id is not None:
+                g = self._compensate(gname, g, trainer_id)
+            self.exe.run(prog, feed={gname: g},
                          fetch_list=[], scope=self.scope)
             self.n_applied += 1
 
-    def get_params(self, names: List[str]) -> Dict[str, np.ndarray]:
+    def get_params(self, names: List[str],
+                   trainer_id: Optional[int] = None) -> Dict[str, np.ndarray]:
         with self._lock:
             out = {}
             for n in names:
@@ -114,6 +171,12 @@ class AsyncPServer:
                         f"parameter {n!r} is not served by this pserver "
                         f"(placed on another endpoint?)")
                 out[n] = np.asarray(v)
+            if self.dc_asgd and trainer_id is not None:
+                # refresh this trainer's backups at pull time (reference
+                # request_handler_impl.cc:96-106: GET copies param ->
+                # param.trainer_%d_bak)
+                for n, w in out.items():
+                    self._param_bak[(trainer_id, n)] = np.array(w, copy=True)
             return out
 
     # -- the RPC surface ---------------------------------------------------
@@ -144,16 +207,22 @@ class AsyncPServer:
                 msg = conn.recv()
                 kind = msg[0]
                 if kind == "push":
-                    _, name, value = msg
+                    # ("push", name, value[, trainer_id]); id-less pushes
+                    # (old protocol) get no DC compensation rather than
+                    # borrowing trainer 0's backup
+                    name, value = msg[1], msg[2]
+                    tid = msg[3] if len(msg) > 3 else None
                     try:
-                        self.apply_grad(name, value)
+                        self.apply_grad(name, value, trainer_id=tid)
                     except Exception as e:      # reply, don't kill the conn
                         conn.send(("err", f"push {name!r}: {e!r}"))
                         continue
                     conn.send(("ok",))
                 elif kind == "pull":
+                    # ("pull", names[, trainer_id])
+                    tid = msg[2] if len(msg) > 2 else None
                     try:
-                        params = self.get_params(msg[1])
+                        params = self.get_params(msg[1], trainer_id=tid)
                     except Exception as e:
                         conn.send(("err", f"pull: {e!r}"))
                         continue
@@ -184,18 +253,20 @@ class AsyncTrainerClient:
     (reference trainer half in async mode: send without send_barrier,
     distribute_transpiler.py sync_mode=False)."""
 
-    def __init__(self, address, authkey: bytes = b"paddle_tpu"):
+    def __init__(self, address, authkey: bytes = b"paddle_tpu",
+                 trainer_id: int = 0):
         from multiprocessing.connection import Client
         self._conn = Client(tuple(address), authkey=authkey)
+        self.trainer_id = int(trainer_id)
 
     def push_grad(self, name: str, value) -> None:
-        self._conn.send(("push", name, np.asarray(value)))
+        self._conn.send(("push", name, np.asarray(value), self.trainer_id))
         kind, *rest = self._conn.recv()
         if kind != "ok":
             raise RuntimeError(f"push_grad {name}: {rest}")
 
     def pull(self, names: List[str]) -> Dict[str, np.ndarray]:
-        self._conn.send(("pull", list(names)))
+        self._conn.send(("pull", list(names), self.trainer_id))
         kind, *rest = self._conn.recv()
         if kind != "params":
             raise RuntimeError(f"pull: {rest}")

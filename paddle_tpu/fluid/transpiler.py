@@ -101,6 +101,12 @@ class DistributeTranspilerConfig:
     split_method: type = RoundRobin
     min_block_size: int = 8192
     mode: str = "pserver"          # "pserver" | "nccl2" | "collective"
+    # DC-ASGD (reference: distribute_transpiler.py:150 enable_dc_asgd;
+    # delay compensation applied by the async pserver, see
+    # distributed.AsyncPServer(dc_asgd=True)): the async server keeps a
+    # per-trainer param backup and feeds optimizers the compensated
+    # gradient g + (w - w_bak)*g*g.
+    enable_dc_asgd: bool = False
 
 
 class DistributeTranspiler:
@@ -205,7 +211,12 @@ class DistributeTranspiler:
         my_ops = [op for op in ops
                   if not op.inputs.get("Param")
                   or set(op.inputs["Param"]) & my_params]
-        return prune_to_program(src, my_ops)
+        prog = prune_to_program(src, my_ops)
+        # stamp the DC-ASGD request on the program so AsyncPServer picks
+        # it up from the config alone (reference: enable_dc_asgd rewrites
+        # the pserver optimize blocks, distribute_transpiler.py:1672)
+        prog._dc_asgd = self.config.enable_dc_asgd
+        return prog
 
     def get_startup_program(self, endpoint: str, pserver_program=None):
         """Startup pruned to the persistables this endpoint owns

@@ -45,7 +45,7 @@ def main():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)     # local init; params replaced by pulls
 
-    client = AsyncTrainerClient((host, int(port)))
+    client = AsyncTrainerClient((host, int(port)), trainer_id=rank)
     rng = np.random.RandomState(100 + rank)
     losses = []
     for _ in range(steps):

@@ -2,8 +2,8 @@
 RunAsyncLoop capability (reference listen_and_serv_op.cc:217-268) —
 per-gradient optimizer subgraphs applied with NO trainer barriers —
 behind the existing DistributeTranspiler split, exercised by a DeepFM
-config across two real OS processes. DC-ASGD stays a documented drop
-(docs/migration.md)."""
+config across two real OS processes. DC-ASGD (delay compensation) is
+covered by the tests at the bottom of this file."""
 
 import json
 import os
@@ -152,3 +152,100 @@ def test_deepfm_two_process_async_converges():
     assert async_loss < init_loss, (async_loss, init_loss)
     # async staleness costs some quality; the tolerance bounds it
     assert abs(async_loss - sync_loss) < 0.25, (async_loss, sync_loss)
+
+
+# -- DC-ASGD (delay-compensated async SGD) --------------------------------
+# reference: distribute_transpiler.py:1595 _append_dc_asgd_ops (the
+# sub/mul/mul/add compensation chain, unscaled), :977-985 (startup
+# param->bak assign), request_handler_impl.cc:96-106 (GET refreshes
+# param.trainer_%d_bak). This closes the last parallelism-table row that
+# was previously a documented drop.
+
+
+def _build_linear(seed=7, lr=0.1):
+    from paddle_tpu.fluid import unique_name
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = seed
+    startup.random_seed = seed
+    with unique_name.guard():
+        with fluid.program_guard(main_p, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(x, 1, bias_attr=False)
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main_p, startup
+
+
+def _dc_server(lr=0.1):
+    main_p, startup = _build_linear(lr=lr)
+    t = DistributeTranspiler()
+    t.config.enable_dc_asgd = True
+    ep = "127.0.0.1:0"
+    t.transpile(0, program=main_p, pservers=ep, trainers=2,
+                sync_mode=False, startup_program=startup)
+    ps_prog = t.get_pserver_program(ep)
+    ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog),
+                      dc_asgd=t.config.enable_dc_asgd)
+    g = t.send_vars[0]
+    pname = next(p for p in t.params if g == p + "@GRAD")
+    return ps, g, pname
+
+
+def test_dc_asgd_compensation_exact():
+    """One stale push reproduces w -= lr*(g + (w-w_bak)*g*g) bit-for-bit."""
+    lr = 0.1
+    ps, g, pname = _dc_server(lr=lr)
+    # trainer 1 pulls -> its backup snapshots w0
+    w0 = ps.get_params([pname], trainer_id=1)[pname].copy()
+    # trainer 0 pushes while w == its backup (startup value): dc == g
+    g1 = np.full(w0.shape, 0.5, np.float32)
+    ps.apply_grad(g, g1, trainer_id=0)
+    w1 = ps.get_params([pname])[pname].copy()
+    np.testing.assert_allclose(w1, w0 - lr * g1, rtol=1e-6)
+    # trainer 1's gradient is now stale by (w1 - w0): compensated
+    g2 = np.full(w0.shape, -0.25, np.float32)
+    ps.apply_grad(g, g2, trainer_id=1)
+    dc = g2 + (w1 - w0) * g2 * g2
+    w2 = ps.get_params([pname])[pname]
+    np.testing.assert_allclose(w2, w1 - lr * dc, rtol=1e-5, atol=1e-7)
+
+
+def test_dc_asgd_backup_refreshes_on_pull():
+    """Pulling again re-snapshots the backup: an immediately-following
+    push gets zero compensation (dc == g), per the reference GET handler."""
+    lr = 0.1
+    ps, g, pname = _dc_server(lr=lr)
+    ps.apply_grad(g, np.full((4, 1), 1.0, np.float32), trainer_id=0)
+    # trainer 1 pulls AFTER that update -> bak == current w
+    w = ps.get_params([pname], trainer_id=1)[pname].copy()
+    g2 = np.full(w.shape, 2.0, np.float32)
+    ps.apply_grad(g, g2, trainer_id=1)
+    w2 = ps.get_params([pname])[pname]
+    np.testing.assert_allclose(w2, w - lr * g2, rtol=1e-6)
+
+
+def test_dc_asgd_over_the_wire_trainer_id():
+    """The connection protocol carries trainer_id: two clients with
+    different ids get independent backups."""
+    lr = 0.1
+    ps, g, pname = _dc_server(lr=lr)
+    port = _free_port()
+    ps.serve(("127.0.0.1", port))
+    try:
+        c0 = AsyncTrainerClient(("127.0.0.1", port), trainer_id=0)
+        c1 = AsyncTrainerClient(("127.0.0.1", port), trainer_id=1)
+        w0 = c1.pull([pname])[pname].copy()         # bak(t1) = w0
+        g1 = np.full(w0.shape, 0.5, np.float32)
+        c0.push_grad(g, g1)                          # dc == g1 (t0 fresh)
+        w1 = c0.pull([pname])[pname].copy()
+        np.testing.assert_allclose(w1, w0 - lr * g1, rtol=1e-6)
+        g2 = np.full(w0.shape, -0.25, np.float32)
+        c1.push_grad(g, g2)                          # stale by w1-w0
+        dc = g2 + (w1 - w0) * g2 * g2
+        w2 = c0.pull([pname])[pname]
+        np.testing.assert_allclose(w2, w1 - lr * dc, rtol=1e-5, atol=1e-7)
+        c0.close()
+        c1.stop_server()
+        c1.close()
+    finally:
+        ps.stop()
