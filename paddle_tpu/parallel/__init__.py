@@ -10,11 +10,12 @@ that the reference hand-scheduled over NCCL.
 """
 
 from paddle_tpu.parallel.mesh import (DistributeConfig, get_default_mesh,
-                                      make_mesh, set_default_mesh)
+                                      make_hybrid_mesh, make_mesh,
+                                      set_default_mesh)
 from paddle_tpu.parallel import collective  # noqa: F401
 from paddle_tpu.parallel.pipeline import gpipe, stack_stage_params  # noqa: F401
 from paddle_tpu.parallel.moe import moe_ffn  # noqa: F401
 
 __all__ = ["DistributeConfig", "collective", "get_default_mesh", "gpipe",
-           "make_mesh", "moe_ffn", "set_default_mesh",
+           "make_hybrid_mesh", "make_mesh", "moe_ffn", "set_default_mesh",
            "stack_stage_params"]

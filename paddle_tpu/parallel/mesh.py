@@ -35,6 +35,86 @@ def make_mesh(axis_sizes: Optional[Dict[str, int]] = None,
     return Mesh(arr, names)
 
 
+def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
+                     devices=None) -> Mesh:
+    """Build a multi-slice Mesh whose DCN axes span slices and whose ICI
+    axes stay inside one slice — so the cheap high-bandwidth collectives
+    (tp/sp all-gathers and reduce-scatters every layer) ride the intra-
+    slice ICI torus and only the once-per-step gradient reductions (dp)
+    cross the slice-to-slice data-center network.
+
+    The reference's analogue is the two-tier NCCL topology: intra-node
+    NVLink ring per trainer + inter-node "nccl2" rings stitched by
+    gen_nccl_id (nccl_helper.h:86 NCCLContextMap over local devices;
+    distribute_transpiler.py:222 _transpile_nccl2 for the cross-host
+    tier). Here the tiers are declared in the mesh itself and XLA's
+    partitioner picks the right collective per axis.
+
+    DCN axes are laid out OUTERMOST (slowest-varying), so all devices of
+    one slice are contiguous along every ICI axis. Slice membership comes
+    from `device.slice_index` (multi-slice TPU), falling back to
+    `device.process_index` (one host = one slice: the multi-host DCN
+    case), falling back to contiguous groups (CPU test meshes, where
+    neither attribute distinguishes devices). If the ICI extent does not
+    fit inside one physical slice, the call raises rather than silently
+    routing per-layer collectives over DCN.
+
+        mesh = make_hybrid_mesh({"tp": 4}, {"dp": 2})   # 2 slices x 4 chips
+        # axis_names ("dp", "tp"): dp crosses DCN, tp stays on ICI
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ici_names, dcn_names = list(ici_axes), list(dcn_axes)
+    ici_sizes = [ici_axes[n] for n in ici_names]
+    dcn_sizes = [dcn_axes[n] for n in dcn_names]
+    per_slice = int(np.prod(ici_sizes))
+    want_slices = int(np.prod(dcn_sizes))
+    if per_slice * want_slices != len(devices):
+        raise ValueError(
+            f"hybrid mesh ici={ici_axes} x dcn={dcn_axes} needs "
+            f"{per_slice * want_slices} devices, have {len(devices)}")
+
+    ordered = _order_devices_by_slice(devices, per_slice, want_slices)
+    arr = np.asarray(ordered).reshape(dcn_sizes + ici_sizes)
+    return Mesh(arr, dcn_names + ici_names)
+
+
+def _order_devices_by_slice(devices, per_slice: int, want_slices: int):
+    """Sort devices slice-major so a reshape puts whole slices on the
+    outer (DCN) axes. Slice membership: `slice_index` (multi-slice TPU) >
+    `process_index` (one host = one slice) > contiguous groups (CPU test
+    meshes where neither attribute distinguishes devices).
+
+    The group count need not equal prod(dcn_axes): one physical slice may
+    hold several DCN blocks (it is then split), and one DCN block may
+    span several whole slices. What is never allowed is an ICI block
+    straddling a physical slice boundary — that would silently route
+    per-layer collectives over DCN, so it raises instead."""
+    def slice_id(d):
+        sid = getattr(d, "slice_index", None)
+        if sid is not None:
+            return sid
+        return getattr(d, "process_index", 0)
+
+    groups: Dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(slice_id(d), []).append(d)
+    if len(groups) <= 1:
+        # single-slice / emulated fallback: contiguous groups act as slices
+        return list(devices)
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"slices are uneven ({ {k: len(g) for k, g in groups.items()} })")
+    actual_per_slice = sizes.pop()
+    if actual_per_slice % per_slice != 0:
+        raise ValueError(
+            f"prod(ici_axes)={per_slice} does not divide the "
+            f"{actual_per_slice} devices of one physical slice — an ICI "
+            f"block would straddle slices; shrink the ICI axes or move "
+            f"an axis to dcn_axes")
+    return [d for sid in sorted(groups) for d in groups[sid]]
+
+
 _default_mesh: Optional[Mesh] = None
 
 
