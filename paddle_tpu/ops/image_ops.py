@@ -140,6 +140,30 @@ def _grid_sampler(ctx, ins, attrs):
 
 # -- unpooling / indexed pooling ---------------------------------------------
 
+def max_pool_with_index_nd(x, window, strides, padding):
+    """Shared N-D max-pool-with-index: Out from the plain max
+    reduce_window (differentiable — XLA derives select_and_scatter for
+    its backward); the flat spatial index from a variadic first-max
+    select under stop_gradient, whose vjp otherwise rejects the
+    symbolic-zero cotangent of the integer output. Index payload is
+    int32 — a float32 mantissa would corrupt indices > 2^24."""
+    spatial = x.shape[2:]
+    flat = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+    flat = jnp.broadcast_to(flat, x.shape)
+    out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, idx = lax.reduce_window(
+        (lax.stop_gradient(x), flat), (-jnp.inf, jnp.int32(-1)),
+        select, window, strides, padding)
+    return out, idx
+
+
 @register_op("max_pool2d_with_index", ref="operators/pool_with_index_op.cc")
 def _max_pool2d_with_index(ctx, ins, attrs):
     """Max pool returning both values and the flat HW index of each max
@@ -151,24 +175,10 @@ def _max_pool2d_with_index(ctx, ins, attrs):
     if attrs.get("global_pooling", False):
         k = list(x.shape[2:])
         s, p = k, [0, 0]
-    n, c, h, w = x.shape
-    flat_idx = jnp.broadcast_to(
-        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]).astype(jnp.float32),
-        x.shape)
-    window = (1, 1, k[0], k[1])
-    strides = (1, 1, s[0], s[1])
-    padding = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
-
-    def select(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv > av
-        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-
-    out, idx = lax.reduce_window(
-        (x, flat_idx), (-jnp.inf, jnp.float32(-1)), select,
-        window, strides, padding)
-    return {"Out": [out], "Mask": [idx.astype(jnp.int32)]}
+    out, idx = max_pool_with_index_nd(
+        x, (1, 1, k[0], k[1]), (1, 1, s[0], s[1]),
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    return {"Out": [out], "Mask": [idx]}
 
 
 @register_op("unpool", ref="operators/unpool_op.cc")

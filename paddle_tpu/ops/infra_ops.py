@@ -107,26 +107,14 @@ def _mean_iou(ctx, ins, attrs):
 @register_op("max_pool3d_with_index",
              ref="operators/pool_with_index_op.cc (3D)")
 def _max_pool3d_with_index(ctx, ins, attrs):
+    from paddle_tpu.ops.image_ops import max_pool_with_index_nd
     x = first(ins, "X")                  # [N, C, D, H, W]
     k = attrs.get("ksize", [2, 2, 2])
     s = attrs.get("strides", k)
     p = attrs.get("paddings", [0, 0, 0])
-    n, c, d, h, w = x.shape
-    # int32 index payload (float32 mantissa would corrupt indices > 2^24)
-    flat = jnp.arange(d * h * w, dtype=jnp.int32).reshape(d, h, w)
-    flat = jnp.broadcast_to(flat, x.shape)
-    window = (1, 1, k[0], k[1], k[2])
-    strides = (1, 1, s[0], s[1], s[2])
-    padding = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
-
-    def select(a, b):
-        av, ai = a
-        bv, bi = b
-        t = bv > av
-        return jnp.where(t, bv, av), jnp.where(t, bi, ai)
-
-    out, idx = lax.reduce_window((x, flat), (-jnp.inf, jnp.int32(-1)),
-                                 select, window, strides, padding)
+    out, idx = max_pool_with_index_nd(
+        x, (1, 1, k[0], k[1], k[2]), (1, 1, s[0], s[1], s[2]),
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2])))
     return {"Out": [out], "Mask": [idx]}
 
 

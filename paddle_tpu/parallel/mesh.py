@@ -73,12 +73,12 @@ def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
             f"hybrid mesh ici={ici_axes} x dcn={dcn_axes} needs "
             f"{per_slice * want_slices} devices, have {len(devices)}")
 
-    ordered = _order_devices_by_slice(devices, per_slice, want_slices)
+    ordered = _order_devices_by_slice(devices, per_slice)
     arr = np.asarray(ordered).reshape(dcn_sizes + ici_sizes)
     return Mesh(arr, dcn_names + ici_names)
 
 
-def _order_devices_by_slice(devices, per_slice: int, want_slices: int):
+def _order_devices_by_slice(devices, per_slice: int):
     """Sort devices slice-major so a reshape puts whole slices on the
     outer (DCN) axes. Slice membership: `slice_index` (multi-slice TPU) >
     `process_index` (one host = one slice) > contiguous groups (CPU test

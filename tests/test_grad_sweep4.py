@@ -1,0 +1,216 @@
+"""Numeric-gradient sweep 4: the differentiable ops (and zero-gradient
+contracts) that no earlier suite checked numerically — sequence ops over
+the padded+SeqLens LoD redesign, indexed/ROI pooling, conv-transpose
+variants, the fusion ops' independent formulations, trig/power
+elementwise, and the round/floor/ceil/sign zero-grad contract.
+Reference pattern: unittests/op_test.py:414 check_grad (the ~300-op
+numeric backbone, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad
+
+
+def _r(*shape, seed=0, lo=0.0, hi=1.0):
+    rng = np.random.RandomState(seed)
+    return (lo + (hi - lo) * rng.rand(*shape)).astype(np.float32)
+
+
+def _lens(*vals):
+    return np.asarray(vals, dtype=np.int64)
+
+
+# -- elementwise / unary ----------------------------------------------------
+
+@pytest.mark.parametrize("op,attrs,lo,hi", [
+    ("sin", {}, -2.0, 2.0),
+    ("cos", {}, -2.0, 2.0),
+    ("rsqrt", {}, 0.5, 1.5),
+    ("pow", {"factor": 2.5}, 0.1, 1.1),
+    ("elu", {"alpha": 1.0}, 0.05, 1.0),        # positive branch
+    ("elu", {"alpha": 0.5}, -1.0, -0.05),      # negative branch
+])
+def test_unary_numeric(op, attrs, lo, hi):
+    x = _r(3, 4, seed=1, lo=lo, hi=hi)
+    check_grad(op, {"X": {"x": x}}, attrs=attrs)
+
+
+def test_log_softmax_numeric():
+    # small gradients + fp32 loss: widen the probe so central-difference
+    # noise stays below tolerance
+    x = _r(3, 4, seed=1, lo=-1.0, hi=1.0)
+    check_grad("log_softmax", {"X": {"x": x}}, delta=5e-3, atol=5e-4)
+
+
+def test_clip_boundary_branches():
+    """Interior passes gradient 1, clipped region 0; sample points nudged
+    off the kinks so the central difference stays one-sided."""
+    x = _r(4, 5, seed=2)                      # (0, 1)
+    for b in (0.4, 0.6):
+        x = np.where(np.abs(x - b) < 5e-3, x + 0.02, x)
+    check_grad("clip", {"X": {"x": x.astype(np.float32)}},
+               attrs={"min": 0.4, "max": 0.6})
+
+
+@pytest.mark.parametrize("op", ["sign", "round", "floor", "ceil"])
+def test_zero_grad_contract(op):
+    """Step functions: analytic gradient must be exactly zero away from
+    the jumps (x in (0.25, 0.45): no jump within the probe delta)."""
+    x = _r(3, 4, seed=3, lo=0.25, hi=0.45)
+    check_grad(op, {"X": {"x": x}}, atol=1e-12)
+
+
+def test_sum_multi_input():
+    check_grad("sum", {"X": {"a": _r(2, 3, seed=4),
+                             "b": _r(2, 3, seed=5),
+                             "c": _r(2, 3, seed=6)}})
+
+
+def test_squeeze_v1():
+    check_grad("squeeze", {"X": {"x": _r(2, 1, 3, seed=7)}},
+               attrs={"axes": [1]})
+
+
+def test_flatten2():
+    check_grad("flatten2", {"X": {"x": _r(2, 3, 4, seed=8)}},
+               attrs={"axis": 1}, extra_out_slots=("XShape",))
+
+
+# -- sequence ops (padded [B,T,...] + SeqLens LoD redesign) -----------------
+
+def test_sequence_concat():
+    check_grad("sequence_concat",
+               {"X": {"x1": _r(2, 4, 3, seed=10), "x2": _r(2, 3, 3, seed=11)},
+                "SeqLens": {"l1": _lens(3, 4), "l2": _lens(2, 3)}},
+               extra_out_slots=("NewLens",))
+
+
+def test_sequence_reverse():
+    check_grad("sequence_reverse",
+               {"X": {"x": _r(2, 4, 3, seed=12)},
+                "SeqLens": {"l": _lens(3, 4)}})
+
+
+def test_sequence_slice():
+    check_grad("sequence_slice",
+               {"X": {"x": _r(2, 4, 3, seed=13)},
+                "Offset": {"off": _lens(0, 1)},
+                "Length": {"length": _lens(2, 2)},
+                "SeqLens": {"l": _lens(3, 4)}},
+               extra_out_slots=("NewLens",))
+
+
+def test_sequence_unpad():
+    check_grad("sequence_unpad",
+               {"X": {"x": _r(2, 4, 3, seed=14)},
+                "Length": {"length": _lens(3, 4)}},
+               extra_out_slots=("Length",))
+
+
+def test_sequence_reshape():
+    check_grad("sequence_reshape",
+               {"X": {"x": _r(2, 4, 6, seed=15)},
+                "SeqLens": {"l": _lens(2, 4)}},
+               attrs={"new_dim": 3}, extra_out_slots=("NewLens",))
+
+
+def test_sequence_scatter():
+    check_grad("sequence_scatter",
+               {"X": {"x": _r(2, 6, seed=16)},
+                "Ids": {"ids": np.asarray([[0, 2, 4], [1, 3, 5]], np.int64)},
+                "Updates": {"upd": _r(2, 3, seed=17)},
+                "SeqLens": {"l": _lens(2, 3)}})
+
+
+def test_lod_reset():
+    check_grad("lod_reset",
+               {"X": {"x": _r(2, 4, 3, seed=18)},
+                "Y": {"y": _lens(2, 4)}})
+
+
+# -- indexed / ROI pooling --------------------------------------------------
+
+def test_max_pool2d_with_index():
+    check_grad("max_pool2d_with_index", {"X": {"x": _r(1, 2, 4, 4, seed=20)}},
+               attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+               extra_out_slots=("Mask",))
+
+
+def test_max_pool3d_with_index():
+    check_grad("max_pool3d_with_index",
+               {"X": {"x": _r(1, 2, 4, 4, 4, seed=21)}},
+               attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0]},
+               extra_out_slots=("Mask",))
+
+
+def test_roi_pool():
+    check_grad("roi_pool",
+               {"X": {"x": _r(1, 2, 6, 6, seed=22)},
+                "ROIs": {"rois": np.asarray([[0.0, 0.0, 4.0, 4.0]],
+                                            np.float32)},
+                "RoisBatchId": {"bidx": _lens(0)}},
+               attrs={"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0},
+               grad_vars=["x"], extra_out_slots=("Argmax",))
+
+
+def test_psroi_pool():
+    check_grad("psroi_pool",
+               {"X": {"x": _r(1, 8, 6, 6, seed=23)},
+                "ROIs": {"rois": np.asarray([[0.0, 0.0, 4.0, 4.0]],
+                                            np.float32)},
+                "RoisBatchId": {"bidx": _lens(0)}},
+               attrs={"output_channels": 2, "pooled_height": 2,
+                      "pooled_width": 2, "spatial_scale": 1.0},
+               grad_vars=["x"])
+
+
+# -- conv variants / spatial ------------------------------------------------
+
+def test_depthwise_conv2d_transpose():
+    check_grad("depthwise_conv2d_transpose",
+               {"Input": {"x": _r(1, 3, 4, 4, seed=24)},
+                "Filter": {"w": _r(3, 1, 3, 3, seed=25)}},
+               attrs={"strides": [2, 2], "paddings": [0, 0], "groups": 3},
+               out_slot="Output")
+
+
+def test_affine_grid():
+    check_grad("affine_grid", {"Theta": {"theta": _r(1, 2, 3, seed=26)}},
+               attrs={"output_shape": [1, 1, 4, 4]})
+
+
+# -- fusion ops (independent single-op formulations) ------------------------
+
+@pytest.mark.parametrize("functors", [
+    ["elementwise_add", "relu"],       # binary then unary
+    ["relu", "elementwise_add"],       # unary-of-Y then binary
+])
+def test_fused_elemwise_activation(functors):
+    check_grad("fused_elemwise_activation",
+               {"X": {"x": _r(3, 4, seed=27, lo=0.05, hi=1.0)},
+                "Y": {"y": _r(3, 4, seed=28, lo=0.05, hi=1.0)}},
+               attrs={"functor_list": functors},
+               extra_out_slots=("IntermediateOut",))
+
+
+def test_fusion_seqpool_concat():
+    check_grad("fusion_seqpool_concat",
+               {"X": {"x1": _r(2, 4, 3, seed=29), "x2": _r(2, 4, 3, seed=30)},
+                "SeqLens": {"l": _lens(3, 4)}},
+               attrs={"pooltype": "SUM"})
+
+
+def test_fusion_transpose_flatten_concat():
+    check_grad("fusion_transpose_flatten_concat",
+               {"X": {"x1": _r(2, 3, 4, seed=31), "x2": _r(2, 3, 4, seed=32)}},
+               attrs={"trans_axis": [0, 2, 1], "flatten_axis": 1})
+
+
+def test_fusion_seqexpand_concat_fc():
+    check_grad("fusion_seqexpand_concat_fc",
+               {"X": {"x1": _r(2, 4, 3, seed=33), "x2": _r(2, 3, seed=34)},
+                "FCWeight": {"w": _r(6, 5, seed=35)},
+                "SeqLens": {"l": _lens(3, 4)}})

@@ -44,7 +44,7 @@ def test_slice_index_grouping_wins_over_listing_order():
     """Devices arriving interleaved across slices are regrouped so each
     slice is contiguous (slice_index attribute, multi-slice TPU)."""
     devs = [_FakeDev(i, slice_index=i % 2) for i in range(8)]
-    ordered = _order_devices_by_slice(devs, per_slice=4, want_slices=2)
+    ordered = _order_devices_by_slice(devs, per_slice=4)
     assert [d.slice_index for d in ordered] == [0] * 4 + [1] * 4
 
 
@@ -52,7 +52,7 @@ def test_process_index_fallback_groups_hosts():
     """Without slice_index, one host = one slice (the multi-host DCN
     case, jax.distributed)."""
     devs = [_FakeDev(i, process_index=i // 2) for i in range(8)]
-    ordered = _order_devices_by_slice(devs, per_slice=2, want_slices=4)
+    ordered = _order_devices_by_slice(devs, per_slice=2)
     assert [d.process_index for d in ordered] == [0, 0, 1, 1, 2, 2, 3, 3]
 
 
@@ -61,21 +61,21 @@ def test_ici_straddling_slices_rejected():
     silently route per-layer collectives over DCN."""
     devs = [_FakeDev(i, slice_index=i // 4) for i in range(8)]
     with pytest.raises(ValueError, match="straddle"):
-        _order_devices_by_slice(devs, per_slice=8, want_slices=1)
+        _order_devices_by_slice(devs, per_slice=8)
 
 
 def test_slice_may_hold_several_dcn_blocks():
     """One physical slice splitting into two DCN blocks is fine — ICI
     blocks stay within the slice."""
     devs = [_FakeDev(i, slice_index=i // 4) for i in range(8)]
-    ordered = _order_devices_by_slice(devs, per_slice=2, want_slices=4)
+    ordered = _order_devices_by_slice(devs, per_slice=2)
     assert [d.slice_index for d in ordered] == [0] * 4 + [1] * 4
 
 
 def test_uneven_slices_rejected():
     devs = [_FakeDev(i, slice_index=0 if i < 3 else 1) for i in range(8)]
     with pytest.raises(ValueError, match="uneven"):
-        _order_devices_by_slice(devs, per_slice=4, want_slices=2)
+        _order_devices_by_slice(devs, per_slice=4)
 
 
 def test_device_count_mismatch_rejected():

@@ -366,21 +366,21 @@ spec("max_pool3d_with_index", {"X": [f(1, 2, 4, 4, 4)]},
      {"ksize": [2, 2, 2], "strides": [2, 2, 2], "paddings": [0, 0, 0]})
 spec("psroi_pool", {"X": [f(1, 8, 6, 6)],
                     "ROIs": [jnp.asarray([[0.0, 0.0, 4.0, 4.0]])],
-                    "RoisBatchIdx": [lens(0)]},
+                    "RoisBatchId": [lens(0)]},
      {"output_channels": 2, "pooled_height": 2, "pooled_width": 2,
       "spatial_scale": 1.0})
 spec("roi_align", {"X": [f(1, 2, 6, 6)],
                    "ROIs": [jnp.asarray([[0.0, 0.0, 4.0, 4.0]])],
-                   "RoisBatchIdx": [lens(0)]},
+                   "RoisBatchId": [lens(0)]},
      {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0})
 spec("roi_pool", {"X": [f(1, 2, 6, 6)],
                   "ROIs": [jnp.asarray([[0.0, 0.0, 4.0, 4.0]])],
-                  "RoisBatchIdx": [lens(0)]},
+                  "RoisBatchId": [lens(0)]},
      {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0})
 spec("roi_perspective_transform",
      {"X": [f(1, 2, 6, 6)],
       "ROIs": [jnp.asarray([[0.0, 0.0, 4.0, 0.0, 4.0, 4.0, 0.0, 4.0]])],
-      "RoisBatchIdx": [lens(0)]},
+      "RoisBatchId": [lens(0)]},
      {"transformed_height": 2, "transformed_width": 2,
       "spatial_scale": 1.0})
 spec("spp", {"X": [f(1, 2, 6, 6)]}, {"pyramid_height": 2})
