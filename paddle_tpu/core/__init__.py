@@ -8,8 +8,8 @@ caches (executor.py) against a Scope of PJRT-backed arrays (scope.py).
 
 from paddle_tpu.core.ir import BlockDesc, OpDesc, ProgramDesc, VarDesc, VarType
 from paddle_tpu.core.scope import Scope, global_scope
-from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor, Place,
-                                      TPUPlace)
+from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, EOFException,
+                                      Executor, Place, TPUPlace)
 from paddle_tpu.core.registry import OPS, register_op
 
 __all__ = [

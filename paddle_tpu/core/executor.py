@@ -46,6 +46,13 @@ class CUDAPlace(Place):  # accepted for API parity; maps to default backend
         self.device_id = device_id
 
 
+class EOFException(Exception):
+    """Raised by exe.run when an attached py_reader's epoch is exhausted
+    (reference: fluid.core.EOFException from the reader ops' blocking
+    queue — operators/reader/blocking_queue.h). Catch it, call
+    reader.reset(), and continue to the next epoch."""
+
+
 def _resolve_device(place: Optional[Place]):
     devs = jax.devices()
     if isinstance(place, CPUPlace):
@@ -126,6 +133,34 @@ class Executor:
             program = fw.default_main_program()
         scope = scope or global_scope()
         fetch_list = fetch_list or []
+
+        # attached py_readers supply the feed when none is given (the
+        # reference's in-graph reader ops pulling their blocking queue;
+        # raises EOFException at epoch end — fluid/layers/io.py PyReader)
+        readers = getattr(program, "_py_readers", None)
+        if not feed and readers:
+            started = [r for r in readers if r._queue is not None]
+            if started:
+                if iterations > 1:
+                    # one fresh batch per scanned step; a short epoch
+                    # tail shrinks the window (EOF only when empty)
+                    feeds, eof = [], None
+                    for _ in range(iterations):
+                        try:
+                            f = {}
+                            for r in started:
+                                f.update(r._next_feed())
+                            feeds.append(f)
+                        except EOFException as e:
+                            eof = e
+                            break
+                    if not feeds:
+                        raise eof
+                    feed, iterations = feeds, len(feeds)
+                else:
+                    feed = {}
+                    for r in started:
+                        feed.update(r._next_feed())
 
         # BuildStrategy IR passes run once, right before compilation —
         # the reference's BuildStrategy::Apply moment (CompiledProgram

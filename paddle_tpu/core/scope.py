@@ -62,3 +62,10 @@ def global_scope() -> Scope:
 def _reset_global_scope_for_tests() -> None:
     global _global_scope
     _global_scope = Scope()
+
+
+def _switch_scope(scope: Scope) -> Scope:
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old

@@ -1,0 +1,141 @@
+"""Image preprocessing utilities (reference: python/paddle/dataset/
+image.py — load/resize/crop/flip/transform helpers the vision datasets
+and benchmarks compose). The reference decodes with cv2; this build uses
+PIL + numpy (cv2 is not in the TPU image), keeping the same function
+contracts: HWC uint8 in, CHW float32 out of simple_transform.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+__all__ = [
+    "batch_images_from_tar", "load_image_bytes", "load_image",
+    "resize_short", "to_chw", "center_crop", "random_crop",
+    "left_right_flip", "simple_transform", "load_and_transform",
+]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """reference: image.py:141 — decode an encoded image buffer to an
+    HWC uint8 array (HW for grayscale)."""
+    img = _pil().open(io.BytesIO(bytes_))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    """reference: image.py:167."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """reference: image.py:197 — resize so the SHORT side == size."""
+    h, w = im.shape[:2]
+    if h <= w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    img = _pil().fromarray(im)
+    return np.asarray(img.resize((nw, nh), _pil().BILINEAR))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """reference: image.py:225."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """reference: image.py:249."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    """reference: image.py:277."""
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    """reference: image.py:305."""
+    return im[:, ::-1] if im.ndim >= 2 else im
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """reference: image.py:327 — resize-short, crop (random+flip when
+    training, center otherwise), HWC→CHW, subtract mean."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """reference: image.py:383."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """reference: image.py:80 — pre-decode a tar of images into pickled
+    batch files next to the archive; returns the meta-file path."""
+    import os
+    import pickle
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    meta = os.path.join(out_path, "batch_meta")
+    if os.path.exists(meta):
+        return meta
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id, names = [], [], 0, []
+    with tarfile.open(data_file) as tf:
+        for m in tf.getmembers():
+            if m.name not in img2label:
+                continue
+            data.append(tf.extractfile(m).read())
+            labels.append(img2label[m.name])
+            if len(data) == num_per_batch:
+                name = os.path.join(out_path, f"batch_{file_id}")
+                with open(name, "wb") as f:
+                    pickle.dump({"data": data, "label": labels}, f,
+                                protocol=4)
+                names.append(name)
+                data, labels, file_id = [], [], file_id + 1
+    if data:
+        name = os.path.join(out_path, f"batch_{file_id}")
+        with open(name, "wb") as f:
+            pickle.dump({"data": data, "label": labels}, f, protocol=4)
+        names.append(name)
+    with open(meta, "w") as f:
+        f.write("\n".join(names))
+    return meta

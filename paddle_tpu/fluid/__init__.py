@@ -3,9 +3,10 @@ reference's `paddle.fluid` (python/paddle/fluid/__init__.py) so a reference
 user finds the same entry points: Executor, Program/program_guard, layers,
 optimizer, initializer, ParamAttr, nets, backward, io, metrics, profiler."""
 
-from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, Executor,
-                                      TPUPlace)
+from paddle_tpu.core.executor import (CPUPlace, CUDAPlace, EOFException,
+                                      Executor, TPUPlace)
 from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu import core  # fluid.core.EOFException, reference spelling
 from paddle_tpu.fluid import backward, clip, initializer, layers, nets
 from paddle_tpu.fluid import optimizer, param_attr, regularizer, unique_name
 from paddle_tpu.fluid import (io, learning_rate_scheduler, metrics,
@@ -16,7 +17,9 @@ from paddle_tpu.fluid.data_feeder import DataFeeder
 from paddle_tpu.fluid.framework import (Program, default_main_program,
                                         default_startup_program,
                                         program_guard)
-from paddle_tpu.fluid.param_attr import ParamAttr
+from paddle_tpu.fluid.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu.fluid.lod_tensor import (LoDTensor, create_lod_tensor,
+                                         create_random_int_lodtensor)
 from paddle_tpu.fluid.compiler import (BuildStrategy, CompiledProgram,
                                        ExecutionStrategy)
 from paddle_tpu.fluid.parallel_executor import ParallelExecutor
@@ -41,3 +44,24 @@ __all__ = [
 ]
 
 from paddle_tpu.fluid import debugger  # noqa: F401,E402
+
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    """reference: executor.py scope_guard — run exe.run against `scope`
+    as the global scope."""
+    from paddle_tpu.core.scope import _switch_scope
+    old = _switch_scope(scope)
+    try:
+        yield
+    finally:
+        _switch_scope(old)
+
+
+from paddle_tpu.fluid.framework import name_scope  # noqa: F401,E402
+
+__all__ += ["scope_guard", "name_scope", "WeightNormParamAttr",
+            "LoDTensor", "create_lod_tensor",
+            "create_random_int_lodtensor", "EOFException"]

@@ -372,3 +372,22 @@ def convert_dtype(dtype) -> str:
     if isinstance(dtype, str):
         return dtype
     return np.dtype(dtype).name
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference: framework.py:107 — nests a name prefix for ops created
+    inside (debug/visualization aid; here it prefixes unique_name keys).
+    The per-key COUNTERS are shared with the enclosing generator, so two
+    same-prefix scopes still produce unique names (a scope annotates,
+    it never resets uniqueness)."""
+    from paddle_tpu.fluid import unique_name as un
+    token = f"{prefix or ''}/"
+    old = un._generator
+    scoped = un.NameGenerator(getattr(old, "prefix", "") + token)
+    scoped.ids = old.ids               # shared counters
+    un._generator = scoped
+    try:
+        yield
+    finally:
+        un._generator = old

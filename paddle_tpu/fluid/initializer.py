@@ -134,3 +134,55 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+class BilinearInitializer(Initializer):
+    """reference: initializer.py BilinearInitializer — bilinear-upsampling
+    kernel for conv_transpose weights [C_in, C_out, kH, kW] (each spatial
+    map is the separable triangle filter)."""
+
+    def __call__(self, var, block):
+        import numpy as np
+        shape = list(var.shape)
+        if len(shape) != 4:
+            raise ValueError("BilinearInitializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        f = np.zeros((kh, kw), dtype=np.float32)
+        fh = np.ceil(kh / 2.0)
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        fw = np.ceil(kw / 2.0)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        for i in range(kh):
+            for j in range(kw):
+                f[i, j] = (1 - abs(i / fh - ch)) * (1 - abs(j / fw - cw))
+        weight = np.broadcast_to(f, shape).astype(np.float32)
+        return NumpyArrayInitializer(weight)(var, block)
+
+
+Bilinear = BilinearInitializer
+
+
+_force_init_on_cpu = False
+
+
+def force_init_on_cpu():
+    """reference: initializer.py force_init_on_cpu flag. On TPU the
+    startup program already runs host-side before transfer, so the flag
+    is observed but changes nothing."""
+    return _force_init_on_cpu
+
+
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu context manager."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        global _force_init_on_cpu
+        prev = _force_init_on_cpu
+        _force_init_on_cpu = True
+        try:
+            yield
+        finally:
+            _force_init_on_cpu = prev
+    return cm()

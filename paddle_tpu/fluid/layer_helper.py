@@ -63,7 +63,46 @@ class LayerHelper:
             sp = startup_block.create_var(
                 name=attr.name, shape=shape, dtype=dtype, persistable=True)
             init(sp, startup_block)
+        from paddle_tpu.fluid.param_attr import WeightNormParamAttr
+        if isinstance(attr, WeightNormParamAttr):
+            return self._weight_norm_reparam(param, attr.dim, dtype)
         return param
+
+    def _weight_norm_reparam(self, v, dim, dtype):
+        """Weight normalization (reference: param_attr.py
+        WeightNormParamAttr + layer_helpers appending the reparam):
+        w = g * v / ||v||, norm over every axis except `dim`. `v` is the
+        direction parameter just created; `g` is a fresh magnitude
+        parameter initialized to 1; the returned Variable is the
+        reparameterized weight the layer consumes."""
+        from paddle_tpu.fluid.initializer import ConstantInitializer
+        shape = list(v.shape)
+        g_shape = [1] * len(shape)
+        if dim is not None:
+            g_shape[dim] = shape[dim]
+        g = self.create_parameter(
+            ParamAttr(name=v.name + ".wn_g",
+                      initializer=ConstantInitializer(1.0)),
+            shape=g_shape, dtype=dtype)
+        reduce_dims = [i for i in range(len(shape)) if i != dim] \
+            if dim is not None else list(range(len(shape)))
+        sq = self.create_variable_for_type_inference(dtype)
+        self.append_op("square", inputs={"X": [v]}, outputs={"Out": [sq]})
+        ssum = self.create_variable_for_type_inference(dtype)
+        self.append_op("reduce_sum", inputs={"X": [sq]},
+                       outputs={"Out": [ssum]},
+                       attrs={"dim": reduce_dims, "keep_dim": True})
+        norm = self.create_variable_for_type_inference(dtype)
+        self.append_op("sqrt", inputs={"X": [ssum]},
+                       outputs={"Out": [norm]})
+        unit = self.create_variable_for_type_inference(dtype)
+        self.append_op("elementwise_div", inputs={"X": [v], "Y": [norm]},
+                       outputs={"Out": [unit]})
+        w = self.create_variable_for_type_inference(dtype)
+        self.append_op("elementwise_mul", inputs={"X": [unit], "Y": [g]},
+                       outputs={"Out": [w]})
+        w.desc.shape = shape
+        return w
 
     # -- temporaries -------------------------------------------------------
     def create_variable_for_type_inference(self, dtype="float32") -> framework.Variable:

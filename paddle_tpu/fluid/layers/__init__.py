@@ -48,3 +48,31 @@ from paddle_tpu.fluid.layers.detection import (  # noqa: F401
     mine_hard_examples, multi_box_head, multiclass_nms,
     polygon_box_transform, prior_box,
     rpn_target_assign, ssd_loss, target_assign, yolov3_loss)
+
+# round-3 API-surface completion: every public name the reference exports
+# from fluid.layers resolves (tests/test_layers_api_parity.py)
+from paddle_tpu.fluid.layers.nn import (  # noqa: F401
+    adaptive_pool2d, adaptive_pool3d, autoincreased_step_counter,
+    clip_by_norm, conv3d, conv3d_transpose, data_norm, dice_loss,
+    gaussian_random, gaussian_random_batch_size_like,
+    get_tensor_from_selected_rows, group_norm, hash, im2sequence,
+    image_resize_short, lod_reset, logical_and, logical_not, logical_or,
+    logical_xor, lrn, lstm, mean_iou, merge_selected_rows, pad, pool3d,
+    prelu, psroi_pool, py_func, roi_perspective_transform, scatter,
+    smooth_l1, soft_relu, sum, teacher_student_sigmoid_loss,
+    uniform_random_batch_size_like)
+from paddle_tpu.fluid.layers.tensor import (  # noqa: F401
+    create_global_var, create_parameter, create_tensor, has_inf, has_nan,
+    is_empty, isfinite, load, reverse)
+from paddle_tpu.fluid.layers.sequence import sequence_scatter  # noqa: F401
+from paddle_tpu.fluid.layers.control_flow import (  # noqa: F401
+    Print, reorder_lod_tensor_by_rank, tensor_array_to_tensor)
+from paddle_tpu.fluid.layers.detection import (  # noqa: F401
+    generate_proposal_labels)
+from paddle_tpu.fluid.layers.rnn import dynamic_lstmp  # noqa: F401
+from paddle_tpu.fluid.layers.io import (  # noqa: F401
+    Preprocessor, PyReader, batch, create_py_reader_by_data, double_buffer,
+    open_files, py_reader, random_data_generator, read_file, shuffle)
+from paddle_tpu.fluid.learning_rate_scheduler import (  # noqa: F401
+    append_LARS, exponential_decay, inverse_time_decay, natural_exp_decay,
+    noam_decay, piecewise_decay, polynomial_decay)

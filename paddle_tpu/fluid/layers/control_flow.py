@@ -643,3 +643,43 @@ def _block_guard(program: framework.Program, block_idx: int):
         yield
     finally:
         program._current_block_idx = old
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference: control_flow.py Print → print_op.cc (identity + host
+    print via jax.debug.print)."""
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or ""})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference: control_flow.py reorder_lod_tensor_by_rank →
+    reorder_lod_tensor_by_rank_op.cc."""
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """reference: tensor.py tensor_array_to_tensor (concat an array)."""
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    idx = helper.create_variable_for_type_inference("int32")
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    helper.append_op("tensor_array_to_tensor", inputs={"X": list(xs)},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": axis})
+    return out, idx

@@ -409,3 +409,32 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     box_vars = L.concat(vars_all, axis=0)
     return mbox_locs, mbox_confs, prior_boxes, box_vars
 
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd=None,
+                             gt_boxes=None, im_info=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, bbox_reg_weights=(0.1, 0.1,
+                                                                 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """reference: layers/detection.py generate_proposal_labels →
+    detection/generate_proposal_labels_op.cc. Batched dense [B, R, 4]
+    rois with sampled-mask outputs replace the reference's LoD lists."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = _out(helper)
+    labels = _out(helper, "int32")
+    targets = _out(helper)
+    inw = _out(helper)
+    outw = _out(helper)
+    helper.append_op(
+        "generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "GtBoxes": [gt_boxes]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [targets], "BboxInsideWeights": [inw],
+                 "BboxOutsideWeights": [outw]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo})
+    return rois, labels, targets, inw, outw

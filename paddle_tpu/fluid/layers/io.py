@@ -22,3 +22,297 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
         return block.var(name)
     return block.create_var(name=name, shape=shape, dtype=dtype,
                             lod_level=lod_level, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# In-graph reader surface (reference: layers/io.py py_reader :485,
+# double_buffer, open_files, shuffle, batch, read_file, Preprocessor,
+# random_data_generator). The reference implements these as reader OPS
+# with C++ blocking queues (operators/reader/); on TPU the queue is a
+# host-side prefetch thread and the executor pulls the next batch when
+# run() is called with no feed — same user protocol, including
+# EOFException/reset() at epoch end.
+# ---------------------------------------------------------------------------
+
+
+class _ReaderError:
+    """Wrapper pushed by the fill thread when the user's provider raises:
+    the trainer's next run() re-raises the original error instead of
+    seeing a clean (and silently truncated) epoch end."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class PyReader:
+    """The host-side successor of create_py_reader_op + blocking_queue
+    (reference: operators/reader/create_py_reader_op.cc,
+    reader/blocking_queue.h). decorate_paddle_reader/start/reset follow
+    the reference protocol: run the program with NO feed and catch
+    fluid.core.EOFException at epoch end."""
+
+    def __init__(self, var_names, program, capacity=64):
+        import queue as _q
+        self.var_names = list(var_names)
+        self.capacity = int(capacity)
+        self._provider = None
+        self._decorators = []      # shuffle/batch wrap at start() time
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        self._exhausted = False    # sentinel seen; EOF until reset()
+        self._program = program
+        readers = getattr(program, "_py_readers", None)
+        if readers is None:
+            readers = program._py_readers = []
+        readers.append(self)
+
+    # -- providers ---------------------------------------------------------
+
+    def decorate_paddle_reader(self, reader_creator):
+        """reader yields per-batch LISTS of sample tuples (the
+        paddle.batch convention) or ready tuples of arrays."""
+        self._provider = reader_creator
+
+    decorate_tensor_provider = decorate_paddle_reader
+
+    def _to_feed(self, item):
+        import numpy as np
+        if isinstance(item, dict):
+            return {n: item[n] for n in self.var_names}
+        if isinstance(item, (list, tuple)) and item and \
+                isinstance(item[0], (list, tuple)):
+            # list of sample tuples -> stack per slot
+            cols = list(zip(*item))
+            arrs = [np.stack([np.asarray(v) for v in col]) for col in cols]
+        else:
+            arrs = [np.asarray(v) for v in item]
+        return dict(zip(self.var_names, arrs))
+
+    # -- the blocking-queue lifecycle -------------------------------------
+
+    def start(self):
+        import queue
+        import threading
+        if self._provider is None:
+            raise RuntimeError("py_reader: call decorate_paddle_reader "
+                               "before start()")
+        provider = self._provider
+        for deco in self._decorators:
+            provider = deco(provider)
+        # bind THIS epoch's queue/stop as locals: a mid-epoch
+        # reset()+start() must not let the old fill thread push stale
+        # batches or its end-sentinel into the new epoch's queue
+        q = self._queue = queue.Queue(self.capacity)
+        stop = self._stop = threading.Event()
+        self._exhausted = False
+
+        def fill():
+            try:
+                for item in provider():
+                    if stop.is_set():
+                        return
+                    q.put(self._to_feed(item))
+                q.put(None)                  # clean epoch-end sentinel
+            except BaseException as e:       # propagate, don't fake EOF
+                q.put(_ReaderError(e))
+
+        self._thread = threading.Thread(target=fill, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except Exception:
+                pass
+        self._queue = None
+        self._thread = None
+        self._exhausted = False
+
+    def _next_feed(self):
+        from paddle_tpu.core.executor import EOFException
+        if self._queue is None:
+            raise RuntimeError("py_reader: start() not called (or reset)")
+        if self._exhausted:
+            # the sentinel was already consumed (e.g. by a multi-step
+            # window's partial tail) — keep raising, never block
+            raise EOFException("py_reader: epoch exhausted — call reset()")
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            raise EOFException("py_reader: epoch exhausted — call reset()")
+        if isinstance(item, _ReaderError):
+            self._exhausted = True
+            raise RuntimeError(
+                "py_reader: the data provider raised") from item.exc
+        return item
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference: layers/io.py:485. Returns a PyReader; get the data vars
+    with read_file(reader)."""
+    from paddle_tpu.fluid import unique_name
+    base = name or unique_name.generate("py_reader")
+    names = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        v = data(f"{base}_slot{i}", shape=list(shape)[1:], dtype=dtype,
+                 append_batch_size=True)
+        names.append(v.name)
+    helper = LayerHelper("py_reader")
+    reader = PyReader(names, helper.main_program, capacity=capacity)
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference: layers/io.py create_py_reader_by_data — py_reader bound
+    to existing data vars."""
+    helper = LayerHelper("py_reader")
+    return PyReader([v.name for v in feed_list], helper.main_program,
+                    capacity=capacity)
+
+
+def read_file(reader):
+    """reference: layers/io.py read_file — the reader's data variables."""
+    block = framework.default_main_program().global_block()
+    outs = [block.var(n) for n in reader.var_names]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference: layers/io.py double_buffer. Prefetch is inherent here
+    (the PyReader fill thread + data/pipeline device double-buffering),
+    so this is the identity on PyReader — kept for API parity."""
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """reference: layers/io.py shuffle → shuffle_reader. Registers the
+    host-side shuffle decorator; applied to whatever provider is set
+    (by either decorate_* spelling) when start() runs."""
+    def deco(provider):
+        from paddle_tpu.reader.decorator import shuffle as _shuffle
+        return _shuffle(provider, buffer_size)
+
+    reader._decorators.append(deco)
+    return reader
+
+
+def batch(reader, batch_size):
+    """reference: layers/io.py batch → batch_reader (regroup a
+    sample-level provider into batches); applied at start() time."""
+    def deco(provider):
+        from paddle_tpu.reader.decorator import batch as _batch
+        return _batch(provider, batch_size)
+
+    reader._decorators.append(deco)
+    return reader
+
+
+def open_files(filenames, shapes=None, lod_levels=None, dtypes=None,
+               thread_num=1, buffer_size=64, pass_num=1, is_test=None,
+               name=None):
+    """reference: layers/io.py open_files → open_files_op (recordio
+    readers). Files are paddle_tpu recordio archives of pickled feed
+    dicts (recordio.convert_reader_to_recordio_file)."""
+    import pickle
+
+    from paddle_tpu import recordio as _rio
+    from paddle_tpu.fluid import unique_name
+
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    # discover slot names from the first record
+    first_rec = next(iter(_rio.Scanner(filenames[0])))
+    sample = pickle.loads(first_rec)
+    if not isinstance(sample, dict):
+        raise ValueError("open_files expects recordio of pickled feed "
+                         "dicts (see convert_reader_to_recordio_file)")
+    base = name or unique_name.generate("open_files")
+    helper = LayerHelper("open_files")
+    block = helper.main_program.global_block()
+    names = []
+    for key, arr in sample.items():
+        if not block.has_var(key):
+            import numpy as np
+            a = np.asarray(arr)
+            data(key, shape=list(a.shape)[1:], dtype=str(a.dtype),
+                 append_batch_size=True)
+        names.append(key)
+    reader = PyReader(names, helper.main_program, capacity=buffer_size)
+
+    def provider():
+        for _ in range(pass_num):
+            for fn in filenames:
+                for rec in _rio.Scanner(fn):
+                    yield pickle.loads(rec)
+
+    reader.decorate_paddle_reader(lambda: provider())
+    return reader
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """reference: layers/io.py random_data_generator — a reader of
+    uniform random float batches (used by reader tests/benchmarks)."""
+    import numpy as np
+
+    from paddle_tpu.fluid import unique_name
+    base = unique_name.generate("rand_reader")
+    names = []
+    for i, shape in enumerate(shapes):
+        v = data(f"{base}_slot{i}", shape=list(shape)[1:], dtype="float32",
+                 append_batch_size=True)
+        names.append(v.name)
+    helper = LayerHelper("random_data_generator")
+    reader = PyReader(names, helper.main_program, capacity=16)
+
+    def provider():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(rng.uniform(low, high, size=tuple(s)).astype("float32")
+                        for s in shapes)
+
+    reader.decorate_paddle_reader(lambda: provider())
+    return reader
+
+
+class Preprocessor:
+    """reference: layers/io.py Preprocessor — rewires a reader through a
+    preprocessing block. Host-side form: a python callable over each
+    batch, applied in the fill thread."""
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+        self._fn = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            yield self
+        return cm()
+
+    def inputs(self):
+        raise NotImplementedError(
+            "Preprocessor.inputs/outputs (in-graph rewiring) is not "
+            "supported; pass a callable to set_transform instead")
+
+    def set_transform(self, fn):
+        self._fn = fn
+        inner = self.reader._provider
+        if inner is None:
+            raise RuntimeError("decorate the reader before Preprocessor")
+
+        def provider():
+            for item in inner():
+                yield fn(item)
+
+        self.reader._provider = provider
+        return self.reader

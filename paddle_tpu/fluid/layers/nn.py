@@ -99,12 +99,33 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     return helper.append_activation(out, act)
 
 
+def _transpose_filter_size(filter_size, output_size, in_spatial, stride,
+                           padding, dilation, nd):
+    """reference: nn.py conv2d_transpose — when filter_size is omitted,
+    derive it from output_size:
+    f[i] = (out[i] + 2*pad[i] - (in[i]-1)*stride[i] - 1) // dil[i] + 1."""
+    if filter_size is not None:
+        return (list(filter_size) if isinstance(filter_size, (list, tuple))
+                else [filter_size] * nd)
+    if output_size is None:
+        raise ValueError(
+            "conv_transpose: give filter_size or output_size")
+    out = (list(output_size) if isinstance(output_size, (list, tuple))
+           else [output_size] * nd)
+    pad = padding if isinstance(padding, (list, tuple)) else [padding] * nd
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * nd
+    dil = dilation if isinstance(dilation, (list, tuple)) else [dilation] * nd
+    return [(out[i] + 2 * pad[i] - (in_spatial[i] - 1) * st[i] - 1)
+            // dil[i] + 1 for i in range(nd)]
+
+
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      stride=1, padding=0, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, act=None, name=None):
     helper = LayerHelper("conv2d_transpose", name=name)
     num_channels = input.shape[1]
-    fsize = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    fsize = _transpose_filter_size(filter_size, output_size, input.shape[2:],
+                                   stride, padding, dilation, 2)
     filter_shape = [num_channels, num_filters // groups] + list(fsize)
     w = helper.create_parameter(param_attr, shape=filter_shape, dtype=input.dtype)
     out = helper.create_variable_for_type_inference(input.dtype)
@@ -1059,3 +1080,573 @@ def ctc_greedy_decoder(input, blank, name=None):
                      outputs={"Output": [out]},
                      attrs={"blank": blank, "merge_repeated": True})
     return out
+
+
+# ---------------------------------------------------------------------------
+# API-surface completion (round 3): every name the reference exports from
+# fluid.layers resolves here too (machine-checked by
+# tests/test_layers_api_parity.py)
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    """reference: nn.py:1944 — NCDHW conv."""
+    helper = LayerHelper("conv3d", name=name)
+    num_channels = input.shape[1]
+    fsize = _triple(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + fsize
+    std = (2.0 / (fsize[0] * fsize[1] * fsize[2] * num_channels)) ** 0.5
+    from paddle_tpu.fluid.initializer import NormalInitializer
+    w = helper.create_parameter(param_attr, shape=filter_shape,
+                                dtype=input.dtype,
+                                default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        with_b = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [with_b]}, attrs={"axis": 1})
+        out = with_b
+    return helper.append_activation(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference: nn.py:3405."""
+    helper = LayerHelper("conv3d_transpose", name=name)
+    num_channels = input.shape[1]
+    fsize = _transpose_filter_size(filter_size, output_size, input.shape[2:],
+                                   stride, padding, dilation, 3)
+    w = helper.create_parameter(
+        param_attr, shape=[num_channels, num_filters // groups] + fsize,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+               "dilations": _triple(dilation), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        with_b = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [with_b]}, attrs={"axis": 1})
+        out = with_b
+    return helper.append_activation(out, act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    """reference: nn.py:2453."""
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _triple(pool_size),
+               "strides": _triple(pool_stride),
+               "paddings": _triple(pool_padding),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """reference: nn.py:2526 (floor/ceil bin rule)."""
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool2d(require_index=True): use "
+            "max_pool2d_with_index for the mask")
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("adaptive_pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooled_size": _pair(pool_size),
+                            "pooling_type": pool_type})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """reference: nn.py adaptive_pool3d."""
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool3d(require_index=True) is not supported")
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("adaptive_pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooled_size": _triple(pool_size),
+                            "pooling_type": pool_type})
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """reference: nn.py:3137 → group_norm_op.cc."""
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1]
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    scale = helper.create_parameter(
+        param_attr, shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=input.dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("group_norm",
+                     inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """reference: nn.py data_norm → data_norm_op.cc (batch-statistics
+    normalization without learned scale/shift)."""
+    helper = LayerHelper("data_norm", name=name)
+    c = input.shape[1]
+    import copy
+
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    from paddle_tpu.fluid.param_attr import ParamAttr
+
+    def slot_attr(suffix):
+        # one attr object per slot — create_parameter mutates attr.name,
+        # so sharing one object would alias all three stats into one var
+        a = copy.copy(ParamAttr._to_attr(param_attr))
+        a.initializer = None
+        if a.name is not None:
+            a.name = a.name + suffix
+        return a
+
+    batch_size = helper.create_parameter(
+        slot_attr(".batch_size"), shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    batch_sum = helper.create_parameter(
+        slot_attr(".batch_sum"), shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(0.0))
+    batch_square_sum = helper.create_parameter(
+        slot_attr(".batch_square_sum"), shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1e4))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("data_norm",
+                     inputs={"X": [input], "BatchSize": [batch_size],
+                             "BatchSum": [batch_sum],
+                             "BatchSquareSum": [batch_square_sum]},
+                     outputs={"Y": [out], "Means": [means],
+                              "Scales": [scales]},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """reference: nn.py:6125 → lrn_op.cc."""
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("lrn", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    """reference: nn.py:7758; mode in {'all','channel','element'}."""
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1]
+    elif mode == "element":
+        alpha_shape = list(x.shape[1:])
+    else:
+        raise ValueError("prelu mode must be all|channel|element")
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    alpha = helper.create_parameter(
+        param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """reference: nn.py:7873 — log(1 + exp(clip(x, -t, t))); composed
+    from clip + softplus (exact same math)."""
+    helper = LayerHelper("soft_relu", name=name)
+    clipped = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", inputs={"X": [x]}, outputs={"Out": [clipped]},
+                     attrs={"min": -threshold, "max": threshold})
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("softplus", inputs={"X": [clipped]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """reference: nn.py:5699 → smooth_l1_loss_op.cc."""
+    helper = LayerHelper("smooth_l1")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op("smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": [out], "Diff": [diff]},
+                     attrs={"sigma": 1.0 if sigma is None else sigma})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference: nn.py:6484 — composed from existing ops exactly as the
+    reference composes it in python."""
+    from paddle_tpu.fluid.layers.ops import (elementwise_add,
+                                             elementwise_div,
+                                             elementwise_mul)
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dim)
+    dice_denominator = elementwise_add(
+        reduce_sum(input, dim=reduce_dim),
+        reduce_sum(label, dim=reduce_dim))
+    dice_score = scale(
+        elementwise_div(
+            scale(inse, scale=2.0),
+            scale(dice_denominator, bias=epsilon)),
+        scale=-1.0, bias=1.0)
+    return reduce_mean(dice_score)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """reference: nn.py:5383 → im2sequence_op.cc."""
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(p) == 2:
+        p = [p[0], p[0], p[1], p[1]]
+    helper.append_op("im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pair(filter_size),
+                            "strides": _pair(stride), "paddings": list(p)})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference: nn.py:6751 — resize so the SHORT side equals
+    out_short_len, preserving aspect ratio."""
+    in_shape = input.shape
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[1 - short_idx] = int(
+        round(hw[1 - short_idx] * (out_short_len / hw[short_idx])))
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: nn.py:6029 → lod_reset_op.cc (here: re-binds SeqLens)."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op("lod_reset", inputs=inputs, outputs={"Out": [out]},
+                     attrs={} if target_lod is None
+                           else {"target_lod": list(target_lod)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """reference: nn.py:6195 → pad_op.cc."""
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def scatter(input, index, updates, name=None):
+    """reference: nn.py:6836 → scatter_op.cc."""
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sum(x):
+    """reference: nn.py:8392 → sum_op.cc (elementwise sum of a list)."""
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("sum", inputs={"X": list(xs)}, outputs={"Out": [out]})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """reference: nn.py:7086 → mean_iou_op.cc."""
+    helper = LayerHelper("mean_iou")
+    iou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [iou], "OutWrong": [wrong],
+                              "OutCorrect": [correct]},
+                     attrs={"num_classes": num_classes})
+    return iou, wrong, correct
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """reference: nn.py:8764 → clip_by_norm_op.cc."""
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"max_norm": max_norm})
+    return out
+
+
+def _logical(op, x, y=None, out=None, name=None):
+    helper = LayerHelper(op, name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    inputs = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(op, inputs=inputs, outputs={"Out": [out]})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    """reference: nn.py:8615."""
+    return _logical("logical_and", x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out, name)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out, name)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """reference: nn.py:8259 → gaussian_random_op.cc."""
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random", inputs={}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": mean, "std": std,
+                            "seed": seed, "dtype": dtype})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """reference: nn.py:8208."""
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "min": min, "max": max,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx,
+                            "seed": seed, "dtype": dtype})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """reference: nn.py:8338."""
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": mean, "std": std,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx,
+                            "seed": seed, "dtype": dtype})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: nn.py:9194 → hash_op.cc."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("hash", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"mod_by": hash_size, "num_hash": num_hash})
+    return out
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference: nn.py:489 (the cudnn multi-layer LSTM) → cudnn_lstm op.
+    `input` [T, B, D]; returns (rnn_out, last_h, last_c)."""
+    helper = LayerHelper("lstm", name=name)
+    d_in = input.shape[-1]
+    ndir = 2 if is_bidirec else 1
+    # packed W: per layer, per direction, Wx (Din,4H) | Wh (H,4H) | b (4H)
+    total = 0
+    cur = d_in
+    for _ in range(num_layers):
+        total += ndir * (cur * 4 * hidden_size
+                         + hidden_size * 4 * hidden_size + 4 * hidden_size)
+        cur = hidden_size * ndir
+    w = helper.create_parameter(None, shape=[total], dtype=input.dtype,
+                                default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cudnn_lstm",
+                     inputs={"Input": [input], "InitH": [init_h],
+                             "InitC": [init_c], "W": [w]},
+                     outputs={"Out": [out], "last_h": [last_h],
+                              "last_c": [last_c]},
+                     attrs={"hidden_size": hidden_size,
+                            "num_layers": num_layers,
+                            "is_bidirec": is_bidirec,
+                            "dropout_prob": dropout_prob,
+                            "is_test": is_test, "seed": seed})
+    return out, last_h, last_c
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: nn.py:9395 → teacher_student_sigmoid_loss_op.cc."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_batch_id=None, name=None):
+    """reference: nn.py psroi_pool → psroi_pool_op.cc (batch ids replace
+    the reference's ROI LoD)."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("psroi_pool", inputs=inputs, outputs={"Out": [out]},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch_id=None):
+    """reference: detection/roi_perspective_transform_op.cc."""
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    helper.append_op("roi_perspective_transform", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"transformed_height": transformed_height,
+                            "transformed_width": transformed_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """reference: merge_selected_rows_op.cc (dedup sparse rows)."""
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference: get_tensor_from_selected_rows_op.cc."""
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """reference: nn.py:9653 → py_func_op.cc (host callback; backward_func
+    is accepted for parity — gradients flow through jax.pure_callback's
+    defined vjp only when provided)."""
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    helper.append_op("py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)},
+                     attrs={"func": func,
+                            "out_shapes": [list(o.shape) for o in outs],
+                            "out_dtypes": [o.dtype for o in outs]})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference: nn.py:5780 — a persistable int64 counter incremented
+    once per executed step."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        # reuse: the increment op was appended when the counter was
+        # created — appending another would advance it twice per step
+        # (reference appends the increment only for a fresh counter)
+        return block.var(name)
+    counter = helper.create_global_variable(
+        shape=[1], dtype="int64", name=name, persistable=True)
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    startup_block = helper.startup_program.global_block()
+    if not startup_block.has_var(name):
+        sp = startup_block.create_var(name=name, shape=[1],
+                                      dtype="int64", persistable=True)
+        ConstantInitializer(float(begin - 1))(sp, startup_block)
+    one = helper.create_variable_for_type_inference("int64")
+    helper.append_op("fill_constant", inputs={}, outputs={"Out": [one]},
+                     attrs={"shape": [1], "dtype": "int64",
+                            "value": float(step)})
+    helper.append_op("elementwise_add", inputs={"X": [counter], "Y": [one]},
+                     outputs={"Out": [counter]})
+    counter.stop_gradient = True
+    return counter

@@ -119,3 +119,34 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
     if hidden.shape is not None:
         out.desc.shape = list(hidden.shape)
     return out, None, None
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """reference: nn.py:657 dynamic_lstmp → lstmp_op.cc. `input` is the
+    pre-projected [B, T, 4H] sequence; returns (projection, cell)."""
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    H = size // 4
+    weight = helper.create_parameter(param_attr, shape=[proj_size, 4 * H],
+                                     dtype=dtype)
+    proj_weight = helper.create_parameter(param_attr, shape=[H, proj_size],
+                                          dtype=dtype)
+    bias_size = 7 * H if use_peepholes else 4 * H
+    bias = helper.create_parameter(bias_attr, shape=[1, bias_size],
+                                   dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias]},
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell

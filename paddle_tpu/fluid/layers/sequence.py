@@ -209,3 +209,16 @@ def edit_distance(input, label, normalized=True, input_length=None,
                      outputs={"Out": [out], "SequenceNum": [seq_num]},
                      attrs={"normalized": normalized})
     return out, seq_num
+
+
+def sequence_scatter(input, index, updates, seq_lens=None, name=None):
+    """reference: nn.py sequence_scatter → sequence_scatter_op.cc (padded
+    ids+updates per row with seq_lens replacing the updates LoD)."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if seq_lens is not None:
+        inputs["SeqLens"] = [seq_lens]
+    helper.append_op("sequence_scatter", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out

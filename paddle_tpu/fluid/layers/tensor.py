@@ -99,3 +99,86 @@ def shape(input):
     out = helper.create_variable_for_type_inference("int32")
     helper.append_op("shape", inputs={"Input": [input]}, outputs={"Out": [out]})
     return out
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference: tensor.py:35."""
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_global_variable(shape=[1], dtype=dtype,
+                                         name=name, persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference: tensor.py:59."""
+    from paddle_tpu.fluid.param_attr import ParamAttr
+    helper = LayerHelper("create_parameter")
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape=list(shape), dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: tensor.py:97 — a global var initialized by the startup
+    program."""
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    helper = LayerHelper("global_var")
+    var = helper.create_global_variable(shape=list(shape), dtype=dtype,
+                                        name=name, persistable=persistable)
+    startup_block = helper.startup_program.global_block()
+    if not startup_block.has_var(var.name):
+        sp = startup_block.create_var(name=var.name, shape=list(shape),
+                                      dtype=dtype, persistable=persistable)
+        ConstantInitializer(float(value))(sp, startup_block)
+    return var
+
+
+def reverse(x, axis):
+    """reference: tensor.py:608 → reverse_op.cc."""
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reverse", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": [axis] if isinstance(axis, int)
+                            else list(axis)})
+    return out
+
+
+def _overflow_check(op, x):
+    helper = LayerHelper(op)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(op, inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_inf(x):
+    """reference: tensor.py:714."""
+    return _overflow_check("has_inf", x)
+
+
+def has_nan(x):
+    """reference: tensor.py:730."""
+    return _overflow_check("has_nan", x)
+
+
+def isfinite(x):
+    """reference: tensor.py:746."""
+    return _overflow_check("isfinite", x)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: tensor.py load() → load_op.cc."""
+    helper = LayerHelper("load")
+    helper.append_op("load", inputs={}, outputs={"Out": [out]},
+                     attrs={"file_path": file_path})
+    return out
+
+
+def is_empty(x, cond=None):
+    """reference: control_flow.py is_empty → is_empty_op.cc."""
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", inputs={"X": [x]}, outputs={"Out": [cond]})
+    return cond

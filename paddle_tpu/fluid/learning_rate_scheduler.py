@@ -159,3 +159,40 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
     half = _op(helper, "scale", {"X": [c]},
                {"scale": 0.5, "bias": 0.5})
     return _op(helper, "scale", {"X": [half]}, {"scale": learning_rate})
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """reference: layers/learning_rate_scheduler.py:310 — layer-wise
+    adaptive rate scaling: per-param decayed lr =
+    lr * ||w|| / (||g|| + wd * ||w||), written into the param's
+    optimize_attr; the optimizer's _param_lr feeds that Variable to the
+    update op in place of the global lr (reference _create_param_lr).
+    The LARS-momentum optimizer (ops/optimizer_ops.py lars_momentum) is
+    the fused form."""
+    helper = LayerHelper("lars")
+
+    def _norm(v):
+        sq = _op(helper, "square", {"X": [v]})
+        s = _op(helper, "reduce_sum", {"X": [sq]}, {"reduce_all": True})
+        return _op(helper, "sqrt", {"X": [s]})
+
+    for param, grad in params_grads:
+        param_norm = _norm(param)
+        grad_norm = _norm(grad)
+        if weight_decay == 1.0:
+            denom = _op(helper, "elementwise_add",
+                        {"X": [grad_norm], "Y": [param_norm]})
+        else:
+            scaled = _op(helper, "scale", {"X": [param_norm]},
+                         {"scale": float(weight_decay)})
+            denom = _op(helper, "elementwise_add",
+                        {"X": [grad_norm], "Y": [scaled]})
+        num = _op(helper, "elementwise_mul",
+                  {"X": [learning_rate], "Y": [param_norm]})
+        decayed = _op(helper, "elementwise_div",
+                      {"X": [num], "Y": [denom]})
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        if not (isinstance(param_lr, float) and param_lr == 1.0):
+            decayed = _op(helper, "scale", {"X": [decayed]},
+                          {"scale": float(param_lr)})
+        param.optimize_attr["learning_rate"] = decayed

@@ -1,0 +1,71 @@
+"""LoD tensor construction helpers (reference: python/paddle/fluid/
+lod_tensor.py create_lod_tensor / create_random_int_lodtensor).
+
+The TPU redesign replaces LoD offset tables with the padded [B, T, ...]
++ seq_lens pair (ops/sequence_ops.py header); these helpers build that
+pair from LoD-style inputs so reference recipes port verbatim."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+class LoDTensor:
+    """The (padded data, seq_lens) pair — this IS our LoD. Feed `.data`
+    to the tensor input and `.seq_lens` to the op's SeqLens slot."""
+
+    def __init__(self, data: np.ndarray, seq_lens: np.ndarray):
+        self.data = data
+        self.seq_lens = seq_lens
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        return [list(map(int, self.seq_lens))]
+
+    def shape(self):
+        return self.data.shape
+
+    def __array__(self, dtype=None):
+        return self.data if dtype is None else self.data.astype(dtype)
+
+
+def create_lod_tensor(data, recursive_seq_lens: Sequence[Sequence[int]],
+                      place=None) -> LoDTensor:
+    """reference: lod_tensor.py create_lod_tensor — build from a flat
+    [sum(lens), ...] array (or a list of per-sequence lists) + one level
+    of sequence lengths. Returns the padded-pair form."""
+    lens = list(recursive_seq_lens[-1])
+    if isinstance(data, (list, tuple)):
+        rows = [np.asarray(r) for r in data]
+        flat = np.concatenate([r.reshape(len(r), -1) for r in rows], axis=0)
+        if len(rows) != len(lens) or any(len(r) != l
+                                         for r, l in zip(rows, lens)):
+            # list-of-sequences form: lens come from the rows themselves
+            lens = [len(r) for r in rows]
+            flat = np.concatenate([np.asarray(r).reshape(len(r), -1)
+                                   for r in rows], axis=0)
+    else:
+        flat = np.asarray(data)
+        flat = flat.reshape(flat.shape[0], -1)
+    if sum(lens) != flat.shape[0]:
+        raise ValueError(
+            f"sum(seq_lens)={sum(lens)} != data rows {flat.shape[0]}")
+    b, t = len(lens), max(lens) if lens else 0
+    feat = flat.shape[1:]
+    out = np.zeros((b, t) + feat, dtype=flat.dtype)
+    off = 0
+    for i, l in enumerate(lens):
+        out[i, :l] = flat[off:off + l]
+        off += l
+    return LoDTensor(out, np.asarray(lens, np.int64))
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1) -> LoDTensor:
+    """reference: lod_tensor.py create_random_int_lodtensor."""
+    lens = list(recursive_seq_lens[-1])
+    total = sum(lens)
+    data = np.random.randint(low, high + 1,
+                             size=[total] + list(base_shape)).astype(np.int64)
+    return create_lod_tensor(data, [lens], place)

@@ -31,6 +31,25 @@ class Optimizer:
         self.helper_type = type(self).__name__
 
     # -- learning rate -----------------------------------------------------
+    def _param_lr(self, param):
+        """reference: optimizer.py _create_param_lr — per-param learning
+        rate. append_LARS stores a decayed-lr VARIABLE (which already
+        folds in the global lr) in param.optimize_attr; a float scales
+        the global lr; 1.0 is the global lr unchanged."""
+        plr = getattr(param, "optimize_attr", None)
+        plr = (plr or {}).get("learning_rate", 1.0)
+        if isinstance(plr, framework.Variable):
+            return plr
+        if isinstance(plr, (int, float)) and float(plr) == 1.0:
+            return self._lr_var
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op("scale", inputs={"X": [self._lr_var]},
+                         outputs={"Out": [out]},
+                         attrs={"scale": float(plr)})
+        return out
+
     def _create_lr_var(self):
         if self._lr_var is not None:
             return self._lr_var
@@ -122,7 +141,7 @@ class SGDOptimizer(Optimizer):
         return block.append_op(
             "sgd",
             inputs={"Param": [p], "Grad": [g],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p]})
 
 
@@ -144,7 +163,7 @@ class MomentumOptimizer(Optimizer):
         return block.append_op(
             "momentum",
             inputs={"Param": [p], "Grad": [g], "Velocity": [v],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "VelocityOut": [v]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
 
@@ -169,7 +188,7 @@ class LarsMomentumOptimizer(Optimizer):
         return block.append_op(
             "lars_momentum",
             inputs={"Param": [p], "Grad": [g], "Velocity": [v],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "VelocityOut": [v]},
             attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
                    "lars_weight_decay": self._lars_weight_decay})
@@ -202,7 +221,7 @@ class AdamOptimizer(Optimizer):
             "adam",
             inputs={"Param": [p], "Grad": [g], "Moment1": [m1],
                     "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
                      "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
@@ -232,7 +251,7 @@ class AdamaxOptimizer(Optimizer):
                     "Moment": [self._get_accumulator("moment", p)],
                     "InfNorm": [self._get_accumulator("inf_norm", p)],
                     "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p],
                      "MomentOut": [self._get_accumulator("moment", p)],
                      "InfNormOut": [self._get_accumulator("inf_norm", p)]},
@@ -257,7 +276,7 @@ class AdagradOptimizer(Optimizer):
         return block.append_op(
             "adagrad",
             inputs={"Param": [p], "Grad": [g], "Moment": [mom],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "MomentOut": [mom]},
             attrs={"epsilon": self._epsilon})
 
@@ -280,7 +299,7 @@ class DecayedAdagradOptimizer(Optimizer):
         return block.append_op(
             "decayed_adagrad",
             inputs={"Param": [p], "Grad": [g], "Moment": [mom],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "MomentOut": [mom]},
             attrs={"decay": self._decay, "epsilon": self._epsilon})
 
@@ -332,7 +351,7 @@ class RMSPropOptimizer(Optimizer):
         ms = self._get_accumulator("mean_square", p)
         mom = self._get_accumulator("momentum", p)
         ins = {"Param": [p], "Grad": [g], "MeanSquare": [ms], "Moment": [mom],
-               "LearningRate": [self._lr_var]}
+               "LearningRate": [self._param_lr(p)]}
         outs = {"ParamOut": [p], "MeanSquareOut": [ms], "MomentOut": [mom]}
         if self._centered:
             mg = self._get_accumulator("mean_grad", p)
@@ -364,7 +383,7 @@ class FtrlOptimizer(Optimizer):
             "ftrl",
             inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
                     "LinearAccumulator": [lin],
-                    "LearningRate": [self._lr_var]},
+                    "LearningRate": [self._param_lr(p)]},
             outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
                      "LinearAccumOut": [lin]},
             attrs={"l1": self._l1, "l2": self._l2,

@@ -36,3 +36,15 @@ class ParamAttr:
         if isinstance(arg, (list, tuple)):
             return [ParamAttr._to_attr(a) for a in arg]
         raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: param_attr.py WeightNormParamAttr — weight
+    normalization (Salimans & Kingma): the layer's weight is
+    reparameterized as w = g * v / ||v||, with the norm taken over every
+    axis except `dim` (dim=None: one scalar g). LayerHelper detects this
+    attr and appends the reparam ops; gradients flow to g and v."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

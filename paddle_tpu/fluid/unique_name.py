@@ -35,3 +35,12 @@ def guard(new_prefix: str = ""):
         yield
     finally:
         _generator = old
+
+
+def switch(new_generator=None):
+    """reference: unique_name.py switch — swap the global generator,
+    returning the previous one (guard() composes this)."""
+    global _generator
+    old = _generator
+    _generator = new_generator or NameGenerator()
+    return old

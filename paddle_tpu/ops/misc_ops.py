@@ -532,3 +532,111 @@ def _py_func(ctx, ins, attrs):
                     for s, d in zip(out_shapes, out_dtypes)]
     outs = jax.pure_callback(fn, result_shape, *xs)
     return {"Out": list(outs)}
+
+
+@register_op("hash", no_grad=True, ref="operators/hash_op.cc")
+def _hash(ctx, ins, attrs):
+    """Deterministic id hashing: each input row hashes to num_hash values
+    in [0, mod_by). The reference uses XXH64(row, seed=ihash) % mod_by
+    (hash_op.h:46-48); here a splitmix64-style integer mix gives the same
+    contract (stable, seed-dependent, well-spread) in pure XLA ops."""
+    x = first(ins, "X")                              # [N, last_dim] int ids
+    mod_by = int(attrs.get("mod_by", attrs.get("hash_size", 1)))
+    num_hash = int(attrs.get("num_hash", 1))
+    n = x.shape[0]
+    # mix the FULL id width: 64-bit ids contribute both 32-bit halves
+    # (ids differing only above 2^32 must not collide systematically —
+    # the reference hashes all 8 bytes, XXH64 hash_op.h:48). With x64
+    # disabled JAX has already narrowed to int32 and the hi column is 0.
+    if x.dtype in (jnp.int64, jnp.uint64):
+        xu = x.astype(jnp.uint64)      # int64 & uint64 would promote f64
+        lo = (xu & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (xu >> jnp.uint64(32)).astype(jnp.uint32)
+        flat = jnp.stack([lo, hi], axis=-1).reshape(n, -1)
+    else:
+        flat = x.astype(jnp.uint32).reshape(n, -1)
+
+    def mix(h):
+        h = (h ^ (h >> 16)) * jnp.uint32(0x7feb352d)
+        h = (h ^ (h >> 15)) * jnp.uint32(0x846ca68b)
+        return h ^ (h >> 16)
+
+    outs = []
+    for ihash in range(num_hash):
+        h = jnp.full((n,), ihash, jnp.uint32)
+        for j in range(flat.shape[1]):
+            h = mix(h ^ flat[:, j])
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    return single(jnp.stack(outs, axis=1).reshape(n, num_hash, 1))
+
+
+def _adaptive_pool_nd(x, out_sizes, pool_type):
+    """Adaptive pooling with the reference's floor/ceil bin rule
+    (pool_op.h AdaptiveStartIndex/AdaptiveEndIndex): bin i covers
+    [floor(i*H/out), ceil((i+1)*H/out)). Bins are static (shapes are
+    static under XLA), so each output element is a python-scheduled
+    slice reduce — output grids are small by construction."""
+    spatial = x.shape[2:]
+    nd = len(out_sizes)
+    import itertools
+    bounds = []
+    for d in range(nd):
+        H, O = spatial[d], out_sizes[d]
+        bounds.append([(int(np.floor(i * H / O)),
+                        int(np.ceil((i + 1) * H / O))) for i in range(O)])
+    rows = []
+    for combo in itertools.product(*[range(o) for o in out_sizes]):
+        sl = (Ellipsis,) + tuple(slice(bounds[d][combo[d]][0],
+                                       bounds[d][combo[d]][1])
+                                 for d in range(nd))
+        patch = x[sl].reshape(x.shape[0], x.shape[1], -1)
+        rows.append(patch.max(-1) if pool_type == "max" else patch.mean(-1))
+    out = jnp.stack(rows, axis=-1)
+    return out.reshape(x.shape[:2] + tuple(out_sizes))
+
+
+@register_op("adaptive_pool2d", ref="operators/pool_op.cc (adaptive=True)")
+def _adaptive_pool2d(ctx, ins, attrs):
+    return single(_adaptive_pool_nd(first(ins, "X"),
+                                    [int(v) for v in attrs["pooled_size"]],
+                                    attrs.get("pooling_type", "max")))
+
+
+@register_op("adaptive_pool3d", ref="operators/pool_op.cc (adaptive=True, 3D)")
+def _adaptive_pool3d(ctx, ins, attrs):
+    return single(_adaptive_pool_nd(first(ins, "X"),
+                                    [int(v) for v in attrs["pooled_size"]],
+                                    attrs.get("pooling_type", "max")))
+
+
+@register_op("has_inf", no_grad=True, ref="operators/isfinite_op.cc (OverflowOp Inf)")
+def _has_inf(ctx, ins, attrs):
+    return single(jnp.any(jnp.isinf(first(ins, "X"))).reshape(1))
+
+
+@register_op("has_nan", no_grad=True, ref="operators/isfinite_op.cc (OverflowOp NAN)")
+def _has_nan(ctx, ins, attrs):
+    return single(jnp.any(jnp.isnan(first(ins, "X"))).reshape(1))
+
+
+@register_op("uniform_random_batch_size_like", no_grad=True,
+             ref="operators/uniform_random_batch_size_like_op.cc")
+def _uniform_random_batch_size_like(ctx, ins, attrs):
+    x = first(ins, "Input")
+    shape = list(attrs.get("shape", ()))
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    u = jax.random.uniform(ctx.key(), tuple(shape),
+                           minval=attrs.get("min", -1.0),
+                           maxval=attrs.get("max", 1.0), dtype=jnp.float32)
+    return single(u.astype(attrs.get("dtype", "float32")))
+
+
+@register_op("gaussian_random_batch_size_like", no_grad=True,
+             ref="operators/gaussian_random_batch_size_like_op.cc")
+def _gaussian_random_batch_size_like(ctx, ins, attrs):
+    x = first(ins, "Input")
+    shape = list(attrs.get("shape", ()))
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    g = (jax.random.normal(ctx.key(), tuple(shape), dtype=jnp.float32)
+         * attrs.get("std", 1.0) + attrs.get("mean", 0.0))
+    return single(g.astype(attrs.get("dtype", "float32")))

@@ -58,6 +58,12 @@ def chain(*readers):
 
 
 def compose(*readers, check_alignment: bool = True):
+    """reference: decorator.py compose — merge per-sample tuples; with
+    check_alignment (the default) a length mismatch raises
+    ComposeNotAligned instead of silently truncating."""
+    if check_alignment:
+        return _compose_checked(*readers)
+
     def composed():
         iters = [r() for r in readers]
         for items in zip(*iters):
@@ -228,3 +234,122 @@ def pad_batch(samples, length: int, pad_value=0, batch_size: int = None):
         lens = np.concatenate(
             [lens, np.zeros(b - len(samples), np.int32)])
     return out, lens
+
+
+class ComposeNotAligned(ValueError):
+    """reference: decorator.py:121 — raised by compose(check_alignment=
+    True) when the composed readers end at different lengths."""
+
+
+def _compose_checked(*readers):
+    """compose with alignment enforcement (the reference default)."""
+    def composed():
+        iters = [r() for r in readers]
+        while True:
+            items, stopped = [], 0
+            for it in iters:
+                try:
+                    items.append(next(it))
+                except StopIteration:
+                    stopped += 1
+            if stopped == len(iters):
+                return
+            if stopped:
+                raise ComposeNotAligned(
+                    "composed readers have different lengths")
+            out = []
+            for item in items:
+                out.extend(item) if isinstance(item, tuple) \
+                    else out.append(item)
+            yield tuple(out)
+    return composed
+
+
+class Fake:
+    """reference: decorator.py:509 — cache the first sample and replay it
+    data_num times (input-pipeline-free speed testing)."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+        return fake_reader
+
+
+class PipeReader:
+    """reference: decorator.py:438 — stream records from a shell
+    command's stdout (e.g. `cat part-*.gz | zcat`), splitting on a
+    separator; get_line yields decoded lines."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        if not isinstance(command, str):
+            raise TypeError("PipeReader command must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                import zlib
+                decomp = getattr(self, "_z", None)
+                if decomp is None:
+                    decomp = self._z = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                buff = decomp.decompress(buff)
+            buff = buff.decode("utf-8", errors="replace")
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop()
+                yield from lines
+            else:
+                yield buff
+        if remained:
+            yield remained
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """reference: decorator.py:338 — run several sample readers in
+    worker PROCESSES, merging their streams (xmap_readers is the thread
+    form; this is the fork form for GIL-bound decode work)."""
+    import multiprocessing as mp
+
+    def queue_reader():
+        q = mp.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(None)
+
+        procs = [mp.Process(target=worker, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is None:
+                finished += 1
+            else:
+                yield sample
+        for p in procs:
+            p.join()
+
+    return queue_reader

@@ -637,6 +637,15 @@ spec("shrink_rnn_memory", {"X": [f(2, 3)], "I": [lens(1)],
 spec("rnn_memory_helper", X23)
 spec("get_places", {})
 spec("print", {"In": [f(2, 2)]}, {"message": "smoke: "})
+spec("hash", {"X": [ints(4, 2, hi=100)]}, {"mod_by": 1000, "num_hash": 2})
+spec("adaptive_pool2d", {"X": [f(1, 2, 6, 6)]},
+     {"pooled_size": [3, 3], "pooling_type": "avg"})
+spec("adaptive_pool3d", {"X": [f(1, 2, 4, 6, 6)]},
+     {"pooled_size": [2, 3, 3], "pooling_type": "max"})
+spec("has_inf", X23)
+spec("has_nan", X23)
+spec("uniform_random_batch_size_like", {"Input": [f(3, 2)]}, {"shape": [0, 5]})
+spec("gaussian_random_batch_size_like", {"Input": [f(3, 2)]}, {"shape": [0, 5]})
 spec("py_func", {"X": [f(2, 3)]},
      {"func": lambda a: np.asarray(a) * 2.0,
       "out_shapes": [[2, 3]], "out_dtypes": ["float32"]})
