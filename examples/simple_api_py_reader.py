@@ -1,0 +1,91 @@
+"""The fluid "simple API" + py_reader pipeline — the reference's
+book-notebook workflow (contrib.Trainer / contrib.Inferencer) combined
+with the in-graph reader protocol (py_reader → read_file → run without
+feed → EOFException at epoch end).
+
+Run from the repo root: python examples/simple_api_py_reader.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import contrib
+from paddle_tpu.fluid import layers
+
+
+def main():
+    # ---- part 1: the py_reader epoch loop (reference layers/io.py) ----
+    reader = layers.py_reader(capacity=16, shapes=[(-1, 8), (-1, 1)],
+                              dtypes=["float32", "float32"])
+    x, y = layers.read_file(reader)
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square(pred - y))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(8, 1).astype("float32")
+
+    def batches():
+        r = np.random.RandomState(1)
+        for _ in range(16):
+            xb = r.rand(32, 8).astype("float32")
+            yield (xb, xb @ w_true)
+
+    reader.decorate_paddle_reader(batches)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    for epoch in range(3):
+        reader.start()
+        losses = []
+        while True:
+            try:
+                (lv,) = exe.run(fluid.default_main_program(),
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(())))
+            except fluid.core.EOFException:
+                reader.reset()
+                break
+        print(f"[py_reader] epoch {epoch}: mean loss "
+              f"{np.mean(losses):.5f}")
+
+    # ---- part 2: the contrib simple API ------------------------------
+    def train_func():
+        xv = layers.data("sx", shape=[8], dtype="float32")
+        yv = layers.data("sy", shape=[1], dtype="float32")
+        p = layers.fc(xv, 1, name="simple_fc")
+        return layers.mean(layers.square(p - yv))
+
+    trainer = contrib.Trainer(train_func,
+                              lambda: fluid.optimizer.SGD(
+                                  learning_rate=0.05))
+
+    def data_reader():
+        r = np.random.RandomState(2)
+        for _ in range(32):
+            xb = r.rand(32, 8).astype("float32")
+            yield {"sx": xb, "sy": xb @ w_true}
+
+    def handler(ev):
+        if isinstance(ev, contrib.high_level.EndEpochEvent):
+            print(f"[simple API] epoch {ev.epoch} done")
+
+    trainer.train(num_epochs=2, event_handler=handler, reader=data_reader)
+    trainer.save_params("/tmp/simple_api_params")
+
+    def infer_func():
+        xv = layers.data("sx", shape=[8], dtype="float32")
+        return layers.fc(xv, 1, name="simple_fc")
+
+    inf = contrib.Inferencer(infer_func, "/tmp/simple_api_params")
+    (out,) = inf.infer({"sx": np.ones((2, 8), np.float32)})
+    print("[simple API] inferred:", np.asarray(out).reshape(-1))
+
+
+if __name__ == "__main__":
+    main()

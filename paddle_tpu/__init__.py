@@ -49,4 +49,5 @@ def batch(reader, batch_size, drop_last=False):
             yield b
     return batch_reader
 
+from paddle_tpu import compat  # noqa: F401,E402
 from paddle_tpu import dataset, imperative, reader, trainer  # noqa: F401,E402

@@ -1,0 +1,63 @@
+"""Python-compat helpers (reference: python/paddle/compat.py — the
+py2/3 shims the reference's datasets and tools import). Python 3 is the
+only target here, so these reduce to their py3 forms; kept because
+reference user code imports them by name."""
+
+from __future__ import annotations
+
+import builtins
+import math
+
+__all__ = [
+    "long_type", "to_text", "to_bytes", "round", "floor_division",
+    "get_exception_message",
+]
+
+long_type = int
+
+
+def _convert(obj, fn, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [fn(o) for o in obj]
+            obj.clear()
+            (obj.extend if isinstance(obj, list) else obj.update)(items)
+            return obj
+        return type(obj)(fn(o) for o in obj)
+    return fn(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """reference: compat.py:36 — bytes→str (lists/sets element-wise)."""
+    def one(o):
+        return o.decode(encoding) if isinstance(o, bytes) else str(o)
+    return _convert(obj, one, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """reference: compat.py:106 — str→bytes (lists/sets element-wise)."""
+    def one(o):
+        return o.encode(encoding) if isinstance(o, str) else bytes(o)
+    return _convert(obj, one, inplace)
+
+
+def round(x, d=0):
+    """reference: compat.py:179 — py2-style half-away-from-zero round."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    """reference: compat.py:205."""
+    return x // y
+
+
+def get_exception_message(exc):
+    """reference: compat.py:222."""
+    return str(exc)

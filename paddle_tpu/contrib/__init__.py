@@ -13,6 +13,10 @@ from paddle_tpu.contrib.memory_usage import (  # noqa: F401
 from paddle_tpu.contrib.float16 import BF16Transpiler, Float16Transpiler
 
 from paddle_tpu.contrib.quantize_transpiler import QuantizeTranspiler  # noqa: F401
+from paddle_tpu.contrib.high_level import (  # noqa: F401
+    BeginEpochEvent, BeginStepEvent, EndEpochEvent, EndStepEvent,
+    Inferencer, Trainer, op_freq_statistic)
 
 __all__ = ["BF16Transpiler", "Float16Transpiler", "QuantizeTranspiler",
+           "Trainer", "Inferencer", "op_freq_statistic",
            "layout", "mixed_precision", "slim"]

@@ -1,0 +1,144 @@
+"""contrib high-level APIs (reference: python/paddle/fluid/contrib/
+trainer.py, inferencer.py, op_frequence.py). The Trainer/Inferencer
+pair is the fluid-era "simple API" used by the book notebooks; events
+mirror the v2 trainer's (paddle_tpu/trainer.py is the v2 form)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+class BeginEpochEvent:
+    """reference: contrib/trainer.py:40."""
+
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer:
+    """reference: contrib/trainer.py Trainer — builds the program from a
+    `train_func() -> loss (or [loss, ...metrics])`, owns its scope, runs
+    epochs over a reader with event callbacks, save/load via
+    fluid.io."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.place = place or fluid.CPUPlace()
+        self.scope = fluid.Scope()
+        self.train_program = fluid.Program()
+        self.startup_program = fluid.Program()
+        from paddle_tpu.fluid import unique_name
+        with unique_name.guard():
+            with fluid.program_guard(self.train_program,
+                                     self.startup_program):
+                out = train_func()
+                self.train_outputs = (list(out)
+                                      if isinstance(out, (list, tuple))
+                                      else [out])
+                loss = self.train_outputs[0]
+                optimizer_func().minimize(loss)
+        self.exe = fluid.Executor(self.place)
+        self.exe.run(self.startup_program, scope=self.scope)
+        if param_path:
+            fluid.io.load_persistables(self.exe, param_path,
+                                       self.train_program, scope=self.scope)
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        feed_order = feed_order or []
+        for epoch in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch))
+            for step, data in enumerate(reader()):
+                event_handler(BeginStepEvent(epoch, step))
+                feed = self._to_feed(data, feed_order)
+                vals = self.exe.run(self.train_program, feed=feed,
+                                    fetch_list=self.train_outputs,
+                                    scope=self.scope)
+                event_handler(EndStepEvent(
+                    epoch, step, [np.asarray(v) for v in vals]))
+            event_handler(EndEpochEvent(epoch))
+
+    def _to_feed(self, data, feed_order):
+        if isinstance(data, dict):
+            return data
+        if data and isinstance(data[0], (list, tuple)):
+            cols = list(zip(*data))
+            return OrderedDict(
+                (name, np.stack([np.asarray(v) for v in col]))
+                for name, col in zip(feed_order, cols))
+        return OrderedDict((name, np.asarray(v))
+                           for name, v in zip(feed_order, data))
+
+    def save_params(self, param_path):
+        fluid.io.save_persistables(self.exe, param_path,
+                                   self.train_program, scope=self.scope)
+
+    def stop(self):
+        pass
+
+
+class Inferencer:
+    """reference: contrib/inferencer.py — rebuild the inference graph
+    from `infer_func()`, load params from `param_path`, run feeds."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.place = place or fluid.CPUPlace()
+        self.scope = fluid.Scope()
+        self.inference_program = fluid.Program()
+        startup = fluid.Program()
+        from paddle_tpu.fluid import unique_name
+        with unique_name.guard():
+            with fluid.program_guard(self.inference_program, startup):
+                out = infer_func()
+                self.fetch = (list(out) if isinstance(out, (list, tuple))
+                              else [out])
+        self.exe = fluid.Executor(self.place)
+        self.exe.run(startup, scope=self.scope)
+        fluid.io.load_params(self.exe, param_path, self.inference_program,
+                             scope=self.scope)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        vals = self.exe.run(self.inference_program, feed=inputs,
+                            fetch_list=self.fetch, scope=self.scope,
+                            return_numpy=return_numpy)
+        return vals
+
+
+def op_freq_statistic(program):
+    """reference: contrib/op_frequence.py op_freq_statistic — (uni-op,
+    adjacent-op-pair) frequency tables over a program."""
+    uni_op_freq = OrderedDict()
+    adj_2_op_freq = OrderedDict()
+    prev = None
+    for op in program.global_block().ops:
+        uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+        if prev is not None:
+            key = prev + "->" + op.type
+            adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+        prev = op.type
+    uni = sorted(uni_op_freq.items(), key=lambda x: -x[1])
+    adj = sorted(adj_2_op_freq.items(), key=lambda x: -x[1])
+    return uni, adj

@@ -141,16 +141,30 @@ class Executor:
         if not feed and readers:
             started = [r for r in readers if r._queue is not None]
             if started:
+                def pull_one():
+                    # pull a batch from every reader; if one hits EOF
+                    # midway, push the already-pulled parts back so no
+                    # batch is lost across the epoch boundary
+                    pulled = []
+                    try:
+                        for r in started:
+                            pulled.append((r, r._next_feed()))
+                    except EOFException:
+                        for r, fd in pulled:
+                            r._push_back(fd)
+                        raise
+                    f = {}
+                    for _, fd in pulled:
+                        f.update(fd)
+                    return f
+
                 if iterations > 1:
                     # one fresh batch per scanned step; a short epoch
                     # tail shrinks the window (EOF only when empty)
                     feeds, eof = [], None
                     for _ in range(iterations):
                         try:
-                            f = {}
-                            for r in started:
-                                f.update(r._next_feed())
-                            feeds.append(f)
+                            feeds.append(pull_one())
                         except EOFException as e:
                             eof = e
                             break
@@ -158,9 +172,7 @@ class Executor:
                         raise eof
                     feed, iterations = feeds, len(feeds)
                 else:
-                    feed = {}
-                    for r in started:
-                        feed.update(r._next_feed())
+                    feed = pull_one()
 
         # BuildStrategy IR passes run once, right before compilation —
         # the reference's BuildStrategy::Apply moment (CompiledProgram

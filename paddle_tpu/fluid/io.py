@@ -285,20 +285,26 @@ class AsyncCheckpointer:
         return serial
 
 
-def save_params(executor, dirname, main_program=None, filename=None,
-                scope=None):
-    """reference: io.py save_params — parameters only (persistable
-    non-parameter state like LR/step counters excluded)."""
-    main_program = main_program or framework.default_main_program()
+def _param_names(main_program):
+    """Persistable vars that are actual Parameters (optimizer state like
+    Adam moments is persistable but NOT a parameter)."""
+    block = main_program.global_block()
 
     def is_param(v):
         return getattr(v, "is_parameter", False) or isinstance(
             v, framework.Parameter)
 
-    block = main_program.global_block()
-    names = [n for n in _persistable_names(main_program)
-             if block.has_var(n) and is_param(block.var(n))]
-    return save_vars(executor, dirname, main_program, vars=names,
+    return [n for n in _persistable_names(main_program)
+            if block.has_var(n) and is_param(block.var(n))]
+
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    """reference: io.py save_params — parameters only (persistable
+    non-parameter state like LR/step counters excluded)."""
+    main_program = main_program or framework.default_main_program()
+    return save_vars(executor, dirname, main_program,
+                     vars=_param_names(main_program),
                      filename=filename, scope=scope)
 
 
@@ -306,13 +312,5 @@ def load_params(executor, dirname, main_program=None, filename=None,
                 scope=None):
     """reference: io.py load_params."""
     main_program = main_program or framework.default_main_program()
-
-    def is_param(v):
-        return getattr(v, "is_parameter", False) or isinstance(
-            v, framework.Parameter)
-
-    block = main_program.global_block()
-    names = [n for n in _persistable_names(main_program)
-             if block.has_var(n) and is_param(block.var(n))]
-    return load_vars(executor, dirname, main_program, vars=names,
-                     scope=scope)
+    return load_vars(executor, dirname, main_program,
+                     vars=_param_names(main_program), scope=scope)

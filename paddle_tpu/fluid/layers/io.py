@@ -61,6 +61,8 @@ class PyReader:
         self._thread = None
         self._stop = None
         self._exhausted = False    # sentinel seen; EOF until reset()
+        from collections import deque
+        self._pushback = deque()   # batches returned by the executor
         self._program = program
         readers = getattr(program, "_py_readers", None)
         if readers is None:
@@ -132,11 +134,19 @@ class PyReader:
         self._queue = None
         self._thread = None
         self._exhausted = False
+        self._pushback.clear()
+
+    def _push_back(self, feed):
+        """Return an already-pulled batch (the executor aborted a
+        multi-reader or multi-step pull midway) — served again first."""
+        self._pushback.appendleft(feed)
 
     def _next_feed(self):
         from paddle_tpu.core.executor import EOFException
         if self._queue is None:
             raise RuntimeError("py_reader: start() not called (or reset)")
+        if self._pushback:
+            return self._pushback.popleft()
         if self._exhausted:
             # the sentinel was already consumed (e.g. by a multi-step
             # window's partial tail) — keep raising, never block

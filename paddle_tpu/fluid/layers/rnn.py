@@ -130,10 +130,22 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     pre-projected [B, T, 4H] sequence; returns (projection, cell)."""
     helper = LayerHelper("dynamic_lstmp", name=name)
     H = size // 4
-    weight = helper.create_parameter(param_attr, shape=[proj_size, 4 * H],
-                                     dtype=dtype)
-    proj_weight = helper.create_parameter(param_attr, shape=[H, proj_size],
-                                          dtype=dtype)
+    import copy
+
+    from paddle_tpu.fluid.param_attr import ParamAttr
+
+    def slot_attr(suffix):
+        # create_parameter stamps attr.name in place — sharing one attr
+        # object would alias weight and proj_weight into one variable
+        a = copy.copy(ParamAttr._to_attr(param_attr))
+        if a.name is not None:
+            a.name = a.name + suffix
+        return a
+
+    weight = helper.create_parameter(slot_attr(".weight"),
+                                     shape=[proj_size, 4 * H], dtype=dtype)
+    proj_weight = helper.create_parameter(slot_attr(".proj_weight"),
+                                          shape=[H, proj_size], dtype=dtype)
     bias_size = 7 * H if use_peepholes else 4 * H
     bias = helper.create_parameter(bias_attr, shape=[1, bias_size],
                                    dtype=dtype, is_bias=True)

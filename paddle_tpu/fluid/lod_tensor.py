@@ -38,13 +38,15 @@ def create_lod_tensor(data, recursive_seq_lens: Sequence[Sequence[int]],
     lens = list(recursive_seq_lens[-1])
     if isinstance(data, (list, tuple)):
         rows = [np.asarray(r) for r in data]
+        row_lens = [len(r) for r in rows]
+        if row_lens != lens:
+            # the reference asserts list data agrees with the given LoD
+            # (lod_tensor.py create_lod_tensor) — recomputing silently
+            # would mask a wrong-LoD caller bug
+            raise ValueError(
+                f"recursive_seq_lens {lens} disagree with the sequence "
+                f"list's own lengths {row_lens}")
         flat = np.concatenate([r.reshape(len(r), -1) for r in rows], axis=0)
-        if len(rows) != len(lens) or any(len(r) != l
-                                         for r, l in zip(rows, lens)):
-            # list-of-sequences form: lens come from the rows themselves
-            lens = [len(r) for r in rows]
-            flat = np.concatenate([np.asarray(r).reshape(len(r), -1)
-                                   for r in rows], axis=0)
     else:
         flat = np.asarray(data)
         flat = flat.reshape(flat.shape[0], -1)

@@ -328,6 +328,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     form; this is the fork form for GIL-bound decode work)."""
     import multiprocessing as mp
 
+    _POISON = "__multiprocess_reader_error__"
+
     def queue_reader():
         q = mp.Queue(queue_size)
 
@@ -335,8 +337,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for sample in r():
                     q.put(sample)
-            finally:
-                q.put(None)
+                q.put(None)                      # clean end-of-stream
+            except BaseException as e:           # propagate, don't fake EOF
+                q.put((_POISON, repr(e)))
 
         procs = [mp.Process(target=worker, args=(r,), daemon=True)
                  for r in readers]
@@ -347,6 +350,12 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             sample = q.get()
             if sample is None:
                 finished += 1
+            elif (isinstance(sample, tuple) and len(sample) == 2
+                  and sample[0] == _POISON):
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(
+                    f"multiprocess_reader worker raised: {sample[1]}")
             else:
                 yield sample
         for p in procs:

@@ -534,3 +534,67 @@ def test_transformer_noam_schedule_trains():
             for _ in range(4)]
     assert all(np.isfinite(v) for v in vals)
     assert vals[-1] < vals[0]        # warmup lr tiny but nonzero
+
+
+# -- contrib high-level Trainer/Inferencer (reference: contrib/trainer.py,
+# inferencer.py — the book-notebook "simple API") ---------------------------
+
+def test_contrib_trainer_inferencer_roundtrip(tmp_path):
+    import numpy as np
+
+    from paddle_tpu import contrib
+    from paddle_tpu.fluid import layers
+
+    def train_func():
+        x = layers.data("hx", shape=[4], dtype="float32")
+        y = layers.data("hy", shape=[1], dtype="float32")
+        pred = layers.fc(x, 1, name="hl")
+        return layers.mean(layers.square(pred - y))
+
+    def opt_func():
+        return fluid.optimizer.SGD(learning_rate=0.05)
+
+    trainer = contrib.Trainer(train_func, opt_func)
+    rng = np.random.RandomState(0)
+    wt = rng.rand(4, 1).astype("float32")
+
+    def reader():
+        for _ in range(8):
+            xb = rng.rand(8, 4).astype("float32")
+            yield {"hx": xb, "hy": xb @ wt}
+
+    seen = []
+
+    def handler(ev):
+        if isinstance(ev, contrib.high_level.EndStepEvent):
+            seen.append(float(np.asarray(ev.metrics[0]).reshape(())))
+
+    trainer.train(num_epochs=3, event_handler=handler, reader=reader)
+    assert len(seen) == 24 and seen[-1] < seen[0]
+    pdir = str(tmp_path / "hl_params")
+    trainer.save_params(pdir)
+
+    def infer_func():
+        x = layers.data("hx", shape=[4], dtype="float32")
+        return layers.fc(x, 1, name="hl")
+
+    inf = contrib.Inferencer(infer_func, pdir)
+    xb = np.ones((2, 4), np.float32)
+    (out,) = inf.infer({"hx": xb})
+    # parity vs the trained weights applied by hand
+    w = np.asarray(trainer.scope.find_var("hl.w_0"))
+    b = np.asarray(trainer.scope.find_var("hl.b_0"))
+    np.testing.assert_allclose(np.asarray(out), xb @ w + b, rtol=1e-5)
+
+
+def test_op_freq_statistic():
+    from paddle_tpu import contrib
+    from paddle_tpu.fluid import layers
+
+    x = layers.data("fx", shape=[4], dtype="float32")
+    h = layers.fc(x, 4, act="relu")
+    layers.fc(h, 4, act="relu")
+    uni, adj = contrib.op_freq_statistic(fluid.default_main_program())
+    d = dict(uni)
+    assert d.get("mul", 0) >= 2 and d.get("relu", 0) == 2
+    assert any("->" in k for k, _ in adj)

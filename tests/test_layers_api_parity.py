@@ -594,3 +594,96 @@ def test_py_reader_provider_error_propagates():
     with pytest.raises(RuntimeError, match="provider raised"):
         exe.run(fluid.default_main_program(), fetch_list=[out])
     reader.reset()
+
+
+def test_compat_module_surface_and_behavior():
+    src = open("/root/reference/python/paddle/compat.py",
+               encoding="utf-8", errors="ignore").read()
+    names = set()
+    for m in re.finditer(r"__all__\s*=\s*\[(.*?)\]", src, re.S):
+        names.update(re.findall(r"['\"](\w+)['\"]", m.group(1)))
+    from paddle_tpu import compat
+    missing = sorted(n for n in names if not hasattr(compat, n))
+    assert not missing, missing
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert compat.round(2.5) == 3.0          # py2 half-away-from-zero
+    assert compat.round(-2.5) == -3.0
+    assert compat.floor_division(7, 2) == 3
+
+
+def test_dynamic_lstmp_distinct_weights():
+    """A shared param_attr must not alias weight and proj_weight."""
+    x = layers.data("lp_x", shape=[3, 16], dtype="float32")
+    proj, cell = layers.dynamic_lstmp(
+        x, size=16, proj_size=2,
+        param_attr=fluid.ParamAttr(name="lp_shared"))
+    startup = fluid.default_startup_program().global_block().vars
+    ws = [n for n in startup if n.startswith("lp_shared")]
+    assert len(ws) == 2 and len(set(ws)) == 2, ws
+    (v,) = _run(proj, {"lp_x": np.random.RandomState(0)
+                       .rand(2, 3, 16).astype("float32")})
+    assert np.isfinite(np.asarray(v)).all()
+
+
+def test_multiprocess_reader_error_propagates():
+    from paddle_tpu.reader.decorator import multiprocess_reader
+
+    def good():
+        yield (1,)
+
+    def bad():
+        yield (2,)
+        raise ValueError("decode exploded")
+
+    r = multiprocess_reader([good, bad])
+    with pytest.raises(RuntimeError, match="worker raised"):
+        list(r())
+
+
+def test_create_lod_tensor_rejects_wrong_lens():
+    with pytest.raises(ValueError, match="disagree"):
+        fluid.create_lod_tensor([[1.0, 2.0], [3.0]], [[2, 2]])
+
+
+def test_multi_reader_eof_pushes_back_pulled_batch():
+    """Reader B's epoch ends first: the batch already pulled from A must
+    survive to the next run, not vanish."""
+    ra = layers.py_reader(capacity=8, shapes=[(-1, 1)], dtypes=["float32"],
+                          name="rda")
+    rb = layers.py_reader(capacity=8, shapes=[(-1, 1)], dtypes=["float32"],
+                          name="rdb")
+    a = layers.read_file(ra)
+    b = layers.read_file(rb)
+    out = layers.mean(layers.elementwise_add(a, b))
+
+    def mk(vals):
+        def batches():
+            for v in vals:
+                yield (np.full((1, 1), float(v), np.float32),)
+        return batches
+
+    ra.decorate_paddle_reader(mk([1, 2, 3]))       # long
+    rb.decorate_paddle_reader(mk([10, 20]))        # short
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ra.start()
+    rb.start()
+    vals = []
+    while True:
+        try:
+            (v,) = exe.run(fluid.default_main_program(), fetch_list=[out])
+            vals.append(float(np.asarray(v).reshape(())))
+        except fluid.core.EOFException:
+            break
+    assert vals == [11.0, 22.0]
+    # A's batch "3" was pulled during the failed third step — it must
+    # come back on the next epoch instead of being dropped
+    rb.reset()
+    rb.decorate_paddle_reader(mk([30]))
+    rb.start()
+    (v,) = exe.run(fluid.default_main_program(), fetch_list=[out])
+    assert float(np.asarray(v).reshape(())) == 33.0
+    ra.reset()
+    rb.reset()
