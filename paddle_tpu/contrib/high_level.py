@@ -71,11 +71,15 @@ class Trainer:
         for epoch in range(num_epochs):
             event_handler(BeginEpochEvent(epoch))
             for step, data in enumerate(reader()):
-                event_handler(BeginStepEvent(epoch, step))
+                begin = BeginStepEvent(epoch, step)
+                event_handler(begin)
                 feed = self._to_feed(data, feed_order)
+                # the handler may clear fetch_metrics to skip the
+                # device->host metric transfer (reference
+                # contrib/trainer.py:508 checks it before fetching)
+                fetch = self.train_outputs if begin.fetch_metrics else []
                 vals = self.exe.run(self.train_program, feed=feed,
-                                    fetch_list=self.train_outputs,
-                                    scope=self.scope)
+                                    fetch_list=fetch, scope=self.scope)
                 event_handler(EndStepEvent(
                     epoch, step, [np.asarray(v) for v in vals]))
             event_handler(EndEpochEvent(epoch))

@@ -142,14 +142,14 @@ class Executor:
             started = [r for r in readers if r._queue is not None]
             if started:
                 def pull_one():
-                    # pull a batch from every reader; if one hits EOF
-                    # midway, push the already-pulled parts back so no
-                    # batch is lost across the epoch boundary
+                    # pull a batch from every reader; if one fails
+                    # midway (EOF or a provider error), push the
+                    # already-pulled parts back so no batch is lost
                     pulled = []
                     try:
                         for r in started:
                             pulled.append((r, r._next_feed()))
-                    except EOFException:
+                    except BaseException:
                         for r, fd in pulled:
                             r._push_back(fd)
                         raise

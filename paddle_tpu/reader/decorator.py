@@ -351,7 +351,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if sample is None:
                 finished += 1
             elif (isinstance(sample, tuple) and len(sample) == 2
-                  and sample[0] == _POISON):
+                  and isinstance(sample[0], str) and sample[0] == _POISON):
                 for p in procs:
                     p.terminate()
                 raise RuntimeError(

@@ -214,3 +214,42 @@ def test_fusion_seqexpand_concat_fc():
                {"X": {"x1": _r(2, 4, 3, seed=33), "x2": _r(2, 3, seed=34)},
                 "FCWeight": {"w": _r(6, 5, seed=35)},
                 "SeqLens": {"l": _lens(3, 4)}})
+
+
+# -- late additions: fused conv / embedding-pool / packed LSTM --------------
+
+def test_conv2d_fusion_grad():
+    # bias large enough that every pre-activation stays positive: the
+    # relu KINK is probed by the activation grid; here the target is the
+    # fused op's input/filter/bias gradient routing
+    # in the all-active regime the map is affine, so a wide probe delta
+    # is exact and dominates the fp32 loss-rounding noise
+    check_grad("conv2d_fusion",
+               {"Input": {"x": _r(1, 3, 6, 6, seed=40, lo=-0.1, hi=0.1)},
+                "Filter": {"w": _r(4, 3, 3, 3, seed=41, lo=-0.1, hi=0.1)},
+                "Bias": {"b": _r(4, seed=42, lo=0.4, hi=0.6)}},
+               attrs={"strides": [1, 1], "paddings": [1, 1],
+                      "activation": "relu"},
+               out_slot="Output", delta=2e-2, rtol=2e-2, atol=5e-4)
+
+
+def test_fused_embedding_seq_pool_grad():
+    check_grad("fused_embedding_seq_pool",
+               {"W": {"w": _r(8, 4, seed=43)},
+                "Ids": {"ids": np.asarray([[1, 3, 0], [2, 5, 7]], np.int64)},
+                "SeqLens": {"l": _lens(2, 3)}},
+               grad_vars=["w"])
+
+
+def test_cudnn_lstm_numeric_grad():
+    D = 3
+    check_grad("cudnn_lstm",
+               {"Input": {"x": _r(2, 2, D, seed=44, lo=-0.5, hi=0.5)},
+                "InitH": {"h0": np.zeros((1, 2, D), np.float32)},
+                "InitC": {"c0": np.zeros((1, 2, D), np.float32)},
+                "W": {"w": _r(4 * D * (2 * D + 2), seed=45,
+                              lo=-0.3, hi=0.3)}},
+               attrs={"hidden_size": D, "is_bidirec": False},
+               grad_vars=["x", "w"],
+               extra_out_slots=("last_h", "last_c"),
+               delta=2e-3, rtol=2e-2, atol=2e-4)

@@ -687,3 +687,69 @@ def test_multi_reader_eof_pushes_back_pulled_batch():
     assert float(np.asarray(v).reshape(())) == 33.0
     ra.reset()
     rb.reset()
+
+
+def test_cudnn_lstm_bidirec_two_layer_packing():
+    """The wrapper's packed-W sizing must match the emitter's per-layer
+    per-direction consumption: layer1 in=D, layer2 in=2H (bidirec)."""
+    D, H, T, B = 4, 3, 5, 2
+    x = layers.data("bl_x", shape=[T, B, D], dtype="float32",
+                    append_batch_size=False)
+    h0 = layers.data("bl_h", shape=[2 * 2, B, H], dtype="float32",
+                     append_batch_size=False)
+    c0 = layers.data("bl_c", shape=[2 * 2, B, H], dtype="float32",
+                     append_batch_size=False)
+    out, lh, lc = layers.lstm(x, h0, c0, max_len=T, hidden_size=H,
+                              num_layers=2, is_bidirec=True)
+    # expected: L1 2*(D*4H + H*4H + 4H) + L2 2*((2H)*4H + H*4H + 4H)
+    want = 2 * (D * 4 * H + H * 4 * H + 4 * H) \
+        + 2 * (2 * H * 4 * H + H * 4 * H + 4 * H)
+    wvar = [v for n, v in
+            fluid.default_startup_program().global_block().vars.items()
+            if n.startswith("lstm")][0]
+    assert list(wvar.shape) == [want], (wvar.shape, want)
+    rng = np.random.RandomState(0)
+    vals = _run([out, lh], {
+        "bl_x": rng.rand(T, B, D).astype("float32"),
+        "bl_h": np.zeros((4, B, H), np.float32),
+        "bl_c": np.zeros((4, B, H), np.float32)})
+    assert np.asarray(vals[0]).shape == (T, B, 2 * H)
+    assert np.asarray(vals[1]).shape == (4, B, H)
+
+
+def test_multiprocess_reader_ndarray_samples():
+    """Normal (features, label) 2-tuples of ndarrays must not trip the
+    poison-sentinel check (ndarray == str is elementwise)."""
+    from paddle_tpu.reader.decorator import multiprocess_reader
+
+    def r1():
+        yield (np.zeros((4,), np.float32), np.zeros((1,), np.int64))
+
+    got = list(multiprocess_reader([r1])())
+    assert len(got) == 1 and got[0][0].shape == (4,)
+
+
+def test_trainer_fetch_metrics_flag():
+    from paddle_tpu import contrib
+    from paddle_tpu.fluid import layers
+
+    def train_func():
+        x = layers.data("fm_x", shape=[4], dtype="float32")
+        return layers.mean(layers.fc(x, 1))
+
+    tr = contrib.Trainer(train_func,
+                         lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    metrics_seen = []
+
+    def handler(ev):
+        if isinstance(ev, contrib.high_level.BeginStepEvent):
+            ev.fetch_metrics = ev.step % 2 == 0
+        if isinstance(ev, contrib.high_level.EndStepEvent):
+            metrics_seen.append(len(ev.metrics))
+
+    def reader():
+        for _ in range(4):
+            yield {"fm_x": np.ones((2, 4), np.float32)}
+
+    tr.train(1, handler, reader=reader)
+    assert metrics_seen == [1, 0, 1, 0], metrics_seen
