@@ -1,0 +1,17 @@
+"""Version metadata (reference: python/paddle/version.py —
+full_version/major/minor/patch/commit consumed by tooling and the
+fluid __init__ banner)."""
+
+full_version = "1.2.0+tpu"
+major = "1"
+minor = "2"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native-rebuild"
+with_mkl = "OFF"
+
+
+def show():
+    print("commit:", commit)
+    print("version:", full_version)
