@@ -83,6 +83,10 @@ class Master {
   // service.go TaskFinished/TaskFailed)
   int TaskFinished(int64_t id, int64_t epoch) {
     std::lock_guard<std::mutex> lk(mu_);
+    // expire first: a report arriving after the lease deadline is stale
+    // even if no other worker has polled yet (the Go master's timer-based
+    // checkTimeoutFunc gives exactly these semantics, service.go:313)
+    Expire();
     auto it = pending_.find(id);
     if (it == pending_.end() || it->second.epoch != epoch)
       return -1;  // stale (lease expired and possibly reissued)
@@ -93,6 +97,7 @@ class Master {
 
   int TaskFailed(int64_t id, int64_t epoch) {
     std::lock_guard<std::mutex> lk(mu_);
+    Expire();
     auto it = pending_.find(id);
     if (it == pending_.end() || it->second.epoch != epoch) return -1;
     Task t = it->second.task;

@@ -10,6 +10,8 @@ the same capability.
 from paddle_tpu.data.pipeline import DataLoader, PyReader
 from paddle_tpu.data.datafeed import (AsyncExecutor, DataFeedDesc,
                                       MultiSlotDataFeed)
+from paddle_tpu.data.master_service import (MASTER_ENV, MasterClient,
+                                            MasterServer)
 
-__all__ = ["AsyncExecutor", "DataFeedDesc", "DataLoader",
-           "MultiSlotDataFeed", "PyReader"]
+__all__ = ["AsyncExecutor", "DataFeedDesc", "DataLoader", "MASTER_ENV",
+           "MasterClient", "MasterServer", "MultiSlotDataFeed", "PyReader"]

@@ -1,0 +1,255 @@
+"""Network service for the chunk-lease master: N trainer processes share
+one task queue (reference: go/master/service.go — the master is an RPC
+service trainers dial; GetTask :366, TaskFinished :410, TaskFailed :455;
+clients discover it via etcd, go/master/etcd_client.go).
+
+TPU-native shape: the C++ lease/timeout/retry state machine (csrc/
+master.cc, wrapped by data/master.py) is hosted on rank 0 behind a tiny
+line-oriented JSON-over-TCP protocol — the one place a control-plane RPC
+stack survives on a TPU pod (SURVEY §5 comm backend note). Discovery is
+the repo's existing cluster convention instead of etcd: workers read
+``PADDLE_MASTER`` (or are handed the address), the same way
+``PADDLE_COORDINATOR`` carries the JAX coordination service address.
+
+Protocol (one JSON object per line, one reply line per request):
+
+    -> {"method": "get_task"}
+    <- {"ok": true, "task": {"id": 3, "epoch": 7, "path": "...",
+                             "chunk_begin": 0, "chunk_end": 2}}
+       | {"ok": true, "task": null, "done": false}    retry later
+       | {"ok": true, "task": null, "done": true}     queue drained
+    -> {"method": "task_finished", "id": 3, "epoch": 7}
+    <- {"ok": true, "accepted": true}    (false = stale lease epoch)
+    -> {"method": "task_failed", "id": 3, "epoch": 7}
+    -> {"method": "stats"} / {"method": "snapshot", "path": "..."}
+    -> {"method": "ping"}
+
+A worker that dies mid-lease simply stops talking; its lease expires in
+the C++ state machine and the task re-issues to a surviving worker — the
+EDL elasticity loop, now actually shared across OS processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+from paddle_tpu.data.master import Master, Task
+
+MASTER_ENV = "PADDLE_MASTER"
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master: Master = self.server.master  # type: ignore[attr-defined]
+        while True:
+            try:
+                line = self.rfile.readline()
+            except (ConnectionError, OSError):
+                return
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                resp = self._dispatch(master, req)
+            except Exception as e:  # malformed request: report, keep serving
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except (ConnectionError, OSError, BrokenPipeError):
+                return
+
+    @staticmethod
+    def _dispatch(master: Master, req: dict) -> dict:
+        method = req.get("method")
+        if method == "get_task":
+            t = master.get_task()
+            if t is None:
+                return {"ok": True, "task": None, "done": master.done}
+            return {"ok": True, "done": False,
+                    "task": {"id": t.id, "epoch": t.epoch, "path": t.path,
+                             "chunk_begin": t.chunk_begin,
+                             "chunk_end": t.chunk_end}}
+        if method in ("task_finished", "task_failed"):
+            t = Task(int(req["id"]), int(req["epoch"]), "", 0, 0)
+            fn = (master.task_finished if method == "task_finished"
+                  else master.task_failed)
+            return {"ok": True, "accepted": bool(fn(t))}
+        if method == "stats":
+            s = master.stats()
+            s["done_flag"] = master.done
+            return {"ok": True, "stats": s}
+        if method == "snapshot":
+            master.snapshot(req["path"])
+            return {"ok": True}
+        if method == "ping":
+            return {"ok": True, "pong": True}
+        return {"ok": False, "error": f"unknown method {method!r}"}
+
+
+class MasterServer:
+    """Host a Master behind the JSON/TCP protocol (rank-0 side).
+
+        m = Master(timeout_s=2.0)
+        m.set_dataset(files)
+        srv = MasterServer(m)          # serves on an ephemeral port
+        os.environ[MASTER_ENV] = srv.endpoint
+        ... spawn workers ...
+        srv.stop()
+    """
+
+    def __init__(self, master: Master, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.master = master
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.master = master  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address[:2]
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.address
+        return f"{host}:{port}"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class MasterClient:
+    """Trainer-side proxy with the same duck interface as ``Master``, so
+    ``task_reader(MasterClient(...))`` is the multi-worker form of the
+    single-process loop (reference: go/master/client.go dials the service
+    and calls GetTask/TaskFinished/TaskFailed over net/rpc).
+
+    One persistent connection per client; transient socket failures
+    reconnect once per call (the master restarting from a snapshot looks
+    like a reconnect to workers).
+    """
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 timeout_s: float = 30.0):
+        endpoint = endpoint or os.environ.get(MASTER_ENV)
+        if not endpoint:
+            raise ValueError(
+                f"no master endpoint: pass one or set {MASTER_ENV}")
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        self._last_done = False   # done flag from the last get_task reply
+        self._polled = False
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self):
+        self._close_sock()
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    def _close_sock(self):
+        for obj in (self._rfile, self._sock):
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+        self._sock = self._rfile = None
+
+    def _call(self, req: dict, idempotent: bool = True) -> dict:
+        """One request/reply. ``idempotent=False`` (task_finished /
+        task_failed) never resends after the request may have reached the
+        master — a duplicate report would be misread as a stale-lease
+        rejection; reconnect-before-send is always safe."""
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                except (ConnectionError, OSError):
+                    if attempt:
+                        raise
+                    continue
+                try:
+                    self._sock.sendall((json.dumps(req) + "\n").encode())
+                    line = self._rfile.readline()
+                    if not line:
+                        raise ConnectionError("master closed connection")
+                    resp = json.loads(line)
+                    if not resp.get("ok"):
+                        raise RuntimeError(
+                            f"master error: {resp.get('error')}")
+                    return resp
+                except (ConnectionError, OSError, json.JSONDecodeError):
+                    self._close_sock()
+                    if attempt or not idempotent:
+                        raise
+        raise AssertionError("unreachable")
+
+    # -- Master duck interface ------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        resp = self._call({"method": "get_task"})
+        self._last_done = bool(resp.get("done"))
+        self._polled = True
+        t = resp.get("task")
+        if t is None:
+            return None
+        return Task(t["id"], t["epoch"], t["path"],
+                    t["chunk_begin"], t["chunk_end"])
+
+    def task_finished(self, task: Task) -> bool:
+        return bool(self._call({"method": "task_finished", "id": task.id,
+                                "epoch": task.epoch},
+                               idempotent=False)["accepted"])
+
+    def task_failed(self, task: Task) -> bool:
+        return bool(self._call({"method": "task_failed", "id": task.id,
+                                "epoch": task.epoch},
+                               idempotent=False)["accepted"])
+
+    @property
+    def done(self) -> bool:
+        # every get_task reply carries the done flag — reuse it instead of
+        # a second round trip per idle poll; fall back to a stats RPC only
+        # before the first poll
+        if self._polled:
+            return self._last_done
+        return bool(self._call({"method": "stats"})["stats"]["done_flag"])
+
+    def stats(self) -> dict:
+        s = self._call({"method": "stats"})["stats"]
+        s.pop("done_flag", None)
+        return s
+
+    def snapshot(self, path: str):
+        self._call({"method": "snapshot", "path": path})
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._call({"method": "ping"}).get("pong"))
+        except Exception:
+            return False
+
+    def close(self):
+        with self._lock:
+            self._close_sock()

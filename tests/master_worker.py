@@ -1,0 +1,67 @@
+"""Subprocess body for the multi-worker master-service test: dial the
+shared chunk-lease master (PADDLE_MASTER), drain tasks, optionally die
+abruptly mid-lease (DIE_AFTER_LEASES) to exercise lease-timeout
+re-issue. Mirrors the trainer loop of go/master/client.go NextRecord.
+
+Prints one final JSON line: records consumed + tasks completed."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import recordio                      # noqa: E402
+from paddle_tpu.data.master_service import MasterClient  # noqa: E402
+
+
+def main():
+    # start barrier: python/jax import skew would otherwise let the first
+    # worker up drain the whole queue alone
+    bdir = os.environ.get("MASTER_BARRIER_DIR")
+    if bdir:
+        open(os.path.join(bdir, f"ready_{os.getpid()}"), "w").close()
+        while not os.path.exists(os.path.join(bdir, "go")):
+            time.sleep(0.01)
+    client = MasterClient()
+    die_after = int(os.environ.get("DIE_AFTER_LEASES", "0"))
+    leases = 0
+    completed = []
+    records = []
+    while True:
+        task = client.get_task()
+        if task is None:
+            if client.done:
+                break
+            time.sleep(0.05)
+            continue
+        leases += 1
+        if die_after and leases >= die_after:
+            # consume part of the chunk, then die without reporting —
+            # the lease must time out and re-issue to a survivor
+            scanner = recordio.Scanner(task.path, task.chunk_begin,
+                                       task.chunk_end)
+            next(iter(scanner), None)
+            os._exit(17)
+        got = []
+        scanner = recordio.Scanner(task.path, task.chunk_begin,
+                                   task.chunk_end)
+        try:
+            for rec in scanner:
+                got.append(rec.decode())
+        finally:
+            scanner.close()
+        # simulated per-chunk training time, so the test's queue drain
+        # overlaps across workers instead of being won by one process
+        time.sleep(float(os.environ.get("TRAIN_SLEEP", "0")))
+        if client.task_finished(task):
+            records.extend(got)
+            completed.append([task.id, task.path, task.chunk_begin,
+                              task.chunk_end])
+    print(json.dumps({"completed": completed, "records": records}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Cross-process chunk-lease master service test (reference:
+go/master/service.go — N trainers share one task queue over RPC; GetTask
+:366 leases with timeout, TaskFinished :410, TaskFailed :455; the EDL
+headline: a worker dies mid-lease and survivors absorb its chunks with
+every chunk trained exactly once).
+
+The repo's C++ lease state machine (csrc/master.cc) is hosted behind the
+JSON/TCP MasterServer on this (rank-0) process; 3 worker OS processes
+dial it with MasterClient. Worker 0 is configured to die abruptly
+mid-lease (os._exit, no report); its lease times out and the task
+re-issues to a survivor."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import recordio
+from paddle_tpu.core import native
+from paddle_tpu.data.master import Master, task_reader
+from paddle_tpu.data.master_service import (MASTER_ENV, MasterClient,
+                                            MasterServer)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+WORKER = os.path.join(os.path.dirname(__file__), "master_worker.py")
+
+
+def _make_dataset(tmp_path, n_files=3, chunks_per_file=3, recs_per_chunk=4):
+    paths, expected = [], set()
+    for f in range(n_files):
+        p = str(tmp_path / f"part-{f:03d}.recordio")
+        w = recordio.Writer(p, max_chunk_records=recs_per_chunk)
+        for c in range(chunks_per_file):
+            for r in range(recs_per_chunk):
+                rec = f"f{f}c{c}r{r}"
+                w.write(rec.encode())
+                expected.add(rec)
+        w.close()
+        paths.append(p)
+    return paths, expected
+
+
+def _spawn_worker(endpoint, die_after=0, barrier_dir=None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "JAX_", "XLA_"))}
+    env[MASTER_ENV] = endpoint
+    env["JAX_PLATFORMS"] = "cpu"     # workers never touch a device anyway
+    if die_after:
+        env["DIE_AFTER_LEASES"] = str(die_after)
+    if barrier_dir:
+        env["MASTER_BARRIER_DIR"] = barrier_dir
+        env["TRAIN_SLEEP"] = "0.15"
+    return subprocess.Popen(
+        [sys.executable, WORKER], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_multi_worker_drain_with_mid_lease_death(tmp_path):
+    paths, expected = _make_dataset(tmp_path)
+    master = Master(timeout_s=1.5, failure_max=5)
+    master.set_dataset(paths, chunks_per_task=1)
+    total_tasks = master.stats()["todo"]
+    assert total_tasks == 9
+
+    srv = MasterServer(master)
+    try:
+        # victim dies on its FIRST lease, before reporting anything —
+        # all 9 completions must come from the two survivors
+        bdir = str(tmp_path / "barrier")
+        os.makedirs(bdir)
+        workers = [_spawn_worker(srv.endpoint,
+                                 die_after=1 if i == 0 else 0,
+                                 barrier_dir=bdir)
+                   for i in range(3)]
+        import time
+        deadline = time.time() + 90
+        while len([f for f in os.listdir(bdir)
+                   if f.startswith("ready_")]) < 3:
+            assert time.time() < deadline, "workers never reached barrier"
+            time.sleep(0.05)
+        open(os.path.join(bdir, "go"), "w").close()
+        outs = []
+        for i, w in enumerate(workers):
+            out, err = w.communicate(timeout=120)
+            if i == 0:
+                assert w.returncode == 17, f"victim survived: {err}"
+            else:
+                assert w.returncode == 0, f"worker {i} failed: {err}"
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        srv.stop()
+
+    # every chunk completed exactly once, across the surviving workers
+    completed = [tuple(t[1:]) for o in outs for t in o["completed"]]
+    assert len(completed) == total_tasks
+    assert len(set(completed)) == total_tasks
+    # both survivors actually participated (the queue was shared)
+    assert all(o["completed"] for o in outs)
+    # every record trained exactly once within completed tasks
+    records = [r for o in outs for r in o["records"]]
+    assert sorted(records) == sorted(expected)
+    assert len(records) == len(expected)
+    # master accounting: all done, nothing dropped
+    s = master.stats()
+    assert s["done"] == total_tasks and s["dropped"] == 0
+    assert s["todo"] == 0 and s["pending"] == 0
+
+
+def test_client_server_roundtrip_and_epoch_guard(tmp_path):
+    paths, expected = _make_dataset(tmp_path, n_files=1, chunks_per_file=2)
+    master = Master(timeout_s=0.2, failure_max=3)
+    master.set_dataset(paths)
+    srv = MasterServer(master)
+    try:
+        c = MasterClient(srv.endpoint)
+        assert c.ping()
+        t = c.get_task()
+        assert t is not None
+        import time
+        time.sleep(0.4)                      # let the lease expire
+        # stale report onto the expired lease is rejected (epoch guard)
+        assert not c.task_finished(t)
+        # the task re-issued; drain everything through task_reader over
+        # the NETWORK client — the single-process loop works unchanged
+        got = [r.decode() for r in task_reader(c, poll_interval=0.02)]
+        assert sorted(got) == sorted(expected)
+        assert c.done
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_snapshot_over_wire(tmp_path):
+    paths, _ = _make_dataset(tmp_path, n_files=1, chunks_per_file=2)
+    master = Master(timeout_s=5.0, failure_max=3)
+    master.set_dataset(paths)
+    srv = MasterServer(master)
+    snap = str(tmp_path / "master.snap")
+    try:
+        c = MasterClient(srv.endpoint)
+        c.snapshot(snap)
+        c.close()
+    finally:
+        srv.stop()
+    # a fresh master recovers the full queue from the wire-side snapshot
+    m2 = Master(timeout_s=5.0, failure_max=3)
+    m2.recover(snap)
+    assert m2.stats()["todo"] == 2
