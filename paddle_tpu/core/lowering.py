@@ -441,6 +441,17 @@ class CompiledBlock:
             self._feed_sh_cache = self._input_shardings()[2]
         return self._feed_sh_cache.get(name)
 
+    def param_sharding(self, name: str):
+        """Target sharding this compiled step assigns to a persistable —
+        the ``sharding_fn`` for restore-with-resharding
+        (fluid.sharded_io.load_sharded): restore a checkpoint directly
+        into the layout the next mesh will train with."""
+        if self.dist is None or self.dist.mesh is None:
+            return None
+        if not hasattr(self, "_param_sharding_fn"):
+            self._input_shardings()
+        return self._param_sharding_fn(name)
+
     def __call__(self, scope, feeds: Dict[str, Any], step_seed: int):
         state = {}
         for n in self.sig.state_names:

@@ -136,4 +136,10 @@ def task_reader(master: Master, poll_interval: float = 0.05,
         finally:
             if scanner is not None:
                 scanner.close()
+        # Delivery is AT-LEAST-ONCE, like the reference (the Go client
+        # yields records as it scans; go/master/client.go NextRecord): if
+        # consuming a chunk takes longer than the lease, the finish below
+        # is rejected as stale (master.cc expires with timer semantics)
+        # and the chunk re-issues to another worker — re-trained rather
+        # than lost. Size leases for the slowest chunk, not the average.
         master.task_finished(task)

@@ -208,6 +208,12 @@ class MasterClient:
 
     # -- Master duck interface ------------------------------------------
     def get_task(self) -> Optional[Task]:
+        # Retried after connection loss even though a lost-reply retry can
+        # strand the first lease: the orphan simply expires and Requeue
+        # counts one failure — identical to how the reference accounts a
+        # timed-out lease (go/master checkTimeoutFunc increments
+        # NumFailure), so a dropped reply behaves like a briefly-dead
+        # worker rather than crashing this one.
         resp = self._call({"method": "get_task"})
         self._last_done = bool(resp.get("done"))
         self._polled = True

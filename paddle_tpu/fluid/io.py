@@ -48,8 +48,13 @@ def _write_snapshot_dir(dirname: str, snapshot) -> List[str]:
 
 
 def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = None,
-              predicate=None, filename=None, scope=None):
-    """reference: io.py:222 (scope: the fluid.scope_guard capability)."""
+              predicate=None, filename=None, scope=None, sharded=False):
+    """reference: io.py:222 (scope: the fluid.scope_guard capability).
+
+    ``sharded=True`` writes the per-shard layout (fluid.sharded_io): only
+    this process's addressable shards, one file each — the multi-host-safe
+    form (reference: the pserver checkpoints its own shard,
+    go/pserver/service.go:47)."""
     main_program = main_program or framework.default_main_program()
     scope = scope or global_scope()
     if vars is None:
@@ -57,6 +62,14 @@ def save_vars(executor, dirname, main_program=None, vars: Optional[List[str]] = 
         if predicate is not None:
             vars = [v for v in vars
                     if predicate(main_program.global_block().var(v))]
+    if sharded:
+        if filename is not None:
+            raise ValueError("sharded=True writes one file per shard; "
+                             "the single-file `filename` form does not "
+                             "apply")
+        from paddle_tpu.fluid import sharded_io
+        return sharded_io.save_sharded(
+            dirname, sharded_io.snapshot_sharded(scope, vars))
     snapshot = {}
     for name in vars:
         val = scope.find_var(name)
@@ -74,9 +87,18 @@ def save_persistables(executor, dirname, main_program=None, filename=None,
 
 def load_vars(executor, dirname, main_program=None,
               vars: Optional[List[str]] = None, predicate=None,
-              filename=None, scope=None):
-    """reference: io.py load_vars."""
+              filename=None, scope=None, sharding_fn=None):
+    """reference: io.py load_vars. Auto-detects the sharded layout and
+    reassembles it — under ``sharding_fn`` (e.g. the next mesh's
+    CompiledBlock.param_sharding) each device shard is stitched from only
+    the overlapping files (restore-with-resharding: save dp=4, restore
+    dp=8/dp=1)."""
     scope = scope or global_scope()
+    from paddle_tpu.fluid import sharded_io
+    if not os.path.exists(os.path.join(dirname, _MANIFEST)) and \
+            sharded_io.is_sharded_dir(dirname):
+        return sharded_io.load_sharded(dirname, scope, vars=vars,
+                                       sharding_fn=sharding_fn)
     if vars is None:
         with open(os.path.join(dirname, _MANIFEST)) as f:
             vars = json.load(f)["vars"]
@@ -86,7 +108,12 @@ def load_vars(executor, dirname, main_program=None,
         path = os.path.join(dirname, name.replace("/", "__") + ".npy")
         if not os.path.exists(path):
             raise FileNotFoundError(f"no saved tensor for var {name!r} at {path}")
-        scope.set_var(name, jax.device_put(np.load(path)))
+        val = np.load(path)
+        target = sharding_fn(name) if sharding_fn is not None else None
+        if target is not None:
+            scope.set_var(name, jax.device_put(val, target))
+        else:
+            scope.set_var(name, jax.device_put(val))
         loaded.append(name)
     return loaded
 
@@ -200,10 +227,12 @@ class AsyncCheckpointer:
     Keeps at most `max_to_keep` serials like the reference's checkpoint
     dir rotation (io.py save_checkpoint serial handling)."""
 
-    def __init__(self, root_dir: str, max_to_keep: int = 3):
+    def __init__(self, root_dir: str, max_to_keep: int = 3, sharded=True):
         import threading
         self.root = root_dir
         self.max_to_keep = max_to_keep
+        self.sharded = sharded    # per-shard D2H + per-shard files; the
+        # full-gather np.asarray path is kept only for sharded=False
         self._thread = None
         self._error = None
         self._threading = threading
@@ -224,17 +253,26 @@ class AsyncCheckpointer:
         main_program = main_program or framework.default_main_program()
         scope = scope or global_scope()
         names = vars if vars is not None else _persistable_names(main_program)
-        snap = {}
-        for name in names:
-            v = scope.find_var(name)
-            if v is not None:
-                snap[name] = np.asarray(v)      # D2H copy happens here
+        if self.sharded:
+            from paddle_tpu.fluid import sharded_io
+            # D2H copies only this process's addressable shards — bytes
+            # owned, not model size (the reference pserver checkpoints its
+            # own shard the same way, go/pserver/service.go:47)
+            snap = sharded_io.snapshot_sharded(scope, names)
+            writer = sharded_io.save_sharded
+        else:
+            snap = {}
+            for name in names:
+                v = scope.find_var(name)
+                if v is not None:
+                    snap[name] = np.asarray(v)  # full D2H gather per var
+            writer = _write_snapshot_dir
 
-        def _write(snapshot=snap, serial=serial,
+        def _write(snapshot=snap, serial=serial, writer=writer,
                    on_complete=on_complete):
             try:
                 d = self._serial_dir(serial)
-                _write_snapshot_dir(d, snapshot)
+                writer(d, snapshot)
                 # mark complete LAST so partial dirs are never latest
                 with open(os.path.join(d, "_COMPLETE"), "w") as f:
                     f.write(str(serial))
@@ -273,15 +311,17 @@ class AsyncCheckpointer:
         return sorted(out)
 
     def restore(self, executor=None, serial: Optional[int] = None,
-                main_program=None, scope=None) -> int:
-        """Load the given (or latest complete) serial into the scope."""
+                main_program=None, scope=None, sharding_fn=None) -> int:
+        """Load the given (or latest complete) serial into the scope.
+        ``sharding_fn`` restores directly into a (possibly different)
+        mesh layout — save dp=4, restore dp=8."""
         self.wait()
         serials = self.serials()
         if not serials:
             raise FileNotFoundError(f"no complete checkpoints in {self.root}")
         serial = serial if serial is not None else serials[-1]
         load_vars(executor, self._serial_dir(serial), main_program,
-                  scope=scope)
+                  scope=scope, sharding_fn=sharding_fn)
         return serial
 
 

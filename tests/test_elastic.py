@@ -49,7 +49,10 @@ def test_elastic_crash_and_resume(tmp_path):
     main, startup, loss = _build()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
-    t1 = ElasticTrainer(work, paths, lease_timeout_s=0.2,
+    # lease generous vs chunk time: the master now expires leases with
+    # timer semantics (a finish after the deadline is stale), so a lease
+    # shorter than one chunk's compile+train would legitimately re-issue
+    t1 = ElasticTrainer(work, paths, lease_timeout_s=60.0,
                         checkpoint_every=1)
     with pytest.raises(RuntimeError, match="simulated"):
         t1.run(make_runner(exe, main, loss, trained_first, crash_after=3),
@@ -66,9 +69,9 @@ def test_elastic_crash_and_resume(tmp_path):
     main2, startup2, loss2 = _build()
     exe2 = fluid.Executor(fluid.CPUPlace())
     exe2.run(startup2)
-    import time
-    time.sleep(0.25)          # let the crashed worker's leases expire
-    t2 = ElasticTrainer(work, paths, lease_timeout_s=0.2,
+    # no expiry wait needed: recover() resets the crashed worker's
+    # pending leases straight back to todo (service.go:166 semantics)
+    t2 = ElasticTrainer(work, paths, lease_timeout_s=60.0,
                         checkpoint_every=1)
     restored = t2.restore_model(exe2, main_program=main2)
     assert restored is not None
