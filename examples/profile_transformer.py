@@ -1,0 +1,72 @@
+"""Device-time attribution for the transformer bench config — the
+docs/performance.md accounting loop. Run from repo root on TPU:
+    python examples/profile_transformer.py [--max-len 64] [--top 25]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--no-fused", action="store_true")
+    args = ap.parse_args()
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
+    from paddle_tpu.fluid import profiler
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        loss, _, feed_specs = models.transformer.build(
+            is_train=True, src_vocab=32000, tgt_vocab=32000,
+            max_len=args.max_len, fused_attention=not args.no_fused)
+        rewrite_program_amp(main_p)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    B = args.batch_size
+    feed = {n: rng.randint(1, 31999, [B if d == -1 else d for d in sh])
+            .astype("int64") for n, (sh, dt) in feed_specs.items()}
+    feeds = [feed] * args.steps
+
+    # warm up twice (multi-step recompile on 2nd call — SKILL.md)
+    for _ in range(2):
+        (lv,) = exe.run(main_p, feed=feeds, fetch_list=[loss.name],
+                        iterations=args.steps,
+                        stacked_feed=list(feed_specs))
+        float(np.asarray(lv).reshape(-1)[-1])
+
+    import time
+    trace_dir = tempfile.mkdtemp(prefix="tf_trace_")
+    profiler.start_profiler(trace_dir=trace_dir)
+    t0 = time.perf_counter()
+    (lv,) = exe.run(main_p, feed=feeds, fetch_list=[loss.name],
+                    iterations=args.steps, stacked_feed=list(feed_specs))
+    float(np.asarray(lv).reshape(-1)[-1])
+    dt = time.perf_counter() - t0
+    profiler.stop_profiler(trace_dir=trace_dir)
+
+    toks = 2 * B * args.max_len * args.steps
+    print(f"\n== {args.steps} steps in {dt:.3f}s = "
+          f"{dt / args.steps * 1e3:.2f} ms/step, "
+          f"{toks / dt:,.0f} tokens/sec ==\n")
+    profiler.print_device_op_stats(trace_dir, top=args.top)
+
+
+if __name__ == "__main__":
+    main()

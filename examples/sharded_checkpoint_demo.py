@@ -52,7 +52,6 @@ def feeds(step):
 def main():
     ndev = len(jax.devices())
     save_dp, restore_dp = (4, 8) if ndev >= 8 else (1, 1)
-    prog, startup, loss = build(), None, None
     prog, startup, loss = build()
 
     def dist(n):

@@ -161,7 +161,8 @@ def _ge(a, b):
 
 
 AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
-                "conv2d_transpose", "fc", "fused_linear_ce")
+                "conv2d_transpose", "fc", "fused_linear_ce",
+                "fused_attention_block")
 
 
 RECURRENT_OPS = ("dynamic_lstm", "dynamic_gru", "dynamic_lstmp", "while",

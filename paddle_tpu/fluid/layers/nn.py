@@ -596,6 +596,45 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
     return out
 
 
+def fused_multi_head_attention(q_in, kv_in, d_model, n_head, causal=False,
+                               dropout_prob=0.0, param_attr=None,
+                               name=None):
+    """Whole attention block — q/k/v/out projections + scaled-dot
+    attention — as ONE fused op (ops/attention_block.py): the custom VJP
+    is spelled so no [B,T,H,D]↔[B,H,T,D] relayout is ever materialized,
+    forward or backward (the composed graph's measured 7.4 ms/step copy
+    band on Transformer-base, docs/performance.md). q_in [B,Tq,M],
+    kv_in [B,Tk,M] (same var for self-attention) → [B,Tq,M].
+
+    The reference composes this from fc+reshape+transpose+matmul+softmax
+    (benchmark transformer prep); parameter names follow the fc
+    convention so checkpoints keep the per-projection layout."""
+    helper = LayerHelper("fused_multi_head_attention", name=name)
+    if isinstance(param_attr, (list, tuple)):
+        attrs4 = list(param_attr)           # one ParamAttr per projection
+    elif param_attr is None:
+        attrs4 = [None] * 4
+    else:
+        import copy
+        attrs4 = []
+        for tag in ("wq", "wk", "wv", "wo"):
+            a = copy.deepcopy(param_attr)
+            if a.name is not None:
+                a.name = f"{a.name}.{tag}"
+            attrs4.append(a)
+    ws = [helper.create_parameter(a, shape=[d_model, d_model],
+                                  dtype="float32") for a in attrs4]
+    out = helper.create_variable_for_type_inference(q_in.dtype)
+    helper.append_op("fused_attention_block",
+                     inputs={"Xq": [q_in], "Xkv": [kv_in],
+                             "Wq": [ws[0]], "Wk": [ws[1]],
+                             "Wv": [ws[2]], "Wo": [ws[3]]},
+                     outputs={"Out": [out]},
+                     attrs={"n_head": int(n_head), "causal": bool(causal),
+                            "dropout_prob": float(dropout_prob)})
+    return out
+
+
 def fused_linear_cross_entropy(input, label, num_classes, label_smoothing=0.0,
                                ignore_index=-100, param_attr=None,
                                name=None):

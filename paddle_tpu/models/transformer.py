@@ -48,31 +48,28 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
     # 42.9 vs 39.6 ms/step — the split's copies eat the bigger-matmul
     # win; see docs/performance.md transformer accounting)
     d_k = d_model // n_head
+    if fused:
+        # the fused block expresses causality via `causal`; an additive
+        # mask would be silently ignored - fail loudly instead
+        assert mask is None, (
+            "fused attention takes causal=True, not an additive mask")
+        # ONE fused op spanning the projections AND the attention dots
+        # (layers.fused_multi_head_attention → ops/attention_block.py):
+        # its custom VJP is spelled so no [B,T,H,D]↔[B,H,T,D] relayout
+        # ever materializes, forward or backward — the composed bthd
+        # graph still paid ~7.4 ms/step of backward-grad relayouts on
+        # Transformer-base bs128 (docs/performance.md accounting). With
+        # an sp mesh axis the op falls back to ring/Ulysses sequence-
+        # parallel attention. Attention-weight dropout runs inside
+        # (hash-derived keep mask regenerated in the backward), matching
+        # the unfused graph's softmax→dropout→matmul semantics.
+        return layers.fused_multi_head_attention(
+            q_in, kv_in, d_model, n_head, causal=causal,
+            dropout_prob=dropout)
+
     q = layers.fc(q_in, size=d_model, num_flatten_dims=2, bias_attr=False)
     k = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
     v = layers.fc(kv_in, size=d_model, num_flatten_dims=2, bias_attr=False)
-
-    if fused:
-        # fused (and, with an sp mesh axis, ring/Ulysses sequence-parallel)
-        # attention; attention-weight dropout runs INSIDE the fused/flash
-        # kernels (hash-derived keep mask regenerated in the backward —
-        # ops/pallas/flash_attention.py), matching the unfused graph's
-        # softmax→dropout→matmul semantics in expectation. layout="bthd":
-        # the head split is a FREE reshape ([B,L,D] -> [B,L,H,dk]); XLA
-        # folds the head transposition into the attention einsums instead
-        # of materializing [B,H,L,dk] copies (measured ~7 ms/step of
-        # reshape/copy traffic on Transformer-base bs128 v5e)
-        def split_heads_free(x):
-            return layers.reshape(x, shape=[0, 0, n_head, d_k])
-
-        q, k, v = split_heads_free(q), split_heads_free(k), \
-            split_heads_free(v)
-        ctx = layers.scaled_dot_product_attention(q, k, v, causal=causal,
-                                                  dropout_prob=dropout,
-                                                  layout="bthd")
-        ctx = layers.reshape(ctx, shape=[0, 0, d_model])
-        return layers.fc(ctx, size=d_model, num_flatten_dims=2,
-                         bias_attr=False)
 
     def split_heads(x):
         # [B, L, D] -> [B, H, L, dk]

@@ -619,6 +619,11 @@ def _fused_linear_ce(ctx, ins, attrs):
                                    pk.interpret_mode())
         return {"Loss": [loss]}
     logits = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    if attrs.get("__amp_bf16__"):
+        # the [N, V] logits cross to the CE fusions in storage dtype —
+        # fp32 doubled every pass over the ~0.5 GB tensor (measured ~3
+        # ms/step on transformer_big); CE math still reduces in fp32
+        logits = logits.astype(x.dtype)
     outs = _softmax_with_cross_entropy(
         ctx, {"Logits": [logits], "Label": [label]},
         {"label_smoothing": eps, "ignore_index": ignore})
@@ -729,6 +734,90 @@ def _pad(ctx, ins, attrs):
 # configured, the op partitions its time dim over the mesh: ring attention /
 # Ulysses, parallel/ring_attention.py — the long-context capability)
 # ---------------------------------------------------------------------------
+
+@register_op("fused_attention_block",
+             ref="composed: mul+transpose+matmul+softmax ops; TPU-native "
+                 "fused projection+attention block (zero-relayout VJP, "
+                 "ops/attention_block.py)")
+def _fused_attention_block(ctx, ins, attrs):
+    """inputs: Xq [B,Tq,M], Xkv [B,Tk,M], Wq/Wk/Wv/Wo [M,M].
+    attrs: n_head, causal, dropout_prob. One custom-VJP region covering
+    the q/k/v/out projections AND the attention dots, spelled so neither
+    forward nor backward materializes a single layout copy (the measured
+    7.4 ms/step relayout band of the composed path — docs/performance.md
+    Transformer-base accounting). With a mesh sp axis configured, falls
+    back to the projections + sequence-parallel ring/Ulysses attention
+    (the relayout cost is negligible next to the ring collectives)."""
+    from paddle_tpu.ops import attention_block as ab
+
+    x_q, x_kv = first(ins, "Xq"), first(ins, "Xkv")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    n_head = int(attrs["n_head"])
+    causal = bool(attrs.get("causal", False))
+    dropout_p = float(attrs.get("dropout_prob") or 0.0)
+    if ctx.is_test or attrs.get("is_test"):
+        dropout_p = 0.0
+    seed = jnp.zeros((1,), jnp.int32)
+    if dropout_p > 0:
+        seed = jax.random.randint(ctx.step_key(), (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+
+    mesh = ctx.mesh
+    sp_axis = getattr(ctx.dist, "sp_axis", None)
+    t_q, t_k = x_q.shape[1], x_kv.shape[1]
+    if (mesh is not None and sp_axis and sp_axis in mesh.axis_names
+            and mesh.shape[sp_axis] > 1 and t_q == t_k
+            and t_q % mesh.shape[sp_axis] == 0):
+        from paddle_tpu.parallel import ring_attention as ra
+        h = n_head
+        m = x_q.shape[-1]
+        d = m // h
+        def sp_proj(x, w):
+            # fp32 MXU accumulation like every other attention path
+            return jax.lax.dot_general(
+                x, w.reshape(m, h, d), (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32
+                ).astype(x_q.dtype).transpose(0, 2, 1, 3)
+        q, k, v = sp_proj(x_q, wq), sp_proj(x_kv, wk), sp_proj(x_kv, wv)
+        o = ra.sp_attention(q, k, v, mesh, sp_axis, causal=causal,
+                            scale=float(d) ** -0.5,
+                            impl=attrs.get("sp_impl", "ring"),
+                            batch_axis=getattr(ctx.dist, "data_axis", None),
+                            head_axis=getattr(ctx.dist, "model_axis", None),
+                            dropout_p=dropout_p, seed=seed)
+        o = o.transpose(0, 2, 1, 3).reshape(x_q.shape[0], t_q, m)
+        out = jnp.matmul(o, wo.astype(o.dtype))
+        return single(out)
+
+    # long-context: route the dots through the Pallas flash kernels (same
+    # thresholds as parallel/ring_attention.full_attention — measured
+    # faster than XLA from T≈4096, O(T·D) HBM instead of O(T²)); the
+    # bthd↔bhtd transposes are negligible at these lengths
+    h = n_head
+    m = x_q.shape[-1]
+    d = m // h
+    from paddle_tpu.ops import pallas as pk
+    if pk.kernel_enabled(128, d) and t_q >= 2048:
+        bq, bk = pk.pick_blocks(t_q, t_k)
+        if bq and bk:
+            def proj_bhtd(x, w):
+                y = jax.lax.dot_general(x, w.reshape(m, h, d),
+                                        (((2,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32
+                                        ).astype(x.dtype)
+                return y.transpose(0, 2, 1, 3)
+            q = proj_bhtd(x_q, wq)
+            k = proj_bhtd(x_kv, wk)
+            v = proj_bhtd(x_kv, wv)
+            o = pk.flash_attention(q, k, v, causal, float(d) ** -0.5,
+                                   bq, bk, False, dropout_p,
+                                   seed if dropout_p > 0 else None)
+            o = o.transpose(0, 2, 1, 3).reshape(x_q.shape[0], t_q, m)
+            return single(jnp.matmul(o, wo.astype(o.dtype)))
+
+    return single(ab.attention_block(x_q, x_kv, wq, wk, wv, wo, seed,
+                                     n_head, causal, dropout_p))
+
 
 @register_op("attention", ref="composed: matmul+softmax ops; TPU-native "
                               "fused/sequence-parallel redesign")

@@ -313,6 +313,21 @@ class DistributeConfig:
                 sh = param_shape(w)
                 if sh is not None and len(sh) == 2 and sh[1] % size == 0:
                     propose(w, (None, ax), "matmul")
+            elif op.type == "fused_attention_block":
+                # the fused block's four projections shard like the fc's
+                # they replaced: column-parallel [*, tp] (heads split
+                # over tp via the output-feature dim; the dots' (b, h)
+                # batch dims then partition over tp under GSPMD). Wo
+                # contracts its FIRST dim against the tp-sharded ctx
+                # features, so it row-shards [tp, *] — the megatron
+                # pairing that keeps the block's interior collective-free
+                for slot, axes in (("Wq", (None, ax)), ("Wk", (None, ax)),
+                                   ("Wv", (None, ax)), ("Wo", (ax, None))):
+                    w = (ins.get(slot) or [None])[0]
+                    sh = param_shape(w)
+                    if sh is not None and len(sh) == 2 \
+                            and sh[0 if axes[0] else 1] % size == 0:
+                        propose(w, axes, "matmul")
             elif op.type in ("lookup_table", "lookup_sparse_table",
                              "fused_embedding_seq_pool"):
                 w = (ins.get("W") or [None])[0]

@@ -155,6 +155,21 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
         if attrs.get("causal"):
             f *= 0.5
         return f
+    if op_type == "fused_attention_block":
+        # projections (4 × [B,T,M]·[M,M]) + attention dots (QKᵀ + PV)
+        xq, xkv = ishape("Xq"), ishape("Xkv")
+        w = ishape("Wq")
+        if xq is None or xkv is None or w is None:
+            return 0.0
+        b, tq, m = xq[-3], xq[-2], xq[-1]
+        tk = xkv[-2]
+        h = int(attrs.get("n_head", 1))
+        d = m // max(h, 1)
+        proj = 2.0 * b * m * m * (tq + 2.0 * tk + tq)   # q, k, v, out
+        dots = 2.0 * b * h * tq * tk * d * 2.0
+        if attrs.get("causal"):
+            dots *= 0.5
+        return proj + dots
     if op_type in ("dynamic_lstm", "dynamic_lstmp"):
         x = ishape("Input")              # [B, T, 4D] (pre-projected gates)
         if x is None:

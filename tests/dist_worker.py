@@ -127,20 +127,37 @@ def main():
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
 
+    # optional per-process span capture for the merged-timeline test
+    # (reference: tools/timeline.py:27-30 merges trainer1=f1,trainer2=f2)
+    import contextlib
+    spans_dir = os.environ.get("PADDLE_TEST_SPANS_DIR")
+    if spans_dir:
+        from paddle_tpu.fluid import profiler
+        profiler.start_profiler()
+        step_event = profiler.record_event
+    else:
+        step_event = lambda name: contextlib.nullcontext()  # noqa: E731
+
     # every process feeds the SAME global batch (jit with in_shardings
     # splits it over the dp axis; each process computes its shard)
     if model == "mlp" and not local_only:
         # exercise the multi-host MULTI-STEP path: the whole run is one
         # device-side scan over a stacked feed list (exe.run iterations=N
         # with global arrays built per process)
-        (lvs,) = exe.run(run_target, feed=[feed] * steps,
-                         fetch_list=[loss.name], iterations=steps)
+        with step_event(f"rank{rank}/train_scan_{steps}_steps"):
+            (lvs,) = exe.run(run_target, feed=[feed] * steps,
+                             fetch_list=[loss.name], iterations=steps)
         losses = [float(v) for v in np.asarray(lvs).reshape(-1)]
     else:
         losses = []
-        for _ in range(steps):
-            (lv,) = exe.run(run_target, feed=feed, fetch_list=[loss.name])
+        for i in range(steps):
+            with step_event(f"rank{rank}/step_{i}"):
+                (lv,) = exe.run(run_target, feed=feed,
+                                fetch_list=[loss.name])
             losses.append(float(np.asarray(lv).reshape(())))
+    if spans_dir:
+        profiler.export_spans(os.path.join(spans_dir,
+                                           f"spans_rank{rank}.csv"))
     print("RESULT " + json.dumps({"rank": rank, "losses": losses}),
           flush=True)
 

@@ -192,7 +192,8 @@ def test_fused_transformer_no_warning_and_test_mode_clean():
                 is_train=True, max_len=16, src_vocab=64, tgt_vocab=64,
                 d_model=32, d_inner=32, n_head=2, n_layer=1,
                 fused_attention=True)
-    assert any(op.type == "attention" and op.attrs.get("dropout_prob")
+    assert any(op.type in ("attention", "fused_attention_block")
+               and op.attrs.get("dropout_prob")
                for op in main.desc.global_block.ops)
 
 

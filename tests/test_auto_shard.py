@@ -131,3 +131,57 @@ def test_dryrun_multichip_regex_free():
     src = inspect.getsource(ge.dryrun_multichip)
     assert "param_axes" not in src
     ge.dryrun_multichip(8)
+
+
+def test_auto_shard_fused_attention_block():
+    """The fused attention block's projections shard like the fc's they
+    replaced: Wq/Wk/Wv column-parallel (None, tp), Wo row-parallel
+    (tp, None) — the megatron pairing; without this rule the tp configs
+    the transformer docstring advertises would silently replicate all
+    attention weights (round-4 review finding)."""
+    import numpy as np
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.core.lowering import CompiledBlock
+    from paddle_tpu.parallel.mesh import DistributeConfig, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8, 16], dtype="float32")
+        y = layers.data(name="y", shape=[8, 16], dtype="float32")
+        out = layers.fused_multi_head_attention(x, x, 16, 2, causal=True)
+        loss = layers.mean(layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    dist = DistributeConfig(mesh=mesh, data_axis="dp", model_axis="tp",
+                            auto_shard=True)
+    cb = CompiledBlock(main.desc, 0, ["x", "y"], [loss.name], dist=dist)
+    specs = {}
+    for op in main.desc.global_block.ops:
+        if op.type == "fused_attention_block":
+            for slot in ("Wq", "Wk", "Wv", "Wo"):
+                name = op.inputs[slot][0]
+                specs[slot] = cb.param_sharding(name).spec
+    assert specs["Wq"] == P(None, "tp"), specs
+    assert specs["Wk"] == P(None, "tp"), specs
+    assert specs["Wv"] == P(None, "tp"), specs
+    assert specs["Wo"] == P("tp", None), specs
+
+    # and the sharded program actually trains
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    prog = fluid.CompiledProgram(main).with_sharding(dist)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 8, 16).astype(np.float32),
+            "y": rng.rand(8, 8, 16).astype(np.float32)}
+    (lv,) = exe.run(prog, feed=feed, fetch_list=[loss.name], scope=scope)
+    assert np.isfinite(float(np.asarray(lv).reshape(())))
+    w = scope.find_var(
+        [op.inputs["Wq"][0] for op in main.desc.global_block.ops
+         if op.type == "fused_attention_block"][0])
+    assert w.sharding.spec == P(None, "tp")

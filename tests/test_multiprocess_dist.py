@@ -131,3 +131,39 @@ def test_two_process_transformer_dp_loss_curve_parity():
     # across the dp all-reduce allows small drift)
     np.testing.assert_allclose(l0, base, rtol=2e-3, atol=2e-3)
     assert l0[-1] < l0[0], l0
+
+
+def test_merged_multi_trainer_timeline(tmp_path):
+    """tools/timeline.py merges per-trainer span files into ONE chrome
+    trace with a pid lane per trainer (reference: tools/timeline.py:27-30
+    accepts 'trainer1=f1,trainer2=f2,ps=f3') — the observability story
+    for the multi-process training this suite exercises."""
+    spans_dir = str(tmp_path)
+    _run_workers(2, "mlp", 6,
+                 extra_env={"PADDLE_TEST_SPANS_DIR": spans_dir})
+    files = sorted(os.listdir(spans_dir))
+    assert files == ["spans_rank0.csv", "spans_rank1.csv"], files
+
+    from tools.timeline import merge_span_files, parse_profile_paths
+    arg = ",".join(f"trainer{r}={os.path.join(spans_dir, f)}"
+                   for r, f in enumerate(files))
+    named = parse_profile_paths(arg)
+    assert [n for n, _ in named] == ["trainer0", "trainer1"]
+    trace = merge_span_files(named)
+
+    lanes = {e["pid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert lanes == {0, 1}
+    labels = {e["pid"]: e["args"]["name"] for e in trace["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert labels == {0: "trainer0", 1: "trainer1"}
+    # each lane carries that rank's training span(s)
+    for pid, label in labels.items():
+        rank_events = [e["name"] for e in trace["traceEvents"]
+                       if e["ph"] == "X" and e["pid"] == pid]
+        assert rank_events and all(
+            n.startswith(f"rank{pid}/") for n in rank_events), rank_events
+
+    # single-file form still works (no metadata lane)
+    single = merge_span_files(parse_profile_paths(
+        os.path.join(spans_dir, files[0])))
+    assert all(e["ph"] == "X" for e in single["traceEvents"])

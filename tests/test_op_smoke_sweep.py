@@ -194,6 +194,11 @@ spec("unsqueeze2", X23, {"axes": [1]})
 # --- nn_ops ----------------------------------------------------------------
 spec("attention", {"Q": [f(1, 2, 4, 4)], "K": [f(1, 2, 4, 4, seed=1)],
                    "V": [f(1, 2, 4, 4, seed=2)]}, {"causal": True})
+spec("fused_attention_block",
+     {"Xq": [f(2, 4, 8)], "Xkv": [f(2, 4, 8, seed=1)],
+      "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
+      "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)]},
+     {"n_head": 2, "causal": True})
 spec("batch_norm", {"X": [f(2, 3, 4, 4)], "Scale": [pos(3)],
                     "Bias": [f(3, seed=1)], "Mean": [f(3, seed=2)],
                     "Variance": [pos(3, seed=3)]}, {"is_test": False})

@@ -1,13 +1,20 @@
 #!/usr/bin/env python
 """Chrome-trace timeline exporter (reference: tools/timeline.py — converts
-the profiler proto to chrome://tracing JSON; here the host event spans
-recorded by paddle_tpu.fluid.profiler become trace events directly, and
-device-side traces come from jax.profiler's TensorBoard/Perfetto dump,
-which already IS a timeline — this tool covers the host half).
+profiler protos to chrome://tracing JSON; its --profile_path accepts
+EITHER one file OR a 'name=file,name=file' list and merges multiple
+trainers/pservers into one timeline with per-process lanes, reference
+tools/timeline.py:27-30. Here the host event spans recorded by
+paddle_tpu.fluid.profiler become trace events directly; device-side
+traces come from jax.profiler's TensorBoard/Perfetto dump, which already
+IS a timeline — this tool covers the host half).
 
 Usage:
     python tools/timeline.py --profile_path spans.csv --timeline_path out.json
-or programmatically: profiler.export_chrome_trace(path)."""
+    python tools/timeline.py \
+        --profile_path trainer0=a.csv,trainer1=b.csv,ps=c.csv \
+        --timeline_path merged.json
+or programmatically: profiler.export_chrome_trace(path) /
+merge_span_files([...])."""
 
 from __future__ import annotations
 
@@ -18,17 +25,56 @@ import json
 from paddle_tpu.fluid.profiler import spans_to_chrome_trace
 
 
+def _read_spans(path):
+    with open(path, newline="") as f:
+        return [row for row in csv.reader(f) if len(row) >= 3]
+
+
+def parse_profile_paths(arg: str):
+    """'file' -> [(None, file)]; 'n1=f1,n2=f2' -> [(n1, f1), (n2, f2)]
+    (the reference's argument grammar, tools/timeline.py:27-30)."""
+    if "=" not in arg:
+        return [(None, arg)]
+    out = []
+    for part in arg.split(","):
+        if not part:
+            continue
+        name, _, path = part.partition("=")
+        if not path:
+            raise ValueError(
+                f"bad --profile_path segment {part!r}: want name=file")
+        out.append((name, path))
+    return out
+
+
+def merge_span_files(named_paths):
+    """[(label, span_csv_path), ...] → one chrome trace dict with one pid
+    lane per input file, labeled via process_name metadata events."""
+    events = []
+    for pid, (label, path) in enumerate(named_paths):
+        trace = spans_to_chrome_trace(_read_spans(path), pid=pid)
+        events.extend(trace["traceEvents"])
+        if label is not None:
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": label}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile_path", required=True,
-                    help="span csv written by profiler.export_spans")
+                    help="span csv from profiler.export_spans, or a "
+                         "comma list trainer0=a.csv,trainer1=b.csv to "
+                         "merge multiple processes into one timeline")
     ap.add_argument("--timeline_path", required=True)
     args = ap.parse_args()
-    with open(args.profile_path, newline="") as f:
-        spans = [row for row in csv.reader(f) if len(row) >= 3]
+    named = parse_profile_paths(args.profile_path)
+    trace = merge_span_files(named)
     with open(args.timeline_path, "w") as f:
-        json.dump(spans_to_chrome_trace(spans), f)
-    print(f"wrote {args.timeline_path} ({len(spans)} events) — open in "
+        json.dump(trace, f)
+    n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print(f"wrote {args.timeline_path} ({n} events, {len(named)} "
+          f"process lane{'s' if len(named) != 1 else ''}) — open in "
           f"chrome://tracing or ui.perfetto.dev")
 
 
