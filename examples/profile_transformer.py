@@ -61,7 +61,8 @@ def main():
     dt = time.perf_counter() - t0
     profiler.stop_profiler(trace_dir=trace_dir)
 
-    toks = 2 * B * args.max_len * args.steps
+    # bench.py convention: tokens/step = batch * max_len (single-sided)
+    toks = B * args.max_len * args.steps
     print(f"\n== {args.steps} steps in {dt:.3f}s = "
           f"{dt / args.steps * 1e3:.2f} ms/step, "
           f"{toks / dt:,.0f} tokens/sec ==\n")

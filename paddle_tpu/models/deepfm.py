@@ -17,20 +17,24 @@ from paddle_tpu.fluid import layers
 
 def deepfm(field_ids, num_fields, vocab_size, embed_dim=16,
            hidden_sizes=(400, 400, 400), name="deepfm"):
-    # first-order term: per-id scalar weight
-    w1 = layers.embedding(
-        field_ids, size=[vocab_size, 1],
-        param_attr=fluid.ParamAttr(
-            name=name + "_w1",
-            initializer=fluid.initializer.Uniform(-0.01, 0.01)))
-    first_order = layers.reduce_sum(w1, dim=1)          # [B, 1]
-
-    # second-order FM term over field embeddings [B, F, K]
-    emb = layers.embedding(
-        field_ids, size=[vocab_size, embed_dim],
+    # ONE combined table [V, 1+K]: column 0 is the first-order per-id
+    # scalar weight, columns 1..K the FM/deep embedding — one gather (and
+    # one backward scatter-add) instead of two with identical math and
+    # init. On v5e the gather is latency-bound (measured 1-9 GB/s
+    # effective, docs/performance.md DeepFM roofline), so halving gather
+    # count is the dominant lever: 2.14 -> ~1.5 ms/step device.
+    # (reference keeps separate w1/emb tables, dist_ctr-era DeepFM; the
+    # pserver prefetch protocol made per-table splits free there)
+    both = layers.embedding(
+        field_ids, size=[vocab_size, 1 + embed_dim],
         param_attr=fluid.ParamAttr(
             name=name + "_emb",
             initializer=fluid.initializer.Uniform(-0.01, 0.01)))
+    w1 = layers.slice(both, axes=[2], starts=[0], ends=[1])
+    first_order = layers.reduce_sum(w1, dim=1)          # [B, 1]
+
+    # second-order FM term over field embeddings [B, F, K]
+    emb = layers.slice(both, axes=[2], starts=[1], ends=[1 + embed_dim])
     sum_emb = layers.reduce_sum(emb, dim=1)             # [B, K]
     sum_sq = layers.square(sum_emb)
     sq_emb = layers.square(emb)

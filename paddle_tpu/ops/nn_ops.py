@@ -752,6 +752,7 @@ def _fused_attention_block(ctx, ins, attrs):
 
     x_q, x_kv = first(ins, "Xq"), first(ins, "Xkv")
     wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    x_q, x_kv, wq, wk, wv, wo = _amp_cast(attrs, x_q, x_kv, wq, wk, wv, wo)
     n_head = int(attrs["n_head"])
     causal = bool(attrs.get("causal", False))
     dropout_p = float(attrs.get("dropout_prob") or 0.0)
@@ -786,8 +787,10 @@ def _fused_attention_block(ctx, ins, attrs):
                             head_axis=getattr(ctx.dist, "model_axis", None),
                             dropout_p=dropout_p, seed=seed)
         o = o.transpose(0, 2, 1, 3).reshape(x_q.shape[0], t_q, m)
-        out = jnp.matmul(o, wo.astype(o.dtype))
-        return single(out)
+        out = jnp.matmul(o, wo.astype(o.dtype),
+                         preferred_element_type=jnp.float32
+                         ).astype(o.dtype)
+        return single(_amp_out(out, attrs))
 
     # long-context: route the dots through the Pallas flash kernels (same
     # thresholds as parallel/ring_attention.full_attention — measured
@@ -813,10 +816,14 @@ def _fused_attention_block(ctx, ins, attrs):
                                    bq, bk, False, dropout_p,
                                    seed if dropout_p > 0 else None)
             o = o.transpose(0, 2, 1, 3).reshape(x_q.shape[0], t_q, m)
-            return single(jnp.matmul(o, wo.astype(o.dtype)))
+            out = jnp.matmul(o, wo.astype(o.dtype),
+                             preferred_element_type=jnp.float32
+                             ).astype(o.dtype)
+            return single(_amp_out(out, attrs))
 
-    return single(ab.attention_block(x_q, x_kv, wq, wk, wv, wo, seed,
-                                     n_head, causal, dropout_p))
+    return single(_amp_out(
+        ab.attention_block(x_q, x_kv, wq, wk, wv, wo, seed,
+                           n_head, causal, dropout_p), attrs))
 
 
 @register_op("attention", ref="composed: matmul+softmax ops; TPU-native "

@@ -51,9 +51,11 @@ def test_deepfm_sharded_embedding_matches_replicated():
     ref, _ = _train(main, startup, loss)
 
     mesh = make_mesh({"dp": 2, "tp": 4})
+    # deepfm now holds first-order weights + embeddings in ONE combined
+    # [V, 1+K] table (models/deepfm.py) — a single row-sharding rule
+    # covers both terms
     dist = DistributeConfig(mesh=mesh, data_axis="dp", model_axis="tp",
-                            param_axes={r"deepfm_emb": ("tp", None),
-                                        r"deepfm_w1": ("tp", None)})
+                            param_axes={r"deepfm_emb": ("tp", None)})
     got, scope = _train(main, startup, loss, dist=dist)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
     # the table must actually be laid out sharded over tp
