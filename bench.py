@@ -4,8 +4,9 @@ Mirrors the reference's fluid_benchmark CLI capability
 (reference: benchmark/fluid/fluid_benchmark.py:139 train_parallel — reports
 images/sec or words/sec averaged over steps) on TPU.
 
-DEFAULT (no --model): the FULL sweep — one JSON line per model row (12
-train + 3 infer) as each finishes, then one aggregate JSON line
+DEFAULT (no --model): the FULL sweep — one JSON line per model row (13
+train + 3 infer + 1 serving cold-start) as each finishes, then one
+compact aggregate JSON line
 {"metric": "full sweep ...", "value": <headline resnet50 img/s>,
  "unit": ..., "vs_baseline": N, "mfu_pct": N, "rows": [...]}
 whose rows[] carry the whole table with mfu_pct filled per row.
@@ -362,6 +363,79 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
     }
 
 
+def run_coldstart_bench(model_name: str = "resnet50",
+                        batch_size: int = 16):
+    """Serving cold-start: load->first-inference latency with the
+    persisted AOT executable vs recompile-from-source (reference:
+    analysis_predictor.cc model-load path starts serving from a
+    deserialized program; Predictor.save_compiled/load_compiled give the
+    TPU analogue by serializing the compiled XLA executable next to the
+    StableHLO export)."""
+    import tempfile
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    if model_name != "resnet50":
+        raise ValueError("--coldstart benchmarks the resnet50 serving "
+                         f"path; {model_name!r} has no cold-start row")
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        img = fluid.layers.data(name="data", shape=[3, 224, 224],
+                                dtype="float32")
+        prob = fluid.layers.softmax(models.resnet.resnet(
+            img, 1000, depth=50, is_train=False))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.rand(batch_size, 3, 224, 224).astype(np.float32)}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        fluid.io.save_inference_model(tmp, ["data"], [prob], exe,
+                                      main_program=main_p)
+        config = AnalysisConfig()
+        config.model_dir = tmp
+
+        def make_pred():
+            # same amp-bf16 + NHWC serving config as the infer rows (the
+            # fp32-NCHW resnet compile is pathologically slow on this
+            # stack and is not a config anyone serves)
+            pred = create_paddle_predictor(config)
+            from paddle_tpu.contrib.mixed_precision import \
+                rewrite_program_amp
+            from paddle_tpu.contrib.layout import rewrite_program_nhwc
+            rewrite_program_amp(pred._program)
+            rewrite_program_nhwc(pred._program)
+            return pred
+
+        # path A: compile from source at first inference
+        pred_a = make_pred()
+        t0 = time.time()
+        out_a = pred_a.run(batch)
+        t_compile = time.time() - t0
+        pred_a.save_compiled(tmp, batch)
+
+        # path B: deserialize the persisted executable, no compiler
+        pred_b = make_pred()
+        t0 = time.time()
+        assert pred_b.load_compiled(tmp)
+        out_b = pred_b.run(batch)
+        t_aot = time.time() - t0
+        np.testing.assert_allclose(out_a[0], out_b[0], rtol=2e-3,
+                                   atol=2e-3)   # bf16 serving config
+
+    return {
+        "metric": f"{model_name} serving cold-start, AOT-load -> first "
+                  f"inference (bs{batch_size}, 1 chip)",
+        "value": round(t_aot, 3), "unit": "seconds",
+        "vs_baseline": None,
+        "compile_from_source_s": round(t_compile, 3),
+        "speedup": round(t_compile / t_aot, 1) if t_aot else None,
+    }
+
+
 def aggregate_line(rows, head, n_ok):
     """The sweep aggregate is the FINAL stdout line and must survive the
     driver's tail-window capture (round-3 verdict item 6: BENCH_r03
@@ -414,6 +488,9 @@ def main():
     ap.add_argument("--headline", action="store_true",
                     help="run only the headline resnet50 row (the pre-r3 "
                          "default; the default is now the full sweep)")
+    ap.add_argument("--coldstart", action="store_true",
+                    help="serving cold-start row: AOT executable load vs "
+                         "recompile-from-source (resnet50)")
     ap.add_argument("--infer", action="store_true",
                     help="benchmark the deployment/inference path "
                          "(save_inference_model -> AnalysisPredictor)")
@@ -425,7 +502,7 @@ def main():
                     "rewrite (contrib.layout)")
     args = ap.parse_args()
 
-    def run_one_subprocess(m, infer=False):
+    def run_one_subprocess(m, infer=False, coldstart=False):
         # one subprocess per model: a fresh backend per run keeps a
         # pathological compile (googlenet-style) or OOM from taking
         # the whole sweep down. Every non-sweep flag forwards.
@@ -436,6 +513,8 @@ def main():
             cmd.append("--no-nhwc")
         if infer:
             cmd.append("--infer")
+        if coldstart:
+            cmd.append("--coldstart")
         if args.batch_size:
             cmd += ["--batch-size", str(args.batch_size)]
         if args.steps:
@@ -467,7 +546,8 @@ def main():
         for m in models_:
             run_one_subprocess(m, infer=args.infer)
         return
-    if args.model is None and not args.headline and not args.infer:
+    if args.model is None and not args.headline and not args.infer \
+            and not args.coldstart:
         # DEFAULT: the FULL sweep — every train model plus the three
         # deployment-path rows, one JSON line each as they finish, then
         # one aggregate line (driver schema + rows[]) so the driver
@@ -481,6 +561,7 @@ def main():
         rows = [run_one_subprocess(m) for m in order]
         rows += [run_one_subprocess(m, infer=True)
                  for m in ("resnet50", "vgg", "googlenet")]
+        rows.append(run_one_subprocess("resnet50", coldstart=True))
         head = next((r for r in rows if r.get("value") is not None
                      and r["metric"].startswith("resnet50 train")),
                     next((r for r in rows if r.get("value") is not None),
@@ -491,6 +572,10 @@ def main():
         return
     if args.model is None:
         args.model = "resnet50"
+    if args.coldstart:
+        print(json.dumps(run_coldstart_bench(args.model or "resnet50",
+                                             args.batch_size or 16)))
+        return
     if args.infer:
         infer_bs = {"resnet50": 16, "vgg": 1, "googlenet": 16}
         if args.model not in infer_bs:

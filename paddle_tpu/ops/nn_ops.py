@@ -758,6 +758,7 @@ def _fused_attention_block(ctx, ins, attrs):
     dropout_p = float(attrs.get("dropout_prob") or 0.0)
     if ctx.is_test or attrs.get("is_test"):
         dropout_p = 0.0
+    amp = attrs.get("__amp_bf16__", False)
     seed = jnp.zeros((1,), jnp.int32)
     if dropout_p > 0:
         seed = jax.random.randint(ctx.step_key(), (1,), 0, 2 ** 31 - 1,
@@ -790,7 +791,7 @@ def _fused_attention_block(ctx, ins, attrs):
         out = jnp.matmul(o, wo.astype(o.dtype),
                          preferred_element_type=jnp.float32
                          ).astype(o.dtype)
-        return single(_amp_out(out, attrs))
+        return single(_amp_out(out, attrs) if amp else out)
 
     # long-context: route the dots through the Pallas flash kernels (same
     # thresholds as parallel/ring_attention.full_attention — measured
@@ -819,11 +820,11 @@ def _fused_attention_block(ctx, ins, attrs):
             out = jnp.matmul(o, wo.astype(o.dtype),
                              preferred_element_type=jnp.float32
                              ).astype(o.dtype)
-            return single(_amp_out(out, attrs))
+            return single(_amp_out(out, attrs) if amp else out)
 
-    return single(_amp_out(
-        ab.attention_block(x_q, x_kv, wq, wk, wv, wo, seed,
-                           n_head, causal, dropout_p), attrs))
+    out = ab.attention_block(x_q, x_kv, wq, wk, wv, wo, seed,
+                             n_head, causal, dropout_p)
+    return single(_amp_out(out, attrs) if amp else out)
 
 
 @register_op("attention", ref="composed: matmul+softmax ops; TPU-native "

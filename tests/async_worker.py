@@ -34,7 +34,10 @@ def main():
             is_train=True, num_fields=4, vocab_size=64, embed_dim=8,
             lr=1e-2)
 
-    t = DistributeTranspiler()
+    from paddle_tpu.fluid.transpiler import DistributeTranspilerConfig
+    cfg = DistributeTranspilerConfig()
+    cfg.enable_dc_asgd = os.environ.get("PADDLE_DC_ASGD", "0") == "1"
+    t = DistributeTranspiler(cfg)
     t.transpile(rank, program=main_p, pservers=f"{host}:{port}",
                 trainers=int(os.environ["PADDLE_TRAINERS_NUM"]),
                 sync_mode=False, startup_program=startup)
