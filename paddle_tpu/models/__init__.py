@@ -9,9 +9,9 @@ a mesh.
 
 from paddle_tpu.models import (alexnet, deepfm, googlenet,
                                machine_translation, mnist, resnet,
-                               se_resnext, smallnet, stacked_dynamic_lstm,
-                               transformer, vgg)
+                               roofline_probe, se_resnext, smallnet,
+                               stacked_dynamic_lstm, transformer, vgg)
 
 __all__ = ["alexnet", "deepfm", "googlenet", "machine_translation", "mnist",
-           "resnet", "se_resnext", "smallnet", "stacked_dynamic_lstm",
-           "transformer", "vgg"]
+           "resnet", "roofline_probe", "se_resnext", "smallnet",
+           "stacked_dynamic_lstm", "transformer", "vgg"]

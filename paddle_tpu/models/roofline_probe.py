@@ -1,0 +1,33 @@
+"""Roofline probe: a deep fc stack whose arithmetic intensity clears the
+v5e ridge by construction — the measured demonstration that the
+FRAMEWORK does not cap MFU; model structure does (round-3 verdict: "no
+bench row exists whose AI clears the ridge and shows >=50% MFU... until
+one does, 'it's the memory system, not the framework' is an argument,
+not a measurement").
+
+Deliberately synthetic and labeled as such: depth x [B,D]x[D,D] matmuls
+with fused relu epilogues and an MSE head, SGD update. AI ~= B/3
+FLOP/byte on the weights (B=8192 >> ridge ~240 after reuse) and the
+backward is two more matmuls per layer — the workload every per-fusion
+table in docs/performance.md says should run near MXU peak. No
+reference analogue (the reference benchmarks real models only); this
+row exists to anchor the MFU ceiling argument with a measurement."""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def build(is_train: bool = True, d: int = 4096, depth: int = 8,
+          lr: float = 1e-4):
+    x = layers.data(name="x", shape=[d], dtype="float32")
+    y = layers.data(name="y", shape=[d], dtype="float32")
+    h = x
+    for _ in range(depth):
+        h = layers.fc(h, size=d, act="relu", bias_attr=False)
+    loss = layers.mean(layers.square_error_cost(h, y))
+    if is_train:
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    feed_specs = {"x": ([-1, d], "float32"), "y": ([-1, d], "float32")}
+    return loss, None, feed_specs

@@ -40,7 +40,7 @@ def test_aggregate_line_fits_tail_window():
     """The sweep aggregate (the final stdout line) must parse to all rows
     from the driver's tail capture alone — BENCH_r03 lost its head rows
     because the verbose aggregate overflowed the window (round-3 verdict
-    item 6). Budget: well under 2 KB for the full 16-row sweep."""
+    item 6). Budget: well under 2 KB for the full 18-row sweep."""
     import json
     from bench import aggregate_line
     rows = []
@@ -60,11 +60,16 @@ def test_aggregate_line_fits_tail_window():
                                f"1 chip)", "value": 9999.9,
                      "unit": "images/sec", "vs_baseline": None,
                      "mfu_pct": 12.0})
+    rows.append({"metric": "resnet50 serving cold-start, AOT-load -> "
+                           "first inference (bs16, 1 chip)",
+                 "value": 0.898, "unit": "seconds", "vs_baseline": None,
+                 "compile_from_source_s": 4.8, "speedup": 5.3})
     agg = aggregate_line(rows, rows[0], len(rows))
     line = json.dumps(agg, separators=(",", ":"))
     assert len(line) < 1500, len(line)
     back = json.loads(line)
-    assert len(back["rows"]) == 16
+    assert len(back["rows"]) == 17
+    assert back["rows"][-1]["m"] == "resnet50-coldstart"
     assert all({"m", "v", "u"} <= set(r) for r in back["rows"])
     # a failed row keeps its short error
     rows[3]["value"] = None

@@ -96,3 +96,22 @@ def test_deepfm_trains():
     for _ in range(5):
         (l2,) = exe.run(main, feed=feeds, fetch_list=[loss])
     assert float(np.asarray(l2)) < lv
+
+
+def test_roofline_probe_builds_and_trains():
+    """The MFU-ceiling probe (models/roofline_probe.py) is a real
+    trainable program, not just a bench fixture."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        loss, _, feed_specs = models.roofline_probe.build(d=32, depth=3,
+                                                          lr=1e-2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 32).astype(np.float32),
+            "y": rng.rand(16, 32).astype(np.float32)}
+    losses = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[loss.name])[0]))
+              for _ in range(12)]
+    assert losses[-1] < losses[0], losses
