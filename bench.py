@@ -451,8 +451,11 @@ def aggregate_line(rows, head, n_ok):
     compact = []
     for r in rows:
         if "cold-start" in r["metric"]:
-            compact.append({"m": r["metric"].split()[0] + "-coldstart",
-                            "v": r.get("value"), "u": r.get("unit")})
+            c = {"m": r["metric"].split()[0] + "-coldstart",
+                 "v": r.get("value"), "u": r.get("unit")}
+            if r.get("value") is None:
+                c["err"] = (r.get("error") or "?")[:40]
+            compact.append(c)
             continue
         name = r["metric"].split(" train ")[0].split(" infer")[0]
         kind = "infer" if (" infer" in r["metric"]

@@ -1,0 +1,108 @@
+"""EDL trainer process (spawned by tests/test_edl_integration.py): the
+reference's full elastic-deep-learning trainer loop — lease a data chunk
+from the shared master service, train it against the shared parameter
+server, report finished; die abruptly if told to (reference: the v2 EDL
+stack, go/master task leasing + go/pserver SendGrad/GetParam; a dead
+trainer's leases time out and survivors absorb its chunks while the
+model state lives on in the pserver).
+
+Records are "id:label" byte strings; a batch is one RecordIO chunk."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.fluid as fluid                           # noqa: E402
+from paddle_tpu import models, recordio                    # noqa: E402
+from paddle_tpu.data.master_service import MasterClient    # noqa: E402
+from paddle_tpu.distributed import AsyncTrainerClient      # noqa: E402
+from paddle_tpu.fluid.transpiler import (                  # noqa: E402
+    DistributeTranspiler)
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+    ps_host, ps_port = os.environ["PADDLE_PSERVER"].rsplit(":", 1)
+    die_after = int(os.environ.get("DIE_AFTER_LEASES", "0"))
+
+    # barrier: wait until every worker is up before draining the queue
+    bdir = os.environ.get("MASTER_BARRIER_DIR")
+    if bdir:
+        open(os.path.join(bdir, f"ready_{os.getpid()}"), "w").close()
+        while not os.path.exists(os.path.join(bdir, "go")):
+            time.sleep(0.01)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 3
+    startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        loss, _, _ = models.deepfm.build(
+            is_train=True, num_fields=4, vocab_size=64, embed_dim=8,
+            lr=1e-2)
+
+    t = DistributeTranspiler()
+    t.transpile(rank, program=main_p, pservers=f"{ps_host}:{ps_port}",
+                trainers=nprocs, sync_mode=False,
+                startup_program=startup)
+    trainer_prog = t.get_trainer_program()
+    params, grads = t.params, t.send_vars
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    master = MasterClient()
+    ps = AsyncTrainerClient((ps_host, int(ps_port)), trainer_id=rank)
+
+    leases = 0
+    completed = []
+    losses = []
+    while True:
+        task = master.get_task()
+        if task is None:
+            if master.done:
+                break
+            time.sleep(0.05)
+            continue
+        leases += 1
+        if die_after and leases >= die_after:
+            os._exit(17)              # mid-lease death, no report
+
+        # one chunk = one batch: parse "id0,id1,id2,id3:label" records
+        scanner = recordio.Scanner(task.path, task.chunk_begin,
+                                   task.chunk_end)
+        rows = [r.decode().split(":") for r in scanner]
+        scanner.close()
+        ids = np.array([[int(x) for x in r[0].split(",")]
+                        for r in rows], dtype=np.int64)[..., None]
+        label = np.array([[float(r[1])] for r in rows], dtype=np.float32)
+
+        for n, v in ps.pull(params).items():
+            scope.set_var(n, v)
+        outs = exe.run(trainer_prog, feed={"feat_ids": ids, "label": label},
+                       fetch_list=[loss.name] + grads, scope=scope)
+        losses.append(float(np.asarray(outs[0]).reshape(())))
+        for g, val in zip(grads, outs[1:]):
+            ps.push_grad(g, np.asarray(val))
+
+        if master.task_finished(task):
+            completed.append([task.path, task.chunk_begin, task.chunk_end])
+        time.sleep(float(os.environ.get("TRAIN_SLEEP", "0")))
+
+    ps.close()
+    print("RESULT " + json.dumps({"rank": rank, "completed": completed,
+                                  "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,10 +45,9 @@ def test_aggregate_line_fits_tail_window():
     from bench import aggregate_line
     rows = []
     units = {"transformer": "tokens/sec", "deepfm": "examples/sec"}
-    names = ["resnet50", "transformer", "alexnet", "deepfm", "googlenet",
-             "machine_translation", "mnist", "resnet", "se_resnext",
-             "stacked_dynamic_lstm", "transformer_big", "transformer_long",
-             "vgg"]
+    # keep in lockstep with bench.DEFAULT_BATCH_SIZES (the real sweep)
+    from bench import DEFAULT_BATCH_SIZES
+    names = sorted(DEFAULT_BATCH_SIZES)
     for m in names:
         rows.append({"metric": f"{m} train throughput (bs128, amp-bf16, "
                                f"1 chip)",
@@ -68,13 +67,17 @@ def test_aggregate_line_fits_tail_window():
     line = json.dumps(agg, separators=(",", ":"))
     assert len(line) < 1500, len(line)
     back = json.loads(line)
-    assert len(back["rows"]) == 17
+    assert len(back["rows"]) == len(names) + 4
     assert back["rows"][-1]["m"] == "resnet50-coldstart"
     assert all({"m", "v", "u"} <= set(r) for r in back["rows"])
     # a failed row keeps its short error
     rows[3]["value"] = None
     rows[3]["error"] = "x" * 500
-    agg2 = aggregate_line(rows, rows[0], len(rows) - 1)
+    rows[-1]["value"] = None          # failed cold-start keeps err too
+    rows[-1]["error"] = "y" * 500
+    agg2 = aggregate_line(rows, rows[0], len(rows) - 2)
     line2 = json.dumps(agg2, separators=(",", ":"))
     assert len(line2) < 1500
-    assert json.loads(line2)["rows"][3]["err"] == "x" * 40
+    back2 = json.loads(line2)
+    assert back2["rows"][3]["err"] == "x" * 40
+    assert back2["rows"][-1]["err"] == "y" * 40
