@@ -1,0 +1,49 @@
+"""Shared helpers for the multiprocess distributed tests — ONE definition
+of the small-DeepFM build (the param-name contract between trainer
+workers, pserver programs, and eval programs: all three must construct
+byte-identical graphs) plus the free-port and held-out-eval utilities
+duplicated across the dist suites."""
+
+import socket
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_deepfm_small(is_train: bool = True):
+    """Deterministic names (unique_name.guard) + fixed seed: trainer,
+    pserver, and eval processes all rebuild this exact graph."""
+    from paddle_tpu import models
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 3
+    startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        loss, _, _ = models.deepfm.build(
+            is_train=is_train, num_fields=4, vocab_size=64, embed_dim=8,
+            lr=1e-2)
+    return main_p, startup, loss
+
+
+def eval_deepfm_loss(scope, label_fn=None) -> float:
+    """Held-out batch loss under the params in `scope`. label_fn(ids) ->
+    label column; default matches the convergence-matrix data regime."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(999)
+    ids = rng.randint(0, 64, size=(128, 4, 1)).astype("int64")
+    if label_fn is None:
+        label = (ids[:, 0, 0] % 2).astype(np.float32)[:, None]
+    else:
+        label = label_fn(ids)
+    eval_p, _, eval_l = build_deepfm_small(is_train=False)
+    (lv,) = exe.run(eval_p, feed={"feat_ids": ids, "label": label},
+                    fetch_list=[eval_l.name], scope=scope)
+    return float(np.asarray(lv).reshape(()))

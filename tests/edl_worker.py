@@ -22,7 +22,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import paddle_tpu.fluid as fluid                           # noqa: E402
-from paddle_tpu import models, recordio                    # noqa: E402
+from paddle_tpu import recordio                            # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _dist_utils import build_deepfm_small                 # noqa: E402
 from paddle_tpu.data.master_service import MasterClient    # noqa: E402
 from paddle_tpu.distributed import AsyncTrainerClient      # noqa: E402
 from paddle_tpu.fluid.transpiler import (                  # noqa: E402
@@ -42,13 +44,7 @@ def main():
         while not os.path.exists(os.path.join(bdir, "go")):
             time.sleep(0.01)
 
-    main_p, startup = fluid.Program(), fluid.Program()
-    main_p.random_seed = 3
-    startup.random_seed = 3
-    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
-        loss, _, _ = models.deepfm.build(
-            is_train=True, num_fields=4, vocab_size=64, embed_dim=8,
-            lr=1e-2)
+    main_p, startup, loss = build_deepfm_small()
 
     t = DistributeTranspiler()
     t.transpile(rank, program=main_p, pservers=f"{ps_host}:{ps_port}",

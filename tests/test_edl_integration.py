@@ -26,7 +26,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu import models, recordio
+from paddle_tpu import recordio
+from _dist_utils import build_deepfm_small, eval_deepfm_loss, free_port
 from paddle_tpu.core import native
 from paddle_tpu.data.master import Master
 from paddle_tpu.data.master_service import MASTER_ENV, MasterServer
@@ -37,25 +38,6 @@ pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native runtime unavailable")
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _build(is_train=True):
-    main_p, startup = fluid.Program(), fluid.Program()
-    main_p.random_seed = 3
-    startup.random_seed = 3
-    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
-        loss, _, _ = models.deepfm.build(
-            is_train=is_train, num_fields=4, vocab_size=64, embed_dim=8,
-            lr=1e-2)
-    return main_p, startup, loss
 
 
 def _make_dataset(tmp_path, n_files=3, chunks_per_file=4, rows_per_chunk=16):
@@ -75,14 +57,10 @@ def _make_dataset(tmp_path, n_files=3, chunks_per_file=4, rows_per_chunk=16):
 
 
 def _eval_loss(scope):
-    exe = fluid.Executor(fluid.CPUPlace())
-    rng = np.random.RandomState(999)
-    ids = rng.randint(0, 64, size=(128, 4, 1)).astype("int64")
-    label = ((ids[:, 0, 0] % 2) == 0).astype(np.float32)[:, None]
-    eval_p, _, eval_l = _build(is_train=False)
-    (lv,) = exe.run(eval_p, feed={"feat_ids": ids, "label": label},
-                    fetch_list=[eval_l.name], scope=scope)
-    return float(np.asarray(lv).reshape(()))
+    return eval_deepfm_loss(
+        scope,
+        label_fn=lambda ids: ((ids[:, 0, 0] % 2) == 0
+                              ).astype(np.float32)[:, None])
 
 
 def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
@@ -94,8 +72,8 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
     srv = MasterServer(master)
 
     # param plane
-    main_p, startup, loss = _build()
-    port = _free_port()
+    main_p, startup, loss = build_deepfm_small()
+    port = free_port()
     ep = f"127.0.0.1:{port}"
     t = DistributeTranspiler()
     t.transpile(0, program=main_p, pservers=ep, trainers=3,
@@ -124,7 +102,12 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
             env["MASTER_BARRIER_DIR"] = bdir
             env["TRAIN_SLEEP"] = "0.05"
             if rank == 0:
-                env["DIE_AFTER_LEASES"] = "2"   # dies on its 2nd lease
+                # dies on its FIRST lease: always reached (the queue
+                # cannot drain before every worker's first lease — the
+                # others are still compiling their own first chunk), so
+                # the death is deterministic; die_after=2 could let the
+                # victim drain-exit rc=0 under first-compile skew
+                env["DIE_AFTER_LEASES"] = "1"
             workers.append(subprocess.Popen(
                 [sys.executable, os.path.join(TESTS_DIR, "edl_worker.py")],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -151,6 +134,7 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
             if w.poll() is None:
                 w.kill()
         srv.stop()
+        ps.stop()
 
     # exactly-once data plane: survivors completed every chunk except
     # those the victim landed before dying (0 or 1 — its first finish is
@@ -162,7 +146,10 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
     assert s["done"] == n_chunks
     assert len(completed) == len(set(completed)), "a chunk trained twice"
     assert n_chunks - 1 <= len(completed) <= n_chunks
-    assert all(o["completed"] for o in outs), "a survivor did no work"
+    # NOTE: no assertion that BOTH survivors completed work — under
+    # first-compile skew one worker can legitimately drain the queue
+    # while the other is still compiling; the system property is the
+    # exactly-once accounting above, not scheduling fairness
 
     # param plane survived the death and learned: grads were applied and
     # the held-out loss improved over the initial parameters
@@ -170,6 +157,5 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
     trained_scope = fluid.Scope()
     for n in t.params:
         trained_scope.set_var(n, np.asarray(ps.scope.find_var(n)))
-    ps.stop()
     loss_after = _eval_loss(trained_scope)
     assert loss_after < loss_before, (loss_before, loss_after)
