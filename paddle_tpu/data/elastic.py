@@ -24,25 +24,63 @@ from paddle_tpu.data.master import Master
 class ElasticTrainer:
     """Restartable chunk-driven training loop."""
 
-    def __init__(self, work_dir: str, paths: List[str],
+    def __init__(self, work_dir: str, paths: List[str] = (),
                  chunks_per_task: int = 1, lease_timeout_s: float = 60.0,
-                 checkpoint_every: int = 1, max_to_keep: int = 3):
+                 checkpoint_every: int = 1, max_to_keep: int = 3,
+                 master=None):
+        """``master=None`` (single-worker): an in-process Master owning
+        the queue, recovered from/snapshotted to work_dir. ``master=``
+        a MasterClient (or any Master duck): MULTI-WORKER mode — N
+        elastic trainers drain the one served queue (reference: EDL
+        trainers share the go/master service); queue durability then
+        belongs to the process hosting the MasterServer, so this worker
+        skips queue snapshots and only writes model checkpoints.
+
+        Each worker must own its model Scope (EDL trainers own their
+        replica; shared state belongs on a pserver): two workers
+        training against ONE scope race the step's buffer donation
+        against the checkpoint's device-to-host reads (measured: TPU
+        backend InvalidArgument on the donated array).
+
+        DURABILITY PROTOCOL (multi-worker): task_finished is reported
+        when the chunk is TRAINED, before this worker's async checkpoint
+        of it is durable — so worker-local checkpoints alone cannot
+        carry the never-lose-an-update invariant the single-owner mode
+        orders explicitly (snapshot-after-_COMPLETE below). Multi-worker
+        model durability must live on the shared parameter plane, which
+        survives any worker's death: an AsyncPServer (the reference's
+        answer — go/pserver holds the updates the moment gradients
+        apply; tests/test_edl_integration.py), or sync-dp where every
+        worker holds identical state and any survivor's checkpoint is
+        the model's. Worker-local checkpoints here are restart
+        accelerators, not the source of truth."""
+        if master is not None and (
+                paths or chunks_per_task != 1 or lease_timeout_s != 60.0):
+            raise ValueError(
+                "ElasticTrainer(master=...) uses the served queue: "
+                "paths/chunks_per_task/lease_timeout_s belong to the "
+                "process hosting the MasterServer, not this worker")
         from paddle_tpu.fluid.io import AsyncCheckpointer
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
         self._snap_path = os.path.join(work_dir, "master_snapshot.json")
-        self.master = Master(timeout_s=lease_timeout_s)
-        if os.path.exists(self._snap_path):
-            # resume: finished chunks stay finished, leases reset
-            self.master.recover(self._snap_path)
+        self._owns_master = master is None
+        if master is not None:
+            self.master = master
         else:
-            real = [p for p in paths if os.path.exists(p)]
-            if real:
-                self.master.set_dataset(real, chunks_per_task)
-            # logical shard names (non-file work units) become 1-chunk tasks
-            for p in paths:
-                if p not in real:
-                    self.master.add_task(p, 0, 1)
+            self.master = Master(timeout_s=lease_timeout_s)
+            if os.path.exists(self._snap_path):
+                # resume: finished chunks stay finished, leases reset
+                self.master.recover(self._snap_path)
+            else:
+                real = [p for p in paths if os.path.exists(p)]
+                if real:
+                    self.master.set_dataset(real, chunks_per_task)
+                # logical shard names (non-file work units) become
+                # 1-chunk tasks
+                for p in paths:
+                    if p not in real:
+                        self.master.add_task(p, 0, 1)
         self.ckpt = AsyncCheckpointer(os.path.join(work_dir, "ckpt"),
                                       max_to_keep=max_to_keep)
         self.checkpoint_every = checkpoint_every
@@ -84,6 +122,13 @@ class ElasticTrainer:
             done_since_ckpt += 1
             if done_since_ckpt >= self.checkpoint_every:
                 self._serial += 1
+                if not self._owns_master:
+                    # external (served) master: checkpoint the model only;
+                    # queue durability is the master host's job
+                    self.ckpt.save(self._serial,
+                                   main_program=main_program, scope=scope)
+                    done_since_ckpt = 0
+                    continue
                 # the queue snapshot must only become durable AFTER the
                 # model checkpoint it corresponds to (else a crash between
                 # them marks chunks done whose weight updates were lost).
@@ -102,4 +147,5 @@ class ElasticTrainer:
                                scope=scope, on_complete=_promote)
                 done_since_ckpt = 0
         self.ckpt.wait()
-        self.master.snapshot(self._snap_path)
+        if self._owns_master:
+            self.master.snapshot(self._snap_path)

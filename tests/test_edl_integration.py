@@ -40,7 +40,8 @@ pytestmark = pytest.mark.skipif(not native.available(),
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _make_dataset(tmp_path, n_files=3, chunks_per_file=4, rows_per_chunk=16):
+def _make_dataset(tmp_path, n_files=3, chunks_per_file=10,
+                  rows_per_chunk=32):
     """Learnable CTR records: label = f(ids)."""
     rng = np.random.RandomState(0)
     paths, n_chunks = [], 0
