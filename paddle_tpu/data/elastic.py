@@ -24,8 +24,9 @@ from paddle_tpu.data.master import Master
 class ElasticTrainer:
     """Restartable chunk-driven training loop."""
 
-    def __init__(self, work_dir: str, paths: List[str] = (),
-                 chunks_per_task: int = 1, lease_timeout_s: float = 60.0,
+    def __init__(self, work_dir: str, paths: Optional[List[str]] = None,
+                 chunks_per_task: Optional[int] = None,
+                 lease_timeout_s: Optional[float] = None,
                  checkpoint_every: int = 1, max_to_keep: int = 3,
                  master=None):
         """``master=None`` (single-worker): an in-process Master owning
@@ -54,12 +55,19 @@ class ElasticTrainer:
         worker holds identical state and any survivor's checkpoint is
         the model's. Worker-local checkpoints here are restart
         accelerators, not the source of truth."""
-        if master is not None and (
-                paths or chunks_per_task != 1 or lease_timeout_s != 60.0):
+        # None-sentinel defaults so EXPLICITLY passing a queue-config arg
+        # together with master= always raises — even if the value happens
+        # to equal the single-worker default
+        if master is not None and not (
+                paths is None and chunks_per_task is None
+                and lease_timeout_s is None):
             raise ValueError(
                 "ElasticTrainer(master=...) uses the served queue: "
                 "paths/chunks_per_task/lease_timeout_s belong to the "
                 "process hosting the MasterServer, not this worker")
+        paths = () if paths is None else paths
+        chunks_per_task = 1 if chunks_per_task is None else chunks_per_task
+        lease_timeout_s = 60.0 if lease_timeout_s is None else lease_timeout_s
         from paddle_tpu.fluid.io import AsyncCheckpointer
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)

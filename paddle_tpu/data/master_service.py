@@ -55,7 +55,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             try:
                 req = json.loads(line)
-                resp = self._dispatch(master, req)
+                resp = self._dispatch(master, req, self.server)
             except Exception as e:  # malformed request: report, keep serving
                 resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
             try:
@@ -65,7 +65,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
 
     @staticmethod
-    def _dispatch(master: Master, req: dict) -> dict:
+    def _dispatch(master: Master, req: dict, server=None) -> dict:
         method = req.get("method")
         if method == "get_task":
             t = master.get_task()
@@ -85,8 +85,23 @@ class _Handler(socketserver.StreamRequestHandler):
             s["done_flag"] = master.done
             return {"ok": True, "stats": s}
         if method == "snapshot":
-            master.snapshot(req["path"])
-            return {"ok": True}
+            # The wire protocol is unauthenticated: a client-chosen
+            # server-side path would be an arbitrary-file-write primitive
+            # on the master host. Snapshots land under the directory the
+            # SERVER configured (basename of the client's path only);
+            # with no snapshot_root the method is disabled — the hosting
+            # process can always call master.snapshot() directly.
+            root = getattr(server, "snapshot_root", None)
+            if root is None:
+                return {"ok": False, "error":
+                        "snapshot over the wire is disabled: construct "
+                        "MasterServer(snapshot_root=dir) to enable it, "
+                        "or snapshot from the hosting process"}
+            fname = os.path.basename(
+                str(req.get("path", ""))) or "master_snapshot.json"
+            path = os.path.join(root, fname)
+            master.snapshot(path)
+            return {"ok": True, "path": path}
         if method == "ping":
             return {"ok": True, "pong": True}
         return {"ok": False, "error": f"unknown method {method!r}"}
@@ -104,8 +119,13 @@ class MasterServer:
     """
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, snapshot_root: Optional[str] = None):
+        """``snapshot_root``: directory wire-requested snapshots are
+        confined to (clients name only the file). None (default)
+        disables the wire ``snapshot`` method entirely."""
         self.master = master
+        if snapshot_root is not None:
+            os.makedirs(snapshot_root, exist_ok=True)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -113,6 +133,7 @@ class MasterServer:
 
         self._server = _Server((host, port), _Handler)
         self._server.master = master  # type: ignore[attr-defined]
+        self._server.snapshot_root = snapshot_root  # type: ignore
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True)
@@ -248,6 +269,9 @@ class MasterClient:
         return s
 
     def snapshot(self, path: str):
+        """Ask the server to snapshot its queue. Only ``basename(path)``
+        is honored, under the server's configured snapshot_root —
+        disabled unless the server was built with one."""
         self._call({"method": "snapshot", "path": path})
 
     def ping(self) -> bool:

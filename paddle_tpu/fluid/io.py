@@ -273,8 +273,13 @@ class AsyncCheckpointer:
             try:
                 d = self._serial_dir(serial)
                 writer(d, snapshot)
-                # mark complete LAST so partial dirs are never latest
-                with open(os.path.join(d, "_COMPLETE"), "w") as f:
+                # mark complete LAST so partial dirs are never latest.
+                # Multi-host sharded saves write PER-PROCESS markers
+                # (_COMPLETE_p<i>): the serial counts as complete only
+                # once every process's marker is present (serials()), so
+                # one fast host can never make the dir look complete
+                # while another host is still writing (or crashed).
+                with open(os.path.join(d, self._marker_name()), "w") as f:
                     f.write(str(serial))
                 if on_complete is not None:
                     on_complete()
@@ -299,14 +304,44 @@ class AsyncCheckpointer:
             import shutil
             shutil.rmtree(self._serial_dir(s), ignore_errors=True)
 
+    def _marker_name(self) -> str:
+        if self.sharded:
+            import jax
+            if jax.process_count() > 1:
+                return f"_COMPLETE_p{jax.process_index()}"
+        return "_COMPLETE"
+
+    @staticmethod
+    def _serial_complete(d: str) -> bool:
+        """True iff every saving process finished this serial. Single
+        -process saves use the legacy _COMPLETE file; multi-host sharded
+        saves need one _COMPLETE_p<i> per process recorded in the shard
+        manifests' process_count."""
+        if os.path.exists(os.path.join(d, "_COMPLETE")):
+            return True
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        markers = set()
+        for n in names:
+            if n.startswith("_COMPLETE_p"):
+                suffix = n[len("_COMPLETE_p"):]
+                if suffix.isdigit():   # ignore stray _COMPLETE_p0.bak etc.
+                    markers.add(int(suffix))
+        if not markers:
+            return False
+        from paddle_tpu.fluid import sharded_io
+        want = sharded_io.recorded_process_count(d)
+        return want is not None and markers >= set(range(want))
+
     def serials(self) -> List[int]:
         out = []
         if not os.path.isdir(self.root):
             return out
         for n in os.listdir(self.root):
             d = os.path.join(self.root, n)
-            if n.startswith("checkpoint_") and \
-                    os.path.exists(os.path.join(d, "_COMPLETE")):
+            if n.startswith("checkpoint_") and self._serial_complete(d):
                 out.append(int(n.split("_")[-1]))
         return sorted(out)
 
@@ -314,15 +349,35 @@ class AsyncCheckpointer:
                 main_program=None, scope=None, sharding_fn=None) -> int:
         """Load the given (or latest complete) serial into the scope.
         ``sharding_fn`` restores directly into a (possibly different)
-        mesh layout — save dp=4, restore dp=8."""
+        mesh layout — save dp=4, restore dp=8.
+
+        With no explicit ``serial``, a serial whose data turns out torn
+        (e.g. a host crashed between writing shard files and its marker
+        in a way the markers could not catch) is skipped and the next
+        -older complete serial is tried — restore recovers automatically
+        instead of dying on the newest dir."""
         self.wait()
         serials = self.serials()
         if not serials:
             raise FileNotFoundError(f"no complete checkpoints in {self.root}")
-        serial = serial if serial is not None else serials[-1]
-        load_vars(executor, self._serial_dir(serial), main_program,
-                  scope=scope, sharding_fn=sharding_fn)
-        return serial
+        if serial is not None:
+            load_vars(executor, self._serial_dir(serial), main_program,
+                      scope=scope, sharding_fn=sharding_fn)
+            return serial
+        last_err = None
+        for s in reversed(serials):
+            try:
+                load_vars(executor, self._serial_dir(s), main_program,
+                          scope=scope, sharding_fn=sharding_fn)
+                return s
+            except (OSError, ValueError) as e:
+                # incomplete/torn serial (IOError from the manifest
+                # completeness check, json/np parse errors from truncated
+                # files) → fall back to the next-older serial
+                last_err = e
+        raise IOError(
+            f"every complete-looking serial in {self.root} failed to "
+            "load") from last_err
 
 
 def _param_names(main_program):

@@ -122,6 +122,25 @@ def _full_bounds(shape) -> List[List[int]]:
     return [[0, d] for d in shape]
 
 
+def recorded_process_count(dirname: str) -> Optional[int]:
+    """process_count recorded at save time (any one per-process manifest
+    carries it) — lets AsyncCheckpointer.serials() demand the full
+    _COMPLETE_p<i> marker set before a multi-host serial counts as
+    complete."""
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return None
+    for n in names:
+        if n.startswith(_SHARD_MANIFEST_PREFIX):
+            try:
+                with open(os.path.join(dirname, n)) as f:
+                    return json.load(f).get("process_count")
+            except (OSError, ValueError):
+                return None
+    return None
+
+
 def is_sharded_dir(dirname: str) -> bool:
     if not os.path.isdir(dirname):
         return False

@@ -181,12 +181,32 @@ class PaddlePredictor:
         path = os.path.join(dirname, self.AOT_FILENAME)
         with open(path, "wb") as f:
             pickle.dump({"sig": sig, "payload": payload}, f)
+        # integrity tag checked BEFORE unpickling at load (guards a
+        # corrupted/partially-copied artifact; an adversary who can
+        # rewrite the model dir can rewrite both files — the dir itself
+        # must be trusted, see load_compiled). Hash the written file in
+        # chunks: executables can be hundreds of MB.
+        import hashlib
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        with open(path + ".sha256", "w") as f:
+            f.write(h.hexdigest())
         return path
 
     def load_compiled(self, dirname: str) -> bool:
         """Load a serialized executable if present; returns whether
         serving will skip compilation. Shape-mismatched inputs fall back
-        to the normal compile path at run()."""
+        to the normal compile path at run().
+
+        SECURITY: the artifact is a pickle (like any serialized XLA
+        executable it embeds callables) — ``dirname`` must be a TRUSTED
+        model directory, same trust level as the model program itself.
+        The sha256 sidecar written by save_compiled is verified before
+        unpickling, which catches corruption/truncation; it is not a
+        defense against an attacker who can write the directory."""
+        import hashlib
         import os
         import pickle
         from jax.experimental import serialize_executable as se
@@ -194,7 +214,19 @@ class PaddlePredictor:
         if not os.path.exists(path):
             return False
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            raw = f.read()
+        digest_path = path + ".sha256"
+        if os.path.exists(digest_path):
+            with open(digest_path) as f:
+                want = f.read().strip()
+            if hashlib.sha256(raw).hexdigest() != want:
+                import warnings
+                warnings.warn(
+                    "AOT executable failed its sha256 integrity check "
+                    "(corrupted or partially copied) — ignoring it; "
+                    "re-run save_compiled", stacklevel=2)
+                return False
+        blob = pickle.loads(raw)
         sig = blob["sig"]
         # the executable bakes in the traced program INCLUDING amp/nhwc
         # rewrites — a stale artifact or a predictor configured

@@ -50,9 +50,11 @@ def multi_head_attention(q_in, kv_in, d_model, n_head, dropout, mask=None,
     d_k = d_model // n_head
     if fused:
         # the fused block expresses causality via `causal`; an additive
-        # mask would be silently ignored - fail loudly instead
-        assert mask is None, (
-            "fused attention takes causal=True, not an additive mask")
+        # mask would be silently ignored — fail loudly (ValueError, not
+        # assert: must survive python -O)
+        if mask is not None:
+            raise ValueError(
+                "fused attention takes causal=True, not an additive mask")
         # ONE fused op spanning the projections AND the attention dots
         # (layers.fused_multi_head_attention → ops/attention_block.py):
         # its custom VJP is spelled so no [B,T,H,D]↔[B,H,T,D] relayout

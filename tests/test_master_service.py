@@ -142,14 +142,19 @@ def test_snapshot_over_wire(tmp_path):
     paths, _ = _make_dataset(tmp_path, n_files=1, chunks_per_file=2)
     master = Master(timeout_s=5.0, failure_max=3)
     master.set_dataset(paths)
-    srv = MasterServer(master)
-    snap = str(tmp_path / "master.snap")
+    snap_root = tmp_path / "snaps"
+    srv = MasterServer(master, snapshot_root=str(snap_root))
+    snap = str(snap_root / "master.snap")
     try:
         c = MasterClient(srv.endpoint)
-        c.snapshot(snap)
+        # client names only the FILE; the server confines it to its
+        # configured snapshot_root (path traversal is stripped)
+        c.snapshot("/etc/../evil/../../master.snap")
         c.close()
     finally:
         srv.stop()
+    assert os.path.exists(snap)
+    assert sorted(os.listdir(snap_root)) == ["master.snap"]
     # a fresh master recovers the full queue from the wire-side snapshot
     m2 = Master(timeout_s=5.0, failure_max=3)
     m2.recover(snap)

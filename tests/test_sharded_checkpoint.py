@@ -193,3 +193,60 @@ def test_elastic_trainer_resumes_across_mesh_change(tmp_path):
                          scope=scope2)
         losses.append(float(np.asarray(lv).reshape(())))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0] * 5
+
+
+def test_multihost_partial_serial_never_latest(tmp_path):
+    """ADVICE r4 (medium): in a multi-host save, one fast host must not
+    make a serial look complete while another host is still writing (or
+    crashed mid-save). Per-process _COMPLETE_p<i> markers gate
+    completeness, and restore() falls back past a torn serial instead of
+    dying on it."""
+    import shutil
+    from paddle_tpu.fluid import sharded_io
+
+    main, startup, loss = _build_mlp()
+    scope = Scope()
+    _train(main, startup, loss, _zero_dist(4), 2, scope)
+    want = _scope_arrays(scope, _persistables(main))
+
+    root = str(tmp_path / "root")
+    ck = fluid.io.AsyncCheckpointer(root)
+    ck.save(1, main, scope=scope)
+    ck.wait()
+
+    def _fake_partial(serial, markers, process_count=2):
+        """Clone serial 1 into `serial` rewritten as a process_count-host
+        save of which only `markers` processes finished."""
+        src = os.path.join(root, "checkpoint_1")
+        dst = os.path.join(root, f"checkpoint_{serial}")
+        shutil.copytree(src, dst)
+        os.remove(os.path.join(dst, "_COMPLETE"))
+        mpath = os.path.join(dst, "__shards_p0__.json")
+        with open(mpath) as f:
+            m = json.load(f)
+        m["process_count"] = process_count
+        with open(mpath, "w") as f:
+            json.dump(m, f)
+        for p in markers:
+            with open(os.path.join(dst, f"_COMPLETE_p{p}"), "w") as f:
+                f.write(str(serial))
+
+    # serial 2: 2-host save, only host 0 wrote its marker → NOT complete
+    _fake_partial(2, markers=[0])
+    ck2 = fluid.io.AsyncCheckpointer(root)
+    assert ck2.serials() == [1]
+
+    # serial 3: markers claim both hosts finished but host 1's shard
+    # manifest is missing (torn dir) → restore() must fall back to 1,
+    # not raise on the newest serial
+    _fake_partial(3, markers=[0, 1])
+    assert ck2.serials() == [1, 3]
+    scope2 = Scope()
+    assert ck2.restore(scope=scope2, main_program=main) == 1
+    for n, arr in want.items():
+        np.testing.assert_array_equal(np.asarray(scope2.find_var(n)), arr,
+                                      err_msg=n)
+
+    # an EXPLICIT serial request still surfaces the torn-checkpoint error
+    with pytest.raises((IOError, OSError)):
+        ck2.restore(scope=Scope(), main_program=main, serial=3)
