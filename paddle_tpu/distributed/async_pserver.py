@@ -181,9 +181,19 @@ class AsyncPServer:
 
     # -- the RPC surface ---------------------------------------------------
 
-    def serve(self, address, authkey: bytes = b"paddle_tpu"):
-        from multiprocessing.connection import Listener
-        self._listener = Listener(tuple(address), authkey=authkey)
+    def serve(self, address=None, authkey: bytes = b"paddle_tpu",
+              listener=None):
+        """Serve on ``address``, or on an already-bound
+        ``multiprocessing.connection.Listener`` (``listener=``). Binding
+        at allocation time (paddle_tpu.utils.net.bound_listener) closes
+        the pick-a-port-then-rebind TOCTOU race."""
+        if listener is not None:
+            self._listener = listener
+        else:
+            if address is None:
+                raise ValueError("serve() needs address=... or listener=...")
+            from multiprocessing.connection import Listener
+            self._listener = Listener(tuple(address), authkey=authkey)
 
         def accept_loop():
             while not self._stopping.is_set():

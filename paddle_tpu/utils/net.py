@@ -1,0 +1,66 @@
+"""Race-free port allocation for multi-process launches.
+
+The classic ``bind(0) → read port → close → hand the number to a child
+that rebinds later`` pattern has a TOCTOU hole: between the close and
+the child's bind, any process on the host can take the port, turning the
+most expensive distributed tests/launches into spurious failures. Two
+closures of that hole live here (reference analogue: the Go master's
+etcd registration hands out *live* endpoints, never pre-allocated
+numbers — go/master/etcd_client.go):
+
+- :class:`PortReservation` — for binders we don't control (the
+  jax.distributed coordinator's gRPC server). The reservation socket is
+  bound with SO_REUSEPORT and HELD OPEN, never listening: a later binder
+  that also sets SO_REUSEPORT (gRPC does, on Linux) binds the same port
+  and receives every connection, while any unrelated process gets
+  EADDRINUSE for as long as the reservation lives.
+- :func:`bound_listener` — for in-process servers (AsyncPServer): the
+  server socket is bound at allocation and handed to ``serve()``
+  directly, so the port number is never released at all.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class PortReservation:
+    """Hold an ephemeral port against third-party reuse until closed.
+
+    Usage::
+
+        with PortReservation() as r:
+            spawn_workers(coordinator=f"127.0.0.1:{r.port}")
+            ...  # keep the reservation open until the binder has bound
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._sock.bind((host, 0))
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self):
+        self._sock.close()
+
+    def __enter__(self) -> "PortReservation":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def bound_listener(authkey: bytes = b"paddle_tpu", host: str = "127.0.0.1"):
+    """A ``multiprocessing.connection.Listener`` bound NOW on an
+    ephemeral port, returned with its port. Pass it to
+    ``AsyncPServer.serve(listener=...)`` — the socket exists from
+    allocation to serving, so there is no window to steal the port in.
+    """
+    from multiprocessing.connection import Listener
+    listener = Listener((host, 0), authkey=authkey)
+    return listener, listener.address[1]

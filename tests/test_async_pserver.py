@@ -7,7 +7,6 @@ covered by the tests at the bottom of this file."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -18,14 +17,7 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.distributed import AsyncPServer, AsyncTrainerClient
 from paddle_tpu.fluid.transpiler import DistributeTranspiler
 from paddle_tpu import models
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from _dist_utils import bound_listener as _bound_listener
 
 
 def _build_deepfm(seed=3):
@@ -76,14 +68,14 @@ def test_deepfm_two_process_async_converges():
     within tolerance of a single-process synchronous run's."""
     steps = 40
     main_p, startup, loss = _build_deepfm()
-    port = _free_port()
+    listener, port = _bound_listener()   # bound now; no rebind window
     ep = f"127.0.0.1:{port}"
     t = DistributeTranspiler()
     t.transpile(0, program=main_p, pservers=ep, trainers=2,
                 sync_mode=False, startup_program=startup)
     ps_prog = t.get_pserver_program(ep)
     ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog))
-    ps.serve(("127.0.0.1", port))
+    ps.serve(listener=listener)
 
     env_base = {k: v for k, v in os.environ.items()
                 if not k.startswith(("PADDLE_", "XLA_FLAGS"))}
@@ -229,8 +221,8 @@ def test_dc_asgd_over_the_wire_trainer_id():
     different ids get independent backups."""
     lr = 0.1
     ps, g, pname = _dc_server(lr=lr)
-    port = _free_port()
-    ps.serve(("127.0.0.1", port))
+    listener, port = _bound_listener()
+    ps.serve(listener=listener)
     try:
         c0 = AsyncTrainerClient(("127.0.0.1", port), trainer_id=0)
         c1 = AsyncTrainerClient(("127.0.0.1", port), trainer_id=1)

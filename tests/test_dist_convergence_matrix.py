@@ -13,7 +13,6 @@ cross-process convergence curve the round-3 VERDICT noted was missing
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -26,7 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import paddle_tpu.fluid as fluid
 from _dist_utils import build_deepfm_small as _build_deepfm_small
 from _dist_utils import eval_deepfm_loss as _eval_loss
-from _dist_utils import free_port as _free_port
+from _dist_utils import PortReservation as _PortReservation
+from _dist_utils import bound_listener as _bound_listener
 
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
 REPO_ROOT = os.path.dirname(TESTS_DIR)
@@ -63,12 +63,15 @@ def _spawn(script, env_extra, nprocs):
 # ---- collective modes (jax.distributed over 2 OS processes) -------------
 
 def _run_collective(model, steps, nprocs=2, local=False):
-    env = {"PADDLE_COORDINATOR": f"127.0.0.1:{_free_port()}",
-           "PADDLE_TEST_MODEL": model, "PADDLE_TEST_STEPS": str(steps)}
-    if local:
-        env["PADDLE_LOCAL_BASELINE"] = "1"
-        return _spawn("dist_worker.py", env, 1)[0]["losses"]
-    return _spawn("dist_worker.py", env, nprocs)
+    # reservation held until the workers have exited — rank 0's gRPC
+    # coordinator (SO_REUSEPORT) binds through it, nobody else can
+    with _PortReservation() as r:
+        env = {"PADDLE_COORDINATOR": r.endpoint,
+               "PADDLE_TEST_MODEL": model, "PADDLE_TEST_STEPS": str(steps)}
+        if local:
+            env["PADDLE_LOCAL_BASELINE"] = "1"
+            return _spawn("dist_worker.py", env, 1)[0]["losses"]
+        return _spawn("dist_worker.py", env, nprocs)
 
 
 # ---- pserver modes (AsyncPServer on this process, trainer workers) ------
@@ -78,7 +81,7 @@ def _run_pserver_mode(dc_asgd, steps=40, nprocs=2):
     from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
                                              DistributeTranspilerConfig)
     main_p, startup, loss = _build_deepfm_small()
-    port = _free_port()
+    listener, port = _bound_listener()   # bound now; no rebind window
     ep = f"127.0.0.1:{port}"
     cfg = DistributeTranspilerConfig()
     cfg.enable_dc_asgd = dc_asgd
@@ -87,7 +90,7 @@ def _run_pserver_mode(dc_asgd, steps=40, nprocs=2):
                 sync_mode=False, startup_program=startup)
     ps_prog = t.get_pserver_program(ep)
     ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog))
-    ps.serve(("127.0.0.1", port))
+    ps.serve(listener=listener)
     try:
         env = {"PADDLE_PSERVER": ep, "PADDLE_TEST_STEPS": str(steps)}
         if dc_asgd:

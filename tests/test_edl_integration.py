@@ -14,7 +14,6 @@ held-out loss beats the initial parameters'."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -27,7 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import recordio
-from _dist_utils import build_deepfm_small, eval_deepfm_loss, free_port
+from _dist_utils import build_deepfm_small, bound_listener, eval_deepfm_loss
 from paddle_tpu.core import native
 from paddle_tpu.data.master import Master
 from paddle_tpu.data.master_service import MASTER_ENV, MasterServer
@@ -74,14 +73,14 @@ def test_edl_master_plus_pserver_with_trainer_death(tmp_path):
 
     # param plane
     main_p, startup, loss = build_deepfm_small()
-    port = free_port()
+    listener, port = bound_listener()   # bound now; no rebind window
     ep = f"127.0.0.1:{port}"
     t = DistributeTranspiler()
     t.transpile(0, program=main_p, pservers=ep, trainers=3,
                 sync_mode=False, startup_program=startup)
     ps_prog = t.get_pserver_program(ep)
     ps = AsyncPServer(ps_prog, t.get_startup_program(ep, ps_prog))
-    ps.serve(("127.0.0.1", port))
+    ps.serve(listener=listener)
 
     init_scope = fluid.Scope()
     for n in t.params:

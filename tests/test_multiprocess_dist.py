@@ -7,7 +7,6 @@ all-reduce crosses the process boundary."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -17,16 +16,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def _run_workers(nprocs, model, steps, extra_env=None):
-    port = _free_port()
+    from _dist_utils import PortReservation
+    # held open until the workers exit: rank 0's gRPC coordinator
+    # (SO_REUSEPORT) binds through the reservation; third parties can't
+    reservation = PortReservation()
+    port = reservation.port
     workers = []
     env_base = {k: v for k, v in os.environ.items()
                 if not k.startswith(("PADDLE_", "XLA_FLAGS", "JAX_"))}
@@ -57,6 +52,7 @@ def _run_workers(nprocs, model, steps, extra_env=None):
         for w in workers:
             if w.poll() is None:
                 w.kill()
+        reservation.close()
     return results
 
 

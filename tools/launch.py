@@ -26,18 +26,9 @@ from __future__ import annotations
 import argparse
 import os
 import signal
-import socket
 import subprocess
 import sys
 import threading
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _pump(stream, rank, out):
@@ -49,7 +40,19 @@ def _pump(stream, rank, out):
 
 def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
            coordinator: str = "", use_cpu: bool = False) -> int:
-    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    try:
+        from paddle_tpu.utils.net import PortReservation
+    except ImportError:      # `python tools/launch.py` puts only tools/
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))     # on sys.path — add the repo
+        from paddle_tpu.utils.net import PortReservation
+    # held open for the children's whole lifetime: rank 0's gRPC
+    # coordinator (SO_REUSEPORT) binds through it, third parties can't
+    # steal the port between allocation and that bind
+    reservation = None
+    if not coordinator:
+        reservation = PortReservation()
+        coordinator = reservation.endpoint
     procs = []
     pumps = []
     for rank in range(nprocs):
@@ -102,6 +105,8 @@ def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
                 p.kill()
         for t in pumps:
             t.join(timeout=5)
+        if reservation is not None:
+            reservation.close()
     return exit_code
 
 
