@@ -128,18 +128,28 @@ class Master {
     return dropped_;
   }
 
-  // snapshot format: one line per task "state id path begin end failures"
+  // snapshot format v2: header "ptpu_master_v2 next_id done total dropped
+  // epoch", then one line per task "state id path begin end failures
+  // lease_epoch" (lease_epoch meaningful for state=pending). v1 snapshots
+  // (no header epoch, no per-line lease_epoch, pending demoted to todo)
+  // remain readable.
   int Snapshot(const char* file) {
     std::lock_guard<std::mutex> lk(mu_);
     std::ofstream out(file, std::ios::trunc);
     if (!out.good()) return -1;
-    out << "ptpu_master_v1 " << next_id_ << " " << done_ << " " << total_
-        << " " << dropped_ << "\n";
-    for (const auto& t : todo_) Dump(out, "todo", t);
-    // pending leases snapshot as todo: after recovery they re-lease
-    // (reference: recovered tasks go back to the queue, service.go:166)
-    for (const auto& kv : pending_) Dump(out, "todo", kv.second.task);
-    return 0;
+    out << "ptpu_master_v2 " << next_id_ << " " << done_ << " " << total_
+        << " " << dropped_ << " " << epoch_ << "\n";
+    for (const auto& t : todo_) Dump(out, "todo", t, 0);
+    // pending leases persist WITH their epochs: after a master restart
+    // the lease holder's finish/fail still matches and is accepted —
+    // exactly-once across the restart. (The reference re-queues
+    // recovered tasks instead, service.go:166, which re-trains any
+    // chunk that was in flight; lease preservation is strictly
+    // stronger.)
+    for (const auto& kv : pending_)
+      Dump(out, "pending", kv.second.task, kv.second.epoch);
+    out.flush();
+    return out.good() ? 0 : -1;
   }
 
   int Recover(const char* file) {
@@ -148,24 +158,45 @@ class Master {
     if (!in.good()) return -1;
     std::string tag;
     in >> tag;
-    if (tag != "ptpu_master_v1") return -1;
+    int version;
+    if (tag == "ptpu_master_v1") version = 1;
+    else if (tag == "ptpu_master_v2") version = 2;
+    else return -1;
     in >> next_id_ >> done_ >> total_ >> dropped_;
+    if (version >= 2) in >> epoch_;
     todo_.clear();
     pending_.clear();
     std::string state, path;
     Task t;
-    while (in >> state >> t.id >> path >> t.chunk_begin >> t.chunk_end >>
-           t.failures) {
+    int64_t lease_epoch = 0;
+    while (true) {
+      if (!(in >> state >> t.id >> path >> t.chunk_begin >> t.chunk_end >>
+            t.failures))
+        break;
+      if (version >= 2 && !(in >> lease_epoch)) break;
       t.path = path;
-      todo_.push_back(t);
+      if (version >= 2 && state == "pending") {
+        // the lease survives with a FRESH deadline: the master was down
+        // for an unknowable stretch, so the holder gets a full window
+        // to report before the task re-issues
+        pending_[t.id] =
+            Lease{t,
+                  Clock::now() + std::chrono::microseconds(
+                                     static_cast<int64_t>(timeout_s_ * 1e6)),
+                  lease_epoch};
+      } else {
+        todo_.push_back(t);
+      }
     }
     return 0;
   }
 
  private:
-  void Dump(std::ofstream& out, const char* state, const Task& t) {
+  void Dump(std::ofstream& out, const char* state, const Task& t,
+            int64_t lease_epoch) {
     out << state << " " << t.id << " " << t.path << " " << t.chunk_begin
-        << " " << t.chunk_end << " " << t.failures << "\n";
+        << " " << t.chunk_end << " " << t.failures << " " << lease_epoch
+        << "\n";
   }
 
   void Requeue(Task t) {

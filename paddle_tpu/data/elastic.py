@@ -34,8 +34,11 @@ class ElasticTrainer:
         a MasterClient (or any Master duck): MULTI-WORKER mode — N
         elastic trainers drain the one served queue (reference: EDL
         trainers share the go/master service); queue durability then
-        belongs to the process hosting the MasterServer, so this worker
-        skips queue snapshots and only writes model checkpoints.
+        belongs to the process hosting the MasterServer — construct it
+        with ``snapshot_path=`` and it persists every accepted
+        lease/report and recovers on restart (master failover,
+        tests/test_master_failover.py) — so this worker skips queue
+        snapshots and only writes model checkpoints.
 
         Each worker must own its model Scope (EDL trainers own their
         replica; shared state belongs on a pserver): two workers

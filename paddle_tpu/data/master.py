@@ -38,8 +38,10 @@ class Master:
     def __init__(self, timeout_s: float = 60.0, failure_max: int = 3):
         if not native.available():
             raise native.NativeUnavailable("master requires native runtime")
+        import threading
         self._h = native.lib().ptpu_master_new(float(timeout_s),
                                                int(failure_max))
+        self._snap_lock = threading.Lock()
 
     def set_dataset(self, paths: List[str], chunks_per_task: int = 1):
         """Partition RecordIO files into chunk-range tasks
@@ -92,8 +94,21 @@ class Master:
                 "dropped": lib.ptpu_master_num_dropped(self._h)}
 
     def snapshot(self, path: str):
-        if native.lib().ptpu_master_snapshot(self._h, path.encode()) != 0:
-            raise IOError(f"snapshot to {path!r} failed")
+        """Atomic AND ordered: writes a unique tmp file and rename()s it
+        over ``path`` (a crash mid-write can never leave a torn snapshot
+        as the recovery source — the etcd analogue's writes were atomic
+        per key). The capture+replace pair is serialized under a Python
+        lock: without it, two ThreadingTCPServer handler threads could
+        replace the file out of capture order and an OLDER snapshot —
+        missing an already-acked report — could end up newest, silently
+        rolling back the persist-before-reply guarantee."""
+        import os
+        import threading
+        with self._snap_lock:
+            tmp = f"{path}.tmp{os.getpid()}_{threading.get_ident()}"
+            if native.lib().ptpu_master_snapshot(self._h, tmp.encode()) != 0:
+                raise IOError(f"snapshot to {tmp!r} failed")
+            os.replace(tmp, path)
 
     def recover(self, path: str):
         if native.lib().ptpu_master_recover(self._h, path.encode()) != 0:

@@ -27,6 +27,14 @@ Protocol (one JSON object per line, one reply line per request):
 A worker that dies mid-lease simply stops talking; its lease expires in
 the C++ state machine and the task re-issues to a surviving worker — the
 EDL elasticity loop, now actually shared across OS processes.
+
+The MASTER side is elastic too (go/master/service.go:165 recover from
+etcd + etcd_client.go:191 clients watch-and-re-dial): construct
+``MasterServer(snapshot_path=...)`` and every accepted lease/report is
+persisted before its reply; a killed master restarted on the same
+endpoint recovers the queue with pending leases intact, and
+``MasterClient`` rides the outage via reconnect-with-backoff
+(tests/test_master_failover.py).
 """
 
 from __future__ import annotations
@@ -65,12 +73,31 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
 
     @staticmethod
+    def _persist(master: Master, server) -> None:
+        """Durability point: called after every accepted state change,
+        BEFORE the reply is sent — an acked lease/report is always in
+        the snapshot a restarted master recovers from (the reference
+        persists each state change to etcd the same way,
+        go/master/service.go:207)."""
+        sp = getattr(server, "snapshot_path", None)
+        if sp:
+            master.snapshot(sp)
+
+    @staticmethod
     def _dispatch(master: Master, req: dict, server=None) -> dict:
         method = req.get("method")
         if method == "get_task":
             t = master.get_task()
             if t is None:
                 return {"ok": True, "task": None, "done": master.done}
+            try:
+                _Handler._persist(master, server)   # the new lease
+            except Exception:
+                # the worker will never see this lease — fail it back to
+                # the queue NOW instead of stranding the chunk for a
+                # full lease window (disk trouble must not stall drains)
+                master.task_failed(t)
+                raise
             return {"ok": True, "done": False,
                     "task": {"id": t.id, "epoch": t.epoch, "path": t.path,
                              "chunk_begin": t.chunk_begin,
@@ -79,7 +106,10 @@ class _Handler(socketserver.StreamRequestHandler):
             t = Task(int(req["id"]), int(req["epoch"]), "", 0, 0)
             fn = (master.task_finished if method == "task_finished"
                   else master.task_failed)
-            return {"ok": True, "accepted": bool(fn(t))}
+            accepted = bool(fn(t))
+            if accepted:
+                _Handler._persist(master, server)
+            return {"ok": True, "accepted": accepted}
         if method == "stats":
             s = master.stats()
             s["done_flag"] = master.done
@@ -119,21 +149,51 @@ class MasterServer:
     """
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
-                 port: int = 0, snapshot_root: Optional[str] = None):
+                 port: int = 0, snapshot_root: Optional[str] = None,
+                 snapshot_path: Optional[str] = None):
         """``snapshot_root``: directory wire-requested snapshots are
         confined to (clients name only the file). None (default)
-        disables the wire ``snapshot`` method entirely."""
+        disables the wire ``snapshot`` method entirely.
+
+        ``snapshot_path``: the served queue's durability file — the etcd
+        analogue (reference: go/master/service.go:165 the master
+        recovers its state from the etcd snapshot on start, :207 it
+        persists each state change). When set: if the file exists at
+        construction the master RECOVERS from it before serving (a
+        restarted master resumes the drain in place — pending leases
+        survive with their epochs, so in-flight workers' reports are
+        still accepted exactly-once); every accepted lease/report is
+        then snapshotted back atomically before its reply is sent."""
         self.master = master
         if snapshot_root is not None:
             os.makedirs(snapshot_root, exist_ok=True)
+        if snapshot_path and os.path.exists(snapshot_path):
+            master.recover(snapshot_path)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+            def server_bind(self):
+                # SO_REUSEPORT set explicitly (socketserver's
+                # allow_reuse_port attr only works on py3.11+): lets a
+                # restarted master rebind the advertised port through a
+                # held PortReservation (paddle_tpu.utils.net) immediately
+                try:
+                    self.socket.setsockopt(socket.SOL_SOCKET,
+                                           socket.SO_REUSEPORT, 1)
+                except (AttributeError, OSError):
+                    pass    # platform without SO_REUSEPORT
+                super().server_bind()
+
         self._server = _Server((host, port), _Handler)
         self._server.master = master  # type: ignore[attr-defined]
         self._server.snapshot_root = snapshot_root  # type: ignore
+        self._server.snapshot_path = snapshot_path  # type: ignore
+        if snapshot_path:
+            # durable from the very first moment served — a crash before
+            # the first report must still recover the full queue
+            master.snapshot(snapshot_path)
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True)
@@ -160,13 +220,18 @@ class MasterClient:
     single-process loop (reference: go/master/client.go dials the service
     and calls GetTask/TaskFinished/TaskFailed over net/rpc).
 
-    One persistent connection per client; transient socket failures
-    reconnect once per call (the master restarting from a snapshot looks
-    like a reconnect to workers).
+    One persistent connection per client; on socket failure every call
+    reconnects with exponential backoff until ``reconnect_timeout_s``
+    elapses — a master that dies and is restarted from its snapshot on
+    the same endpoint (MasterServer(snapshot_path=...)) looks like a
+    brief outage to workers, the analogue of the reference clients
+    watching the master's etcd key and re-dialing the new address
+    (go/master/etcd_client.go:191 watchKey).
     """
 
     def __init__(self, endpoint: Optional[str] = None,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 reconnect_timeout_s: float = 60.0):
         endpoint = endpoint or os.environ.get(MASTER_ENV)
         if not endpoint:
             raise ValueError(
@@ -174,6 +239,7 @@ class MasterClient:
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout_s
+        self._reconnect_timeout = reconnect_timeout_s
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
@@ -198,20 +264,26 @@ class MasterClient:
         self._sock = self._rfile = None
 
     def _call(self, req: dict, idempotent: bool = True) -> dict:
-        """One request/reply. ``idempotent=False`` (task_finished /
-        task_failed) never resends after the request may have reached the
-        master — a duplicate report would be misread as a stale-lease
-        rejection; reconnect-before-send is always safe."""
+        """One request/reply, retried with exponential backoff across
+        connection failures until ``reconnect_timeout_s`` is exhausted.
+
+        Delivery is AT-LEAST-ONCE for every method, including the report
+        RPCs (``idempotent`` is kept for signature stability): a resend
+        whose original did land is rejected by the lease-epoch check and
+        surfaces as ``accepted: false`` — the same benign answer a stale
+        report gets, and one every caller already tolerates (the chunk
+        is either already done or will re-issue). Application at the
+        master is therefore at-most-once, and with the server's persist
+        -before-reply ordering an acked report is never lost across a
+        master restart."""
+        import time
         with self._lock:
-            for attempt in (0, 1):
+            deadline = time.monotonic() + self._reconnect_timeout
+            delay = 0.05
+            while True:
                 try:
                     if self._sock is None:
                         self._connect()
-                except (ConnectionError, OSError):
-                    if attempt:
-                        raise
-                    continue
-                try:
                     self._sock.sendall((json.dumps(req) + "\n").encode())
                     line = self._rfile.readline()
                     if not line:
@@ -223,9 +295,10 @@ class MasterClient:
                     return resp
                 except (ConnectionError, OSError, json.JSONDecodeError):
                     self._close_sock()
-                    if attempt or not idempotent:
+                    if time.monotonic() + delay > deadline:
                         raise
-        raise AssertionError("unreachable")
+                    time.sleep(delay)
+                    delay = min(delay * 2, 1.0)
 
     # -- Master duck interface ------------------------------------------
     def get_task(self) -> Optional[Task]:

@@ -1,0 +1,51 @@
+"""Subprocess body for the master-failover test: drain the shared queue
+through MasterClient's reconnect-with-backoff, counting every chunk
+actually CONSUMED (trained) — the parent asserts the union across
+workers covers the dataset exactly once even though the master is
+SIGKILLed and restarted from its snapshot mid-drain.
+
+Accounting note: records are counted when the scan completes, before the
+finish report's fate is known. A report whose first delivery landed just
+as the master died is resent after reconnect and rejected as a duplicate
+(accepted=False) — the chunk was still trained exactly once, by us."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import recordio                          # noqa: E402
+from paddle_tpu.data.master_service import MasterClient  # noqa: E402
+
+
+def main():
+    client = MasterClient(reconnect_timeout_s=60.0)
+    records = []
+    completed = []
+    while True:
+        task = client.get_task()
+        if task is None:
+            if client.done:
+                break
+            time.sleep(0.05)
+            continue
+        got = []
+        scanner = recordio.Scanner(task.path, task.chunk_begin,
+                                   task.chunk_end)
+        try:
+            for rec in scanner:
+                got.append(rec.decode())
+                time.sleep(float(os.environ.get("TRAIN_SLEEP", "0")))
+        finally:
+            scanner.close()
+        client.task_finished(task)
+        records.extend(got)
+        completed.append(task.id)
+    print(json.dumps({"records": records, "completed": completed}))
+
+
+if __name__ == "__main__":
+    main()

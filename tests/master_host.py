@@ -1,0 +1,39 @@
+"""Subprocess body for the master-failover test: host the chunk-lease
+MasterServer on a FIXED port with a durability snapshot. First launch
+partitions the dataset; a RELAUNCH with the same snapshot path recovers
+the queue (pending leases included) and resumes serving — the reference's
+master-recovers-from-etcd restart (go/master/service.go:165 recover,
+clients re-dial via etcd watch, go/master/etcd_client.go:191).
+
+Parent kills this process with SIGKILL mid-drain to simulate master
+death. Prints "READY <endpoint>" once serving."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.data.master import Master                 # noqa: E402
+from paddle_tpu.data.master_service import MasterServer   # noqa: E402
+
+
+def main():
+    port = int(os.environ["MASTER_PORT"])
+    snap = os.environ["MASTER_SNAPSHOT"]
+    paths = [p for p in os.environ.get("MASTER_PATHS", "").split(os.pathsep)
+             if p]
+    master = Master(timeout_s=float(os.environ.get("MASTER_LEASE_S", "10")),
+                    failure_max=5)
+    if not os.path.exists(snap):
+        master.set_dataset(paths, chunks_per_task=1)
+    # else: MasterServer(snapshot_path=snap) recovers the queue itself
+    MasterServer(master, port=port, snapshot_path=snap)
+    print(f"READY 127.0.0.1:{port}", flush=True)
+    while True:          # serve until the parent kills us
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,7 +103,14 @@ def test_snapshot_recover(tmp_path):
 
     m2 = Master(timeout_s=60, failure_max=3)  # "restarted" master
     m2.recover(snap)
-    # the outstanding lease snapshots back to todo (service.go:166)
+    # snapshot v2 preserves the outstanding lease WITH its epoch (the
+    # reference re-queued instead, service.go:166 — lease preservation
+    # is strictly stronger: the holder's report is still accepted, so a
+    # master restart cannot re-train an in-flight chunk)
+    assert m2.stats() == {"todo": 2, "pending": 1, "done": 0, "dropped": 0}
+    # the original holder reports FAILED across the restart: accepted
+    # (epoch matched) and the chunk re-queues for the drain below
+    assert m2.task_failed(t)
     assert m2.stats()["todo"] == 3
     got = sorted(r.decode() for r in task_reader(m2))
     assert got == sorted(f"f0r{i}" for i in range(30))

@@ -1,0 +1,162 @@
+"""Master failover (round-4 VERDICT's one missing capability): the EDL
+control plane must survive MASTER death, not just worker death.
+
+Reference behavior matched: the Go master registers in etcd and on
+restart recovers its queue from the etcd snapshot
+(go/master/service.go:165 recover, :207 snapshot); clients watch the
+master key and re-dial (go/master/etcd_client.go:191 watchKey). Here the
+snapshot file is the etcd analogue (MasterServer(snapshot_path=...)
+persists every accepted lease/report before replying and recovers on
+start), and MasterClient's reconnect-with-backoff is the watch-and-
+re-dial analogue on a fixed endpoint.
+
+The scenario: 3 workers drain a 18-chunk dataset through a served
+master; the master host process is SIGKILLed mid-drain and restarted
+from its snapshot on the SAME port; workers ride through the outage and
+every record is trained exactly once — pending leases survive the
+restart with their epochs (csrc/master.cc snapshot v2), so even the
+chunks in flight at kill time are neither lost nor re-trained."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import recordio
+from paddle_tpu.core import native
+from paddle_tpu.data.master_service import MASTER_ENV, MasterClient
+from _dist_utils import PortReservation
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(TESTS_DIR)
+
+
+def _make_dataset(tmp_path, n_files=3, chunks_per_file=6, recs_per_chunk=3):
+    paths, expected = [], []
+    for f in range(n_files):
+        p = str(tmp_path / f"part-{f:03d}.recordio")
+        w = recordio.Writer(p, max_chunk_records=recs_per_chunk)
+        for c in range(chunks_per_file):
+            for r in range(recs_per_chunk):
+                rec = f"f{f}c{c}r{r}"
+                w.write(rec.encode())
+                expected.append(rec)
+        w.close()
+        paths.append(p)
+    return paths, expected
+
+
+def _env_base():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "JAX_", "XLA_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _spawn_master(port, snap, paths):
+    env = _env_base()
+    env["MASTER_PORT"] = str(port)
+    env["MASTER_SNAPSHOT"] = snap
+    env["MASTER_PATHS"] = os.pathsep.join(paths)
+    env["MASTER_LEASE_S"] = "20"   # no legit expiry during the test —
+    # any duplicate training would have to come from the restart itself
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(TESTS_DIR, "master_host.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO_ROOT, env=env)
+    line = p.stdout.readline()
+    assert line.startswith("READY"), \
+        (line, p.stderr.read() if p.poll() is not None else "")
+    return p
+
+
+def test_master_killed_and_restarted_midrain_exactly_once(tmp_path):
+    paths, expected = _make_dataset(tmp_path)
+    snap = str(tmp_path / "master.snap")
+    with PortReservation() as r:
+        endpoint = r.endpoint
+        master_proc = _spawn_master(r.port, snap, paths)
+        workers = []
+        try:
+            env = _env_base()
+            env[MASTER_ENV] = endpoint
+            env["TRAIN_SLEEP"] = "0.05"   # ~2.7 s of total work to kill into
+            workers = [subprocess.Popen(
+                [sys.executable, os.path.join(TESTS_DIR,
+                                              "failover_worker.py")],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=REPO_ROOT, env=env) for _ in range(3)]
+
+            # wait until the drain is demonstrably in progress
+            probe = MasterClient(endpoint, reconnect_timeout_s=30.0)
+            deadline = time.time() + 60
+            while True:
+                s = probe.stats()
+                if s["done"] >= 2 and s["todo"] > 4:
+                    break
+                assert time.time() < deadline, f"drain never progressed: {s}"
+                time.sleep(0.02)
+            probe.close()
+
+            # SIGKILL the master mid-drain (leases are in flight)
+            master_proc.send_signal(signal.SIGKILL)
+            master_proc.wait(timeout=10)
+            time.sleep(0.3)    # workers are now retrying against a void
+
+            # restart from the snapshot on the SAME port
+            master_proc = _spawn_master(r.port, snap, paths)
+
+            results = []
+            for i, w in enumerate(workers):
+                out, err = w.communicate(timeout=120)
+                assert w.returncode == 0, f"worker {i} died:\n{err[-3000:]}"
+                results.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            for p in [master_proc] + workers:
+                if p.poll() is None:
+                    p.kill()
+
+    # the headline assertion: every record trained EXACTLY once across
+    # the master's death and resurrection
+    consumed = sorted(rec for res in results for rec in res["records"])
+    assert consumed == sorted(expected), (
+        f"{len(consumed)} consumed vs {len(expected)} expected; "
+        f"dupes/missing: "
+        f"{set(consumed) ^ set(expected) or 'duplicate records'}")
+    # and the queue really was drained cooperatively after the restart
+    assert all(res["completed"] for res in results)
+
+
+def test_snapshot_preserves_pending_leases(tmp_path):
+    """Unit-level check of the v2 snapshot: a leased (pending) task
+    survives snapshot→recover WITH its epoch, so the original holder's
+    finish is accepted after the restart; v1's demote-to-todo would have
+    rejected it (trained twice)."""
+    from paddle_tpu.data.master import Master
+    paths, _ = _make_dataset(tmp_path, n_files=1, chunks_per_file=2)
+    m = Master(timeout_s=30.0, failure_max=3)
+    m.set_dataset(paths, chunks_per_task=1)
+    t = m.get_task()
+    assert t is not None
+    snap = str(tmp_path / "m.snap")
+    m.snapshot(snap)
+
+    m2 = Master(timeout_s=30.0, failure_max=3)
+    m2.recover(snap)
+    stats = m2.stats()
+    assert stats["pending"] == 1 and stats["todo"] == 1, stats
+    # the ORIGINAL lease holder reports to the restarted master: accepted
+    assert m2.task_finished(t)
+    # a duplicate of the same report is rejected, not double-counted
+    assert not m2.task_finished(t)
+    assert m2.stats()["done"] == 1
