@@ -101,6 +101,251 @@ def fused_gru_sequence(xproj, w, h0, interpret=False):
     return hidden
 
 
+# ---------------------------------------------------------------------------
+# TRAINABLE whole-sequence LSTM (round-4 VERDICT #3): custom-VJP kernel
+# pair. The forward is the same VMEM-resident sequential-grid walk as the
+# is_test kernel but with seq-length masking and peepholes (so it engages
+# on the real bench graphs, which use both — layers/rnn.py defaults
+# use_peepholes=True); the backward walks the grid in REVERSE time,
+# recomputes the gates from (xproj[t], h_{t-1}) — one extra [B,H]x[H,4H]
+# matmul instead of saving four gate tensors per step to HBM — and keeps
+# the dh/dc carries and the [H,4H] dw accumulator resident in VMEM.
+# (Reference analogue: the x86 jit tier generated both directions of the
+# cell, operators/jit/gen/lstm.cc; XLA's scan AD instead materializes
+# every per-step residual through HBM and chains ~T tiny kernels.)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_train_fwd_kernel(x_ref, w_ref, peep_ref, sl_ref, h0_ref, c0_ref,
+                           hid_ref, cell_ref, hlast_ref, clast_ref,
+                           h_scr, c_scr):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    c = c_scr[:]
+    hdim = h.shape[-1]
+    gates = x_ref[0].astype(jnp.float32) + jnp.dot(
+        h, w_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32)            # [B, 4H]
+    peep = peep_ref[:].astype(jnp.float32)             # [B, 3H]
+    w_ic = peep[:, 0 * hdim:1 * hdim]                  # (pre-broadcast:
+    w_fc = peep[:, 1 * hdim:2 * hdim]                  # Mosaic rejects a
+    w_oc = peep[:, 2 * hdim:3 * hdim]                  # 1xH->BxH bcast)
+    i = jax.nn.sigmoid(gates[:, 0 * hdim:1 * hdim] + c * w_ic)
+    f = jax.nn.sigmoid(gates[:, 1 * hdim:2 * hdim] + c * w_fc)
+    g = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
+    c_cand = f * c + i * g
+    o = jax.nn.sigmoid(gates[:, 3 * hdim:4 * hdim] + c_cand * w_oc)
+    h_cand = o * jnp.tanh(c_cand)
+    m = (t < sl_ref[:]).astype(jnp.float32)            # [B, 1]
+    h_new = m * h_cand + (1.0 - m) * h
+    c_new = m * c_cand + (1.0 - m) * c
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+    # outputs zero the masked tail (refer-scan semantics: hs = h_new * m)
+    hid_ref[0] = (m * h_cand).astype(hid_ref.dtype)
+    cell_ref[0] = (m * c_cand).astype(cell_ref.dtype)
+
+    @pl.when(t == T - 1)
+    def _():
+        hlast_ref[:] = h_new.astype(hlast_ref.dtype)   # last VALID h/c
+        clast_ref[:] = c_new.astype(clast_ref.dtype)
+
+
+def _lstm_train_bwd_kernel(x_ref, w_ref, peep_ref, sl_ref,
+                           hprev_ref, cprev_ref, dhid_ref, dcell_ref,
+                           dhlast_ref, dclast_ref,
+                           dx_ref, dw_ref, dh0_ref, dc0_ref, dpeep_ref,
+                           dh_scr, dc_scr, dw_scr, dpeep_scr):
+    idx = pl.program_id(0)             # grid step; time t = T-1-idx
+    T = pl.num_programs(0)
+    t_time = T - 1 - idx
+
+    @pl.when(idx == 0)
+    def _():
+        # the LastHidden/LastCell grads ARE the initial carries (hlast is
+        # the final carry h_T)
+        dh_scr[:] = dhlast_ref[:].astype(jnp.float32)
+        dc_scr[:] = dclast_ref[:].astype(jnp.float32)
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+        dpeep_scr[:] = jnp.zeros_like(dpeep_scr)
+
+    h_prev = hprev_ref[0].astype(jnp.float32)
+    c_prev = cprev_ref[0].astype(jnp.float32)
+    hdim = h_prev.shape[-1]
+    w = w_ref[:].astype(jnp.float32)
+    peep = peep_ref[:].astype(jnp.float32)             # [B, 3H] pre-bcast
+    w_ic = peep[:, 0 * hdim:1 * hdim]
+    w_fc = peep[:, 1 * hdim:2 * hdim]
+    w_oc = peep[:, 2 * hdim:3 * hdim]
+
+    # recompute the gates (the residuals XLA's scan-AD would have spilled)
+    gates = x_ref[0].astype(jnp.float32) + jnp.dot(
+        h_prev, w, preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(gates[:, 0 * hdim:1 * hdim] + c_prev * w_ic)
+    f = jax.nn.sigmoid(gates[:, 1 * hdim:2 * hdim] + c_prev * w_fc)
+    g = jnp.tanh(gates[:, 2 * hdim:3 * hdim])
+    c_cand = f * c_prev + i * g
+    o = jax.nn.sigmoid(gates[:, 3 * hdim:4 * hdim] + c_cand * w_oc)
+    tanh_c = jnp.tanh(c_cand)
+
+    m = (t_time < sl_ref[:]).astype(jnp.float32)       # [B, 1]
+    Dh = dh_scr[:]
+    Dc = dc_scr[:]
+    # h_carry = m*h_cand + (1-m)*h_prev and ho[t] = m*h_cand, so the
+    # grad reaching h_cand is m*(Dh + dho[t]); ditto for c
+    Gh = m * (Dh + dhid_ref[0].astype(jnp.float32))
+    Gc = m * (Dc + dcell_ref[0].astype(jnp.float32))
+    do = Gh * tanh_c
+    dgo = do * o * (1.0 - o)
+    dc_cand = Gc + Gh * o * (1.0 - tanh_c * tanh_c) + dgo * w_oc
+    di = dc_cand * g
+    df = dc_cand * c_prev
+    dg = dc_cand * i
+    dgi = di * i * (1.0 - i)
+    dgf = df * f * (1.0 - f)
+    dgg = dg * (1.0 - g * g)
+    dgates = jnp.concatenate([dgi, dgf, dgg, dgo], axis=1)   # [B, 4H]
+    dx_ref[0] = dgates.astype(dx_ref.dtype)
+    dh_scr[:] = (1.0 - m) * Dh + jax.lax.dot_general(
+        dgates, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [B, H]
+    dc_scr[:] = ((1.0 - m) * Dc + dc_cand * f
+                 + dgi * w_ic + dgf * w_fc)
+    dw_scr[:] += jax.lax.dot_general(
+        h_prev, dgates, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [H, 4H]
+    dpeep_scr[:] += jnp.concatenate(
+        [jnp.sum(dgi * c_prev, axis=0, keepdims=True),
+         jnp.sum(dgf * c_prev, axis=0, keepdims=True),
+         jnp.sum(dgo * c_cand, axis=0, keepdims=True)], axis=1)  # [1, 3H]
+
+    @pl.when(idx == T - 1)
+    def _():
+        dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
+        dpeep_ref[:] = dpeep_scr[:].astype(dpeep_ref.dtype)
+        dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _lstm_train_fwd_call(xproj, w, peep, sl, h0, c0, interpret):
+    t, b, h4 = xproj.shape
+    hdim = h4 // 4
+    peep_b = jnp.broadcast_to(peep, (b, 3 * hdim))
+    return pl.pallas_call(
+        _lstm_train_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, h4), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hdim, h4), lambda i: (0, 0)),
+            pl.BlockSpec((b, 3 * hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, 1), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hdim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, hdim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hdim), xproj.dtype),
+            jax.ShapeDtypeStruct((t, b, hdim), xproj.dtype),
+            jax.ShapeDtypeStruct((b, hdim), xproj.dtype),
+            jax.ShapeDtypeStruct((b, hdim), xproj.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hdim), jnp.float32),
+            pltpu.VMEM((b, hdim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, w, peep_b, sl, h0, c0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def fused_lstm_train(xproj, w, peep, seq_lens, h0, c0, interpret=False):
+    """Trainable whole-sequence LSTM. xproj [T,B,4H] gate pre-activations
+    (x@Wx + b), w [H,4H] recurrent, peep [1,3H] (W_ic|W_fc|W_oc — pass
+    zeros when use_peepholes=False), seq_lens [B,1] int32 (pass T
+    everywhere for unmasked), h0/c0 [B,H].
+
+    Returns (hidden [T,B,H], cell [T,B,H], h_last [B,H], c_last [B,H]);
+    hidden/cell are zeroed past each row's length, h_last/c_last carry
+    the last VALID step (refer-scan semantics, ops/rnn_ops.py)."""
+    return _lstm_train_fwd_call(xproj, w, peep, seq_lens, h0, c0, interpret)
+
+
+def _lstm_train_vjp_fwd(xproj, w, peep, seq_lens, h0, c0, interpret):
+    out = _lstm_train_fwd_call(xproj, w, peep, seq_lens, h0, c0, interpret)
+    hidden, cell, h_last, c_last = out
+    # residuals: the (zeroed) state sequences stand in for the carries —
+    # wherever a step's grads are nonzero (m=1) the two agree, and the
+    # masked steps contribute exactly zero in the backward
+    return out, (xproj, w, peep, seq_lens, h0, c0, hidden, cell)
+
+
+def _lstm_train_vjp_bwd(interpret, res, grads):
+    xproj, w, peep, seq_lens, h0, c0, hidden, cell = res
+    dhid, dcell, dhlast, dclast = grads
+    t, b, h4 = xproj.shape
+    hdim = h4 // 4
+    h_prev_seq = jnp.concatenate([h0[None].astype(hidden.dtype),
+                                  hidden[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([c0[None].astype(cell.dtype),
+                                  cell[:-1]], axis=0)
+    peep_b = jnp.broadcast_to(peep, (b, 3 * hdim))
+    rev = functools.partial(lambda T, i: (T - 1 - i, 0, 0), t)
+    dx, dw, dh0, dc0, dpeep = pl.pallas_call(
+        _lstm_train_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, h4), rev),
+            pl.BlockSpec((hdim, h4), lambda i: (0, 0)),
+            pl.BlockSpec((b, 3 * hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, b, hdim), rev),
+            pl.BlockSpec((1, b, hdim), rev),
+            pl.BlockSpec((1, b, hdim), rev),
+            pl.BlockSpec((1, b, hdim), rev),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, h4), rev),
+            pl.BlockSpec((hdim, h4), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((b, hdim), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * hdim), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, h4), xproj.dtype),
+            jax.ShapeDtypeStruct((hdim, h4), w.dtype),
+            jax.ShapeDtypeStruct((b, hdim), h0.dtype),
+            jax.ShapeDtypeStruct((b, hdim), c0.dtype),
+            jax.ShapeDtypeStruct((1, 3 * hdim), peep.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hdim), jnp.float32),
+            pltpu.VMEM((b, hdim), jnp.float32),
+            pltpu.VMEM((hdim, h4), jnp.float32),
+            pltpu.VMEM((1, 3 * hdim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj, w, peep_b, seq_lens, h_prev_seq, c_prev_seq,
+      dhid, dcell, dhlast, dclast)
+    return dx, dw, dpeep, None, dh0, dc0
+
+
+fused_lstm_train.defvjp(_lstm_train_vjp_fwd, _lstm_train_vjp_bwd)
+
+
 def fused_lstm_sequence(xproj, w, h0, c0, interpret=False):
     """xproj [T, B, 4H], w [H, 4H], h0/c0 [B, H] →
     (hidden [T, B, H], cell [T, B, H])."""

@@ -1,0 +1,126 @@
+"""Parity tests for the TRAINABLE whole-sequence Pallas LSTM (round-4
+VERDICT #3): forward and every gradient (x, w, peepholes, h0, c0) must
+match a plain lax.scan reference under jax.grad, including seq-length
+masking — the config the bench graphs actually use (peepholes on,
+ragged lengths). Runs in interpret mode on CPU; the TPU path compiles
+the same kernels (ops/pallas/__init__ parity self-test discipline)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.fused_rnn import fused_lstm_train
+
+
+def _ref_lstm(xproj, w, peep, seq_lens, h0, c0):
+    """Mirror of ops/rnn_ops.py _dynamic_lstm's scan (peepholes + mask)."""
+    T, B, H4 = xproj.shape
+    H = H4 // 4
+    w_ic = peep[:, :H]
+    w_fc = peep[:, H:2 * H]
+    w_oc = peep[:, 2 * H:]
+
+    def step(carry, inp):
+        h, c, t = carry
+        xt = inp
+        gates = xt + h @ w
+        i = jax.nn.sigmoid(gates[:, :H] + c * w_ic)
+        f = jax.nn.sigmoid(gates[:, H:2 * H] + c * w_fc)
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        c_cand = f * c + i * g
+        o = jax.nn.sigmoid(gates[:, 3 * H:] + c_cand * w_oc)
+        h_cand = o * jnp.tanh(c_cand)
+        m = (t < seq_lens).astype(xproj.dtype)          # [B,1]
+        h_new = m * h_cand + (1 - m) * h
+        c_new = m * c_cand + (1 - m) * c
+        return (h_new, c_new, t + 1), (m * h_cand, m * c_cand)
+
+    (h_last, c_last, _), (hs, cs) = jax.lax.scan(
+        step, (h0, c0, jnp.asarray(0, jnp.int32)), xproj)
+    return hs, cs, h_last, c_last
+
+
+def _make(seed=0, T=6, B=8, H=128, ragged=True):
+    rng = np.random.RandomState(seed)
+    xproj = rng.randn(T, B, 4 * H).astype(np.float32) * 0.4
+    w = rng.randn(H, 4 * H).astype(np.float32) * 0.2
+    peep = rng.randn(1, 3 * H).astype(np.float32) * 0.1
+    h0 = rng.randn(B, H).astype(np.float32) * 0.3
+    c0 = rng.randn(B, H).astype(np.float32) * 0.3
+    if ragged:
+        sl = rng.randint(1, T + 1, size=(B, 1)).astype(np.int32)
+        sl[0, 0] = T        # at least one full row
+    else:
+        sl = np.full((B, 1), T, np.int32)
+    return (jnp.asarray(v) for v in (xproj, w, peep, sl, h0, c0))
+
+
+@pytest.mark.parametrize("ragged", [False, True],
+                         ids=["full-length", "ragged"])
+def test_forward_parity(ragged):
+    xproj, w, peep, sl, h0, c0 = _make(ragged=ragged)
+    got = fused_lstm_train(xproj, w, peep, sl, h0, c0, True)
+    want = _ref_lstm(xproj, w, peep, sl, h0, c0)
+    for g, r, name in zip(got, want, ["hidden", "cell", "hlast", "clast"]):
+        np.testing.assert_allclose(g, r, rtol=2e-6, atol=2e-6,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("ragged", [False, True],
+                         ids=["full-length", "ragged"])
+def test_gradient_parity(ragged):
+    """Every input's gradient matches jax.grad of the scan reference —
+    through a loss that touches all four outputs so the LastHidden/
+    LastCell carry-gradient path is exercised too."""
+    xproj, w, peep, sl, h0, c0 = _make(seed=3, ragged=ragged)
+    rng = np.random.RandomState(7)
+    # fixed projections make the loss sensitive to every element
+    ph = jnp.asarray(rng.randn(*xproj.shape[:2], w.shape[0]) * .1,
+                     jnp.float32)
+
+    def loss_fused(xproj, w, peep, h0, c0):
+        hs, cs, hl, cl = fused_lstm_train(xproj, w, peep, sl, h0, c0, True)
+        return (jnp.sum(hs * ph) + 0.5 * jnp.sum(cs * ph)
+                + jnp.sum(hl ** 2) + jnp.sum(cl * hl))
+
+    def loss_ref(xproj, w, peep, h0, c0):
+        hs, cs, hl, cl = _ref_lstm(xproj, w, peep, sl, h0, c0)
+        return (jnp.sum(hs * ph) + 0.5 * jnp.sum(cs * ph)
+                + jnp.sum(hl ** 2) + jnp.sum(cl * hl))
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(
+        xproj, w, peep, h0, c0)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(
+        xproj, w, peep, h0, c0)
+    for g, r, name in zip(got, want, ["dx", "dw", "dpeep", "dh0", "dc0"]):
+        np.testing.assert_allclose(g, r, rtol=3e-5, atol=3e-5,
+                                   err_msg=name)
+
+
+def test_zero_peepholes_match_plain_cell():
+    """peep=0 must reduce exactly to the peephole-free cell (what the op
+    passes when use_peepholes=False), so one kernel serves both."""
+    xproj, w, peep, sl, h0, c0 = _make(seed=11, ragged=False)
+    peep0 = jnp.zeros_like(peep)
+    hs, cs, hl, cl = fused_lstm_train(xproj, w, peep0, sl, h0, c0, True)
+
+    def plain_step(carry, xt):
+        h, c = carry
+        H = h.shape[-1]
+        gates = xt + h @ w
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H:2 * H])
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (hl_r, cl_r), (hs_r, cs_r) = jax.lax.scan(plain_step, (h0, c0), xproj)
+    np.testing.assert_allclose(hs, hs_r, rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(cs, cs_r, rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(hl, hl_r, rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(cl, cl_r, rtol=2e-6, atol=2e-6)
