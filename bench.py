@@ -513,6 +513,22 @@ def main():
     ap.add_argument("--no-nhwc", dest="nhwc", action="store_false",
                     default=True, help="disable the channels-last layout "
                     "rewrite (contrib.layout)")
+    ap.add_argument("--check", nargs="?", const="BENCH_r04.json",
+                    default=None, metavar="BASELINE_JSON",
+                    help="perf-regression gate: re-run a row subset and "
+                         "fail (exit 1) if any row regresses more than "
+                         "--check-tolerance below the committed aggregate "
+                         "(default baseline: BENCH_r04.json; accepts the "
+                         "driver artifact or a raw aggregate line)")
+    ap.add_argument("--check-models", default="mnist,transformer",
+                    metavar="M1,M2",
+                    help="rows to re-measure for --check (compact "
+                         "aggregate names; suffix -infer for deployment "
+                         "rows). Default: two fast always-runnable rows")
+    ap.add_argument("--check-tolerance", type=float, default=0.08,
+                    help="allowed fractional shortfall per row before "
+                         "--check fails (default 0.08 — run-to-run "
+                         "variance on the tunnel is ~±5%%)")
     args = ap.parse_args()
 
     def run_one_subprocess(m, infer=False, coldstart=False):
@@ -554,6 +570,57 @@ def main():
         return row
 
     import subprocess
+    if args.check is not None:
+        # Perf-regression gate (round-4 VERDICT #8): round-5 edits must
+        # not trade one row for another unnoticed. Re-measures each
+        # requested row fresh (subprocess = fresh backend) and compares
+        # against the committed aggregate's same-named compact row.
+        with open(args.check) as f:
+            base = json.load(f)
+        base_rows = (base.get("parsed") or base).get("rows") or []
+        by_name = {r["m"]: r for r in base_rows}
+        regressions, checked = [], 0
+        for name in [m for m in args.check_models.split(",") if m]:
+            ref = by_name.get(name)
+            if ref is None or ref.get("v") is None:
+                print(json.dumps({"check": name, "status": "no-baseline"}),
+                      flush=True)
+                continue
+            if name.endswith("-coldstart"):
+                m, kw = name[:-len("-coldstart")], {"coldstart": True}
+            elif name.endswith("-infer"):
+                m, kw = name[:-len("-infer")], {"infer": True}
+            else:
+                m, kw = name, {}
+            row = run_one_subprocess(m, **kw)
+            v = row.get("value")
+            checked += 1
+            if v is None:
+                regressions.append(name)
+                status = "ERROR"
+                ratio = None
+            else:
+                ratio = round(v / ref["v"], 3)
+                # latency-unit rows (cold-start seconds) regress UP
+                lower_better = (ref.get("u") or "").startswith("second")
+                ok_row = (v <= ref["v"] * (1.0 + args.check_tolerance)
+                          if lower_better else
+                          v >= ref["v"] * (1.0 - args.check_tolerance))
+                status = "ok" if ok_row else "REGRESSION"
+                if not ok_row:
+                    regressions.append(name)
+            print(json.dumps({"check": name, "value": v,
+                              "baseline": ref["v"], "ratio": ratio,
+                              "status": status}), flush=True)
+        print(json.dumps({
+            "metric": f"perf-check vs {args.check} "
+                      f"(tol {args.check_tolerance:.0%})",
+            "value": checked - len(regressions), "unit": f"of {checked} "
+            f"rows ok", "vs_baseline": None,
+            "regressions": regressions}))
+        # a gate that measured nothing (all names missed the baseline)
+        # must fail loudly, not report success
+        sys.exit(1 if (regressions or checked == 0) else 0)
     if args.all is not None:
         models_ = ([m for m in args.all.split(",") if m] if args.all
                    else sorted(DEFAULT_BATCH_SIZES))
