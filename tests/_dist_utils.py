@@ -30,6 +30,22 @@ def build_deepfm_small(is_train: bool = True):
     return main_p, startup, loss
 
 
+def noisy_deepfm_labels(rng, ids) -> np.ndarray:
+    """Training labels for the dist suites: `ids[:,0,0] % 2` with ~5% of
+    examples flipped per OCCURRENCE (fresh randomness each batch, so the
+    noise is irreducible — a deterministic flip would just be a
+    relearnable relabeling). Why the floor matters (r5 stability loop,
+    two distinct 1-in-10 failures): on the perfectly separable task the
+    sync baseline drives the loss to ~1e-9, which (a) makes relative
+    tolerance bands meaningless and (b) saturates the softmax so a
+    single stale async push explodes the loss (observed 1e-6 → 8.0).
+    With a ~5% noise floor the trained model stays at p≈0.95 and
+    gradients stay bounded."""
+    base = (ids[:, 0, 0] % 2).astype(np.float32)
+    flip = (rng.rand(ids.shape[0]) < 0.05).astype(np.float32)
+    return np.abs(base - flip)[:, None]
+
+
 def eval_deepfm_loss(scope, label_fn=None) -> float:
     """Held-out batch loss under the params in `scope`. label_fn(ids) ->
     label column; default matches the convergence-matrix data regime."""

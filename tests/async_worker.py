@@ -9,6 +9,9 @@ import sys
 
 # launched as `python tests/async_worker.py` — sys.path[0] is tests/
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _dist_utils import noisy_deepfm_labels  # noqa: E402
 
 import numpy as np
 
@@ -55,7 +58,7 @@ def main():
         for n, v in client.pull(params).items():
             scope.set_var(n, v)
         ids = rng.randint(0, 64, size=(16, 4, 1)).astype("int64")
-        label = (ids[:, 0, 0] % 2).astype("float32")[:, None]
+        label = noisy_deepfm_labels(rng, ids)
         outs = exe.run(trainer_prog, feed={"feat_ids": ids, "label": label},
                        fetch_list=[loss.name] + grads, scope=scope)
         losses.append(float(np.asarray(outs[0]).reshape(())))

@@ -36,9 +36,12 @@ def _build_deepfm(seed=3):
 
 
 def _batch(rng, n=16):
+    from _dist_utils import noisy_deepfm_labels
     ids = rng.randint(0, 64, size=(n, 4, 1)).astype("int64")
-    label = (ids[:, 0, 0] % 2).astype("float32")[:, None]
-    return ids, label
+    # ~5% label noise: keeps the separable task's loss floor away from 0
+    # so async staleness can't blow up a saturated softmax (see
+    # _dist_utils.noisy_deepfm_labels)
+    return ids, noisy_deepfm_labels(rng, ids)
 
 
 def test_async_apply_grad_updates_params_without_barrier():
