@@ -54,9 +54,8 @@ def kernel_enabled(min_align: int = 128, *dims) -> bool:
 
 
 from paddle_tpu.ops.pallas.flash_attention import (  # noqa: E402,F401
-    flash_attention, flash_attention_lse, pick_blocks)
+    flash_attention, flash_attention_lse, flash_engage, pick_blocks)
 from paddle_tpu.ops.pallas.fused_ce import fused_linear_ce  # noqa: E402,F401
-from paddle_tpu.ops.pallas.fused_rnn import (fused_gru_sequence,  # noqa: E402,F401
-                                             fused_lstm_sequence,
+from paddle_tpu.ops.pallas.fused_rnn import (fused_gru_train,  # noqa: E402,F401
                                              fused_lstm_train)
 from paddle_tpu.ops.pallas.seqpool import masked_seqpool  # noqa: E402,F401

@@ -183,19 +183,24 @@ def _dynamic_gru(ctx, ins, attrs):
     xt_seq = jnp.swapaxes(x, 0, 1)
 
     # Pallas tier (ops/pallas/fused_rnn.py): whole-sequence kernel with h
-    # resident in VMEM — plain cell only (default activations, no
-    # masking/reverse), hardware-aligned dims (same gating as
-    # _dynamic_lstm's fused path)
-    if (ctx.is_test and not is_reverse and seq_lens is None
+    # resident in VMEM, TRAINABLE via custom-VJP with in-kernel seq-length
+    # masking (same design as _dynamic_lstm's fused path — gates
+    # recomputed in the backward, dh carry + dw accumulator on-chip);
+    # plain cell only (default activations, no reverse), aligned dims
+    if (not is_reverse
             and attrs.get("gate_activation", "sigmoid") == "sigmoid"
             and attrs.get("activation", "tanh") == "tanh"):
         from paddle_tpu.ops import pallas as pk
-        vmem_bytes = (H * 3 * H + 2 * B * 3 * H + 2 * B * H) * 4
+        vmem_bytes = (2 * H * 3 * H + 4 * B * 3 * H + 8 * B * H) * 4
         if (pk.kernel_enabled(128, H) and B % 8 == 0
-                and vmem_bytes <= 8 * 1024 * 1024):
-            hid_tm = pk.fused_gru_sequence(xt_seq, w, h, False)
-            hidden = jnp.swapaxes(hid_tm, 0, 1)
-            return {"Hidden": [hidden], "LastHidden": [hidden[:, -1]]}
+                and vmem_bytes <= 12 * 1024 * 1024):
+            sl = (seq_lens.reshape(-1, 1).astype(jnp.int32)
+                  if seq_lens is not None
+                  else jnp.full((B, 1), T, jnp.int32))
+            hid_tm, h_last = pk.fused_gru_train(xt_seq, w.astype(x.dtype),
+                                                sl, h)
+            return {"Hidden": [jnp.swapaxes(hid_tm, 0, 1)],
+                    "LastHidden": [h_last]}
 
     def step(carry, xt_t):
         h_prev, t = carry

@@ -124,3 +124,76 @@ def test_zero_peepholes_match_plain_cell():
     np.testing.assert_allclose(cs, cs_r, rtol=2e-6, atol=2e-6)
     np.testing.assert_allclose(hl, hl_r, rtol=2e-6, atol=2e-6)
     np.testing.assert_allclose(cl, cl_r, rtol=2e-6, atol=2e-6)
+
+
+# -- trainable GRU --------------------------------------------------------
+
+from paddle_tpu.ops.pallas.fused_rnn import fused_gru_train  # noqa: E402
+
+
+def _ref_gru(xproj, w, seq_lens, h0):
+    """Mirror of ops/rnn_ops.py _dynamic_gru's scan (mask included)."""
+    T, B, H3 = xproj.shape
+    H = H3 // 3
+    w_ur = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+
+    def step(carry, xt):
+        h, t = carry
+        ur = jax.nn.sigmoid(xt[:, :2 * H] + h @ w_ur)
+        u, r = ur[:, :H], ur[:, H:]
+        c = jnp.tanh(xt[:, 2 * H:] + (r * h) @ w_c)
+        h_cand = (1.0 - u) * h + u * c
+        m = (t < seq_lens).astype(xproj.dtype)
+        h_new = m * h_cand + (1 - m) * h
+        return (h_new, t + 1), m * h_cand
+
+    (h_last, _), hs = jax.lax.scan(
+        step, (h0, jnp.asarray(0, jnp.int32)), xproj)
+    return hs, h_last
+
+
+def _make_gru(seed=0, T=6, B=8, H=128, ragged=True):
+    rng = np.random.RandomState(seed)
+    xproj = rng.randn(T, B, 3 * H).astype(np.float32) * 0.4
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.2
+    h0 = rng.randn(B, H).astype(np.float32) * 0.3
+    if ragged:
+        sl = rng.randint(1, T + 1, size=(B, 1)).astype(np.int32)
+        sl[0, 0] = T
+    else:
+        sl = np.full((B, 1), T, np.int32)
+    return (jnp.asarray(v) for v in (xproj, w, sl, h0))
+
+
+@pytest.mark.parametrize("ragged", [False, True],
+                         ids=["full-length", "ragged"])
+def test_gru_forward_parity(ragged):
+    xproj, w, sl, h0 = _make_gru(ragged=ragged)
+    hs, hl = fused_gru_train(xproj, w, sl, h0, True)
+    hs_r, hl_r = _ref_gru(xproj, w, sl, h0)
+    np.testing.assert_allclose(hs, hs_r, rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(hl, hl_r, rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("ragged", [False, True],
+                         ids=["full-length", "ragged"])
+def test_gru_gradient_parity(ragged):
+    xproj, w, sl, h0 = _make_gru(seed=5, ragged=ragged)
+    rng = np.random.RandomState(9)
+    ph = jnp.asarray(rng.randn(*xproj.shape[:2], w.shape[0]) * .1,
+                     jnp.float32)
+
+    def loss_fused(xproj, w, h0):
+        hs, hl = fused_gru_train(xproj, w, sl, h0, True)
+        return jnp.sum(hs * ph) + jnp.sum(hl ** 2)
+
+    def loss_ref(xproj, w, h0):
+        hs, hl = _ref_gru(xproj, w, sl, h0)
+        return jnp.sum(hs * ph) + jnp.sum(hl ** 2)
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(xproj, w, h0)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(xproj, w, h0)
+    for g, r, name in zip(got, want, ["dx", "dw", "dh0"]):
+        np.testing.assert_allclose(g, r, rtol=3e-5, atol=3e-5,
+                                   err_msg=name)

@@ -71,16 +71,19 @@ def test_flash_attention_grad_matches_refer():
 
 
 def test_fused_lstm_matches_dynamic_lstm():
-    from paddle_tpu.ops.pallas import fused_lstm_sequence
+    from paddle_tpu.ops.pallas import fused_lstm_train
     from paddle_tpu.core.registry import get_op, EmitContext
     t, b, hd = 5, 3, 4
     xproj = _r(t, b, 4 * hd, scale=0.5)
     w = _r(hd, 4 * hd, seed=1, scale=0.3)
     h0 = np.zeros((b, hd), np.float32)
     c0 = np.zeros((b, hd), np.float32)
-    hid, cell = fused_lstm_sequence(jnp.asarray(xproj), jnp.asarray(w),
-                                    jnp.asarray(h0), jnp.asarray(c0),
-                                    interpret=True)
+    # the production tier: zero peepholes + full lengths = plain cell
+    hid, cell, _, _ = fused_lstm_train(
+        jnp.asarray(xproj), jnp.asarray(w),
+        jnp.zeros((1, 3 * hd), jnp.float32),
+        jnp.full((b, 1), t, jnp.int32),
+        jnp.asarray(h0), jnp.asarray(c0), True)
     ctx = EmitContext(base_key=jax.random.PRNGKey(0))
     ref = get_op("dynamic_lstm").emit(
         ctx, {"Input": [jnp.asarray(xproj.transpose(1, 0, 2))],
@@ -185,14 +188,15 @@ def test_flash_attention_blockwise_bwd_cross_len():
 def test_fused_gru_matches_dynamic_gru():
     """GRU jit-tier parity (reference: operators/jit gru microkernels vs
     math/gru_compute.cc refer)."""
-    from paddle_tpu.ops.pallas import fused_gru_sequence
+    from paddle_tpu.ops.pallas import fused_gru_train
     from paddle_tpu.core.registry import get_op, EmitContext
     t, b, hd = 5, 3, 4
     xproj = _r(t, b, 3 * hd, scale=0.5)
     w = _r(hd, 3 * hd, seed=1, scale=0.3)
     h0 = np.zeros((b, hd), np.float32)
-    hid = fused_gru_sequence(jnp.asarray(xproj), jnp.asarray(w),
-                             jnp.asarray(h0), interpret=True)
+    hid, _ = fused_gru_train(jnp.asarray(xproj), jnp.asarray(w),
+                             jnp.full((b, 1), t, jnp.int32),
+                             jnp.asarray(h0), True)
     ctx = EmitContext(base_key=jax.random.PRNGKey(0))
     ref = get_op("dynamic_gru").emit(
         ctx, {"Input": [jnp.asarray(xproj.transpose(1, 0, 2))],
