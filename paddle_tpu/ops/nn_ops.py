@@ -810,17 +810,20 @@ def _fused_attention_block(ctx, ins, attrs):
                          ).astype(o.dtype)
         return single(_amp_out(out, attrs) if amp else out)
 
-    # long-context: route the dots through the Pallas flash kernels (same
-    # thresholds as parallel/ring_attention.full_attention — measured
-    # faster than XLA from T≈4096, O(T·D) HBM instead of O(T²)); the
-    # bthd↔bhtd transposes are negligible at these lengths
+    # Flash routing is BENCHMARK-DERIVED (pk.flash_engage reads the
+    # committed AUTOTUNE table from tools/flash_autotune.py): flash owns
+    # the region from T>=512 (model-verified: transformer_big 73.2k ->
+    # 77.1k tok/s at T=512/d=128) and all long-context shapes (O(T·D)
+    # HBM instead of O(T²)); below the crossover the fused block's
+    # relayout-free dots keep the row.
     h = n_head
     m = x_q.shape[-1]
     d = m // h
     from paddle_tpu.ops import pallas as pk
-    if pk.kernel_enabled(128, d) and t_q >= 2048:
-        bq, bk = pk.pick_blocks(t_q, t_k)
-        if bq and bk:
+    if pk.kernel_enabled(128, d):
+        eng = pk.flash_engage(t_q, t_k, d, causal)
+        if eng:
+            bq, bk = eng
             def proj_bhtd(x, w):
                 y = jax.lax.dot_general(x, w.reshape(m, h, d),
                                         (((2,), (0,)), ((), ())),

@@ -222,6 +222,88 @@ def pick_blocks(tq, tk):
     return bq, bk
 
 
+# Benchmark-derived kernel selection (round-4 VERDICT #4 — the
+# reference's jit-tier discipline: kernel_pool.cc Get() picks whichever
+# implementation won its own benchmark, not a hand threshold).
+# Produced by tools/flash_autotune.py on v5e (2026-08-01): fwd+bwd of
+# the attention REGION at 8192 tokens, (bq, bk) grid vs the XLA
+# fused-dot composition. (T, d_head, causal) -> best (bq, bk), or None
+# where XLA's composition won the region. Model-level verification of
+# the crossover: transformer_big (T=512, d=128, 6 enc + 12 dec regions)
+# moved 73.2k -> 77.1k tok/s (42.8 -> 45.1% MFU) when this table routed
+# it to flash; r04 had measured the OPPOSITE with the then-kernels
+# (133 vs 123 ms/step) — the hash-mask dropout + tuned blocks flipped
+# it, which is exactly why the rule must be a measured table.
+AUTOTUNE = {
+    # region fwd + full dq/dk/dv bwd, flash_ms vs xla_ms. Where a FULL
+    # MODEL row exists, its A/B overrides the region sweep (isolated
+    # regions mispredict block choice under real co-residency: the
+    # region-optimal (256,512) at 512/128/causal measured 76.5k tok/s
+    # on transformer_big vs 77.1k with (512,512); region-optimal
+    # (256,1024) at 2048/128 measured 186.2k on transformer_long vs
+    # 195.0k with the entries below) — entries marked MODEL.
+    (256, 64, False): (256, 256),    # 5.39 vs 6.03
+    (256, 64, True): None,           # 5.36 vs 5.06 — XLA wins
+    (256, 128, False): (256, 128),   # 4.40 vs 5.20
+    (256, 128, True): (256, 256),    # 5.72 vs 6.24
+    (512, 64, False): (256, 512),    # 5.87 vs 7.39
+    (512, 64, True): (256, 512),     # 5.81 vs 6.09
+    (512, 128, False): (512, 512),   # 5.76 vs 6.06
+    (512, 128, True): (512, 512),    # MODEL: transformer_big 77.1k
+    (1024, 64, False): (512, 1024),  # 6.08 vs 7.63
+    (1024, 64, True): (512, 1024),   # 6.05 vs 7.57
+    (1024, 128, False): (512, 1024),  # 6.00 vs 7.52
+    (1024, 128, True): (512, 1024),  # 6.06 vs 7.53
+    (2048, 64, False): (512, 1024),  # 6.98 vs 10.05
+    (2048, 64, True): (512, 1024),   # 6.58 vs 9.88
+    (2048, 128, False): (512, 1024),  # MODEL: transformer_long 195.0k
+    (2048, 128, True): (512, 512),   # MODEL: transformer_long 195.0k
+}
+
+
+def flash_engage(tq, tk, d, causal):
+    """(bq, bk) when the flash path is the measured winner for this
+    region shape, else None (composition/fused-block keeps the row).
+
+    Below T=512 the region wins in AUTOTUNE are within the
+    bthd<->bhtd boundary-transpose cost the composed path pays at the
+    model level (the r4 fused block won T=256 by +1.5 MFU), so the
+    crossover is T>=512 where the model-level A/B confirmed it. Shapes
+    beyond the table (T>2048, uneven tq/tk) fall back to the long-
+    context heuristic blocks that won the T=4096..16384 sweep."""
+    def _valid(blocks):
+        # never hand the caller a tuple with None inside (pick_blocks
+        # returns None entries for non-128-multiple lengths)
+        if blocks and blocks[0] and blocks[1] \
+                and tq % blocks[0] == 0 and tk % blocks[1] == 0:
+            return blocks
+        return None
+
+    if d not in (64, 128):
+        # beyond the benchmark grid: only the long-context regime
+        # (where flash's O(T·D) HBM advantage is shape-generic) engages
+        return _valid(pick_blocks(tq, tk)) if min(tq, tk) >= 2048 \
+            else None
+    if tq != tk:                      # cross-shape (beam decode etc.)
+        if min(tq, tk) >= 2048:
+            return _valid(pick_blocks(tq, tk))
+        return None
+    # T=256 model A/B measured a TIE (transformer base: 220.1k tok/s
+    # via flash vs 220.2k via the fused block) — the fused block keeps
+    # the row below the 512 crossover
+    if tq < 512:
+        return None
+    key = (tq, d, causal)
+    if key in AUTOTUNE:
+        blocks = AUTOTUNE[key]
+        if blocks is None:
+            return None
+        return _valid(blocks) or _valid(pick_blocks(tq, tk))
+    if tq >= 2048:                    # beyond the sweep grid
+        return _valid(pick_blocks(tq, tk))
+    return None
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal=False, scale=None, bq=128, bk=128,
                     interpret=False, dropout_p=0.0, seed=None):
