@@ -513,13 +513,14 @@ def main():
     ap.add_argument("--no-nhwc", dest="nhwc", action="store_false",
                     default=True, help="disable the channels-last layout "
                     "rewrite (contrib.layout)")
-    ap.add_argument("--check", nargs="?", const="BENCH_r04.json",
-                    default=None, metavar="BASELINE_JSON",
+    ap.add_argument("--check", nargs="?", const="", default=None,
+                    metavar="BASELINE_JSON",
                     help="perf-regression gate: re-run a row subset and "
                          "fail (exit 1) if any row regresses more than "
                          "--check-tolerance below the committed aggregate "
-                         "(default baseline: BENCH_r04.json; accepts the "
-                         "driver artifact or a raw aggregate line)")
+                         "(default baseline: the newest BENCH_r*.json; "
+                         "accepts the driver artifact or a raw aggregate "
+                         "line)")
     ap.add_argument("--check-models", default="mnist,transformer",
                     metavar="M1,M2",
                     help="rows to re-measure for --check (compact "
@@ -575,6 +576,24 @@ def main():
         # not trade one row for another unnoticed. Re-measures each
         # requested row fresh (subprocess = fresh backend) and compares
         # against the committed aggregate's same-named compact row.
+        if not args.check:            # default: newest COMMITTED round —
+            # a fresh uncommitted sweep artifact must never become its
+            # own baseline (the gate would compare the run to itself)
+            import os
+            repo = os.path.dirname(os.path.abspath(__file__))
+            try:
+                tracked = subprocess.run(
+                    ["git", "ls-files", "BENCH_r*.json"], cwd=repo,
+                    capture_output=True, text=True, check=True
+                ).stdout.split()
+            except (OSError, subprocess.CalledProcessError):
+                import glob                   # non-git checkout fallback
+                tracked = sorted(os.path.basename(p) for p in
+                                 glob.glob(os.path.join(repo,
+                                                        "BENCH_r*.json")))
+            if not tracked:
+                ap.error("--check: no committed BENCH_r*.json baseline")
+            args.check = os.path.join(repo, sorted(tracked)[-1])
         with open(args.check) as f:
             base = json.load(f)
         base_rows = (base.get("parsed") or base).get("rows") or []
