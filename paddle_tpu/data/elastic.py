@@ -28,7 +28,8 @@ class ElasticTrainer:
                  chunks_per_task: Optional[int] = None,
                  lease_timeout_s: Optional[float] = None,
                  checkpoint_every: int = 1, max_to_keep: int = 3,
-                 master=None):
+                 master=None, poll_interval_s: float = 0.05,
+                 max_poll_interval_s: float = 1.0):
         """``master=None`` (single-worker): an in-process Master owning
         the queue, recovered from/snapshotted to work_dir. ``master=``
         a MasterClient (or any Master duck): MULTI-WORKER mode — N
@@ -57,7 +58,14 @@ class ElasticTrainer:
         apply; tests/test_edl_integration.py), or sync-dp where every
         worker holds identical state and any survivor's checkpoint is
         the model's. Worker-local checkpoints here are restart
-        accelerators, not the source of truth."""
+        accelerators, not the source of truth.
+
+        ``poll_interval_s``/``max_poll_interval_s``: the idle poll when
+        nothing is leasable starts at the former and backs off
+        exponentially (capped at the latter), resetting on every granted
+        lease — a worker waiting out other workers' leases doesn't spin
+        the master at a fixed cadence. Worker-loop knobs, so they remain
+        valid together with ``master=``."""
         # None-sentinel defaults so EXPLICITLY passing a queue-config arg
         # together with master= always raises — even if the value happens
         # to equal the single-worker default
@@ -75,10 +83,22 @@ class ElasticTrainer:
         self.work_dir = work_dir
         os.makedirs(work_dir, exist_ok=True)
         self._snap_path = os.path.join(work_dir, "master_snapshot.json")
+        self._poll_s = float(poll_interval_s)
+        self._max_poll_s = max(float(max_poll_interval_s), self._poll_s)
+        self._sleep = time.sleep     # injectable for deterministic tests
         self._owns_master = master is None
         if master is not None:
             self.master = master
         else:
+            # a crash between Master.snapshot(tmp) and the checkpointer's
+            # _promote leaks master_snapshot.json.tmp<serial> files — at
+            # startup no save is in flight, so any survivor is garbage
+            import glob
+            for orphan in glob.glob(glob.escape(self._snap_path) + ".tmp*"):
+                try:
+                    os.remove(orphan)
+                except OSError:
+                    pass
             self.master = Master(timeout_s=lease_timeout_s)
             if os.path.exists(self._snap_path):
                 # resume: finished chunks stay finished, leases reset
@@ -114,16 +134,21 @@ class ElasticTrainer:
         if stats["todo"] + stats["pending"] + stats["done"] == 0:
             return        # nothing to train (empty task list) — not done-able
         done_since_ckpt = 0
+        idle_s = self._poll_s
         while not self.master.done:
             task = self.master.get_task()
             if task is None:
                 # nothing leasable right now (all leased elsewhere or
                 # awaiting timeout) — in-process single worker: just stop
-                # if also nothing pending
+                # if also nothing pending. Capped exponential backoff:
+                # long waits (another worker's lease expiring) shouldn't
+                # poll the master at the granted-lease cadence
                 if self.master.done:
                     break
-                time.sleep(0.05)
+                self._sleep(idle_s)
+                idle_s = min(idle_s * 2, self._max_poll_s)
                 continue
+            idle_s = self._poll_s       # work granted: reset the backoff
             try:
                 train_chunk(task)
             except Exception:

@@ -104,7 +104,10 @@ class Master:
         rolling back the persist-before-reply guarantee."""
         import os
         import threading
+
+        from paddle_tpu.utils import faults
         with self._snap_lock:
+            faults.inject("master.snapshot")   # chaos: disk trouble here
             tmp = f"{path}.tmp{os.getpid()}_{threading.get_ident()}"
             if native.lib().ptpu_master_snapshot(self._h, tmp.encode()) != 0:
                 raise IOError(f"snapshot to {tmp!r} failed")

@@ -44,11 +44,30 @@ import os
 import socket
 import socketserver
 import threading
+import time
+import uuid
 from typing import Optional, Tuple
 
 from paddle_tpu.data.master import Master, Task
+from paddle_tpu.distributed.resilience import RetryError, RetryPolicy
+from paddle_tpu.utils import faults
 
 MASTER_ENV = "PADDLE_MASTER"
+
+
+class MasterUnavailableError(ConnectionError):
+    """The master endpoint could not be reached within the client's retry
+    budget. Carries ``endpoint`` and ``attempts`` so a dying worker's log
+    says exactly what it dialed and how hard it tried (the opaque
+    ``ConnectionRefusedError`` it replaces said neither)."""
+
+    def __init__(self, endpoint: str, attempts: int, elapsed_s: float,
+                 last: BaseException):
+        super().__init__(
+            f"master at {endpoint} unavailable after {attempts} "
+            f"attempt(s) over {elapsed_s:.2f}s (last error: {last!r})")
+        self.endpoint = endpoint
+        self.attempts = attempts
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -84,11 +103,44 @@ class _Handler(socketserver.StreamRequestHandler):
             master.snapshot(sp)
 
     @staticmethod
+    def _touch_worker(server, wid: str, add_lease=None, drop_lease=None,
+                      register=False):
+        """Refresh a worker in the heartbeat registry, optionally
+        recording/clearing a lease in the same critical section (so the
+        reaper can never observe a registered worker without its fresh
+        lease). Only a ``heartbeat`` request REGISTERS a worker
+        (``register=True``): merely carrying a worker_id on get_task must
+        not opt a client into reaping, because a worker silently training
+        a long chunk is indistinguishable from a dead one — reap-by-
+        silence is only safe for workers that promised to keep beating
+        (start_heartbeat runs in a background thread, so long chunks
+        don't go silent). Returns False when the server was built without
+        heartbeat reaping, the request was anonymous, or the worker is
+        not (yet) registered."""
+        reg = getattr(server, "workers", None)
+        if reg is None or not wid:
+            return False
+        with server.workers_lock:
+            rec = reg.get(wid)
+            if rec is None:
+                if not register:
+                    return False
+                rec = reg[wid] = {"last": 0.0, "leases": set()}
+            rec["last"] = time.monotonic()
+            if add_lease is not None:
+                rec["leases"].add(add_lease)
+            if drop_lease is not None:
+                rec["leases"].discard(drop_lease)
+            return True
+
+    @staticmethod
     def _dispatch(master: Master, req: dict, server=None) -> dict:
         method = req.get("method")
+        wid = str(req.get("worker") or "")
         if method == "get_task":
             t = master.get_task()
             if t is None:
+                _Handler._touch_worker(server, wid)
                 return {"ok": True, "task": None, "done": master.done}
             try:
                 _Handler._persist(master, server)   # the new lease
@@ -98,6 +150,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 # full lease window (disk trouble must not stall drains)
                 master.task_failed(t)
                 raise
+            _Handler._touch_worker(server, wid, add_lease=(t.id, t.epoch))
             return {"ok": True, "done": False,
                     "task": {"id": t.id, "epoch": t.epoch, "path": t.path,
                              "chunk_begin": t.chunk_begin,
@@ -109,7 +162,26 @@ class _Handler(socketserver.StreamRequestHandler):
             accepted = bool(fn(t))
             if accepted:
                 _Handler._persist(master, server)
+            _Handler._touch_worker(server, wid, drop_lease=(t.id, t.epoch))
             return {"ok": True, "accepted": accepted}
+        if method == "heartbeat":
+            # liveness signal — the one request that REGISTERS a worker
+            # for reaping: lets the reaper re-issue a silent worker's
+            # leases well before the full lease timeout (the reference
+            # only discovers dead workers by lease expiry,
+            # go/master checkTimeoutFunc)
+            return {"ok": True, "beat": _Handler._touch_worker(
+                server, wid, register=True)}
+        if method == "workers":
+            reg = getattr(server, "workers", None)
+            if reg is None:
+                return {"ok": True, "workers": None}
+            now = time.monotonic()
+            with server.workers_lock:
+                return {"ok": True, "workers": {
+                    w: {"age_s": now - rec["last"],
+                        "leases": len(rec["leases"])}
+                    for w, rec in reg.items()}}
         if method == "stats":
             s = master.stats()
             s["done_flag"] = master.done
@@ -150,7 +222,9 @@ class MasterServer:
 
     def __init__(self, master: Master, host: str = "127.0.0.1",
                  port: int = 0, snapshot_root: Optional[str] = None,
-                 snapshot_path: Optional[str] = None):
+                 snapshot_path: Optional[str] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 reap_interval_s: Optional[float] = None):
         """``snapshot_root``: directory wire-requested snapshots are
         confined to (clients name only the file). None (default)
         disables the wire ``snapshot`` method entirely.
@@ -163,7 +237,19 @@ class MasterServer:
         restarted master resumes the drain in place — pending leases
         survive with their epochs, so in-flight workers' reports are
         still accepted exactly-once); every accepted lease/report is
-        then snapshotted back atomically before its reply is sent."""
+        then snapshotted back atomically before its reply is sent.
+
+        ``heartbeat_timeout_s``: enable the worker heartbeat registry —
+        clients that REGISTER by heartbeating (``MasterClient.
+        start_heartbeat()``; a worker_id alone does not opt in) and then
+        go silent for longer than this have their outstanding leases
+        failed back to the queue by a background reaper, re-issuing the
+        chunks well before the C++ lease timeout fires. The lease epoch
+        keeps this safe: if the "dead" worker was merely slow, its late
+        report is rejected as stale — a chunk is never counted twice.
+        Workers that never beat keep pure lease-expiry semantics.
+        ``reap_interval_s`` defaults to a quarter of the heartbeat
+        timeout."""
         self.master = master
         if snapshot_root is not None:
             os.makedirs(snapshot_root, exist_ok=True)
@@ -190,6 +276,17 @@ class MasterServer:
         self._server.master = master  # type: ignore[attr-defined]
         self._server.snapshot_root = snapshot_root  # type: ignore
         self._server.snapshot_path = snapshot_path  # type: ignore
+        self._hb_timeout = heartbeat_timeout_s
+        self._reap_stop = threading.Event()
+        self._reaper: Optional[threading.Thread] = None
+        if heartbeat_timeout_s is not None:
+            self._server.workers = {}  # type: ignore[attr-defined]
+            self._server.workers_lock = threading.Lock()  # type: ignore
+            self._reap_interval = (reap_interval_s
+                                   if reap_interval_s is not None
+                                   else heartbeat_timeout_s / 4.0)
+        else:
+            self._server.workers = None  # type: ignore[attr-defined]
         if snapshot_path:
             # durable from the very first moment served — a crash before
             # the first report must still recover the full queue
@@ -198,6 +295,34 @@ class MasterServer:
             target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True)
         self._thread.start()
+        if heartbeat_timeout_s is not None:
+            self._reaper = threading.Thread(target=self._reap_loop,
+                                            daemon=True)
+            self._reaper.start()
+
+    def _reap_loop(self):
+        """Fail the outstanding leases of workers whose heartbeat went
+        silent — the chunk re-issues to a survivor immediately instead of
+        stranding for the full lease window. Epoch checks make a racing
+        late report stale, never double-counted."""
+        while not self._reap_stop.wait(self._reap_interval):
+            now = time.monotonic()
+            dead = []
+            with self._server.workers_lock:
+                for wid, rec in list(self._server.workers.items()):
+                    if now - rec["last"] > self._hb_timeout:
+                        dead.append((wid, set(rec["leases"])))
+                        del self._server.workers[wid]
+            changed = False
+            for wid, leases in dead:
+                for tid, epoch in leases:
+                    if self.master.task_failed(Task(tid, epoch, "", 0, 0)):
+                        changed = True
+            if changed and getattr(self._server, "snapshot_path", None):
+                try:
+                    self.master.snapshot(self._server.snapshot_path)
+                except Exception:
+                    pass   # next accepted report persists the state
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -209,6 +334,9 @@ class MasterServer:
         return f"{host}:{port}"
 
     def stop(self):
+        self._reap_stop.set()
+        if self._reaper is not None:
+            self._reaper.join(timeout=5)
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5)
@@ -231,7 +359,20 @@ class MasterClient:
 
     def __init__(self, endpoint: Optional[str] = None,
                  timeout_s: float = 30.0,
-                 reconnect_timeout_s: float = 60.0):
+                 reconnect_timeout_s: float = 60.0,
+                 max_attempts: int = 256,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 worker_id: Optional[str] = None):
+        """``max_attempts``/``reconnect_timeout_s`` bound the retry budget
+        (whichever exhausts first raises :class:`MasterUnavailableError`);
+        ``retry_policy`` overrides both with a fully custom policy.
+
+        ``worker_id`` stamps every request with this client's identity;
+        the first :meth:`heartbeat` (see :meth:`start_heartbeat`) then
+        REGISTERS it in the server's reaping registry so a server built
+        with ``heartbeat_timeout_s`` re-issues this worker's leases
+        quickly if it goes silent. An id without beats — or no id at
+        all — keeps pure lease-expiry semantics."""
         endpoint = endpoint or os.environ.get(MASTER_ENV)
         if not endpoint:
             raise ValueError(
@@ -240,6 +381,14 @@ class MasterClient:
         self._addr = (host, int(port))
         self._timeout = timeout_s
         self._reconnect_timeout = reconnect_timeout_s
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=max_attempts, base_delay_s=0.05, max_delay_s=1.0,
+            deadline_s=reconnect_timeout_s,
+            retryable=(ConnectionError, OSError, json.JSONDecodeError))
+        self.worker_id = worker_id
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_retry: Optional[RetryPolicy] = None
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
@@ -247,9 +396,11 @@ class MasterClient:
         self._polled = False
 
     # -- wire ------------------------------------------------------------
-    def _connect(self):
+    def _connect(self, timeout: Optional[float] = None):
         self._close_sock()
-        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s = socket.create_connection(
+            self._addr, timeout=self._timeout if timeout is None
+            else min(timeout, self._timeout))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
         self._rfile = s.makefile("rb")
@@ -263,9 +414,14 @@ class MasterClient:
                     pass
         self._sock = self._rfile = None
 
-    def _call(self, req: dict, idempotent: bool = True) -> dict:
-        """One request/reply, retried with exponential backoff across
-        connection failures until ``reconnect_timeout_s`` is exhausted.
+    def _call(self, req: dict, idempotent: bool = True,
+              retry: Optional[RetryPolicy] = None,
+              op_timeout_s: Optional[float] = None) -> dict:
+        """One request/reply, retried under the client's
+        :class:`RetryPolicy` (exponential backoff, full jitter, bounded
+        by both attempt count and ``reconnect_timeout_s``). A spent
+        budget raises :class:`MasterUnavailableError` naming the endpoint
+        and attempt count instead of the opaque socket error it used to.
 
         Delivery is AT-LEAST-ONCE for every method, including the report
         RPCs (``idempotent`` is kept for signature stability): a resend
@@ -276,29 +432,46 @@ class MasterClient:
         master is therefore at-most-once, and with the server's persist
         -before-reply ordering an acked report is never lost across a
         master restart."""
-        import time
+        if self.worker_id and "worker" not in req:
+            req = dict(req, worker=self.worker_id)
+
+        def attempt():
+            try:
+                if self._sock is None:
+                    self._connect(timeout=op_timeout_s)
+                if op_timeout_s is not None:
+                    # bound THIS op's socket waits (heartbeats: a beat
+                    # against a blackholed master must not hold the
+                    # client lock for the full timeout_s)
+                    self._sock.settimeout(op_timeout_s)
+                faults.inject("master.rpc.send")
+                self._sock.sendall((json.dumps(req) + "\n").encode())
+                faults.inject("master.rpc.recv")
+                line = self._rfile.readline()
+                if not line:
+                    raise ConnectionError("master closed connection")
+                resp = json.loads(line)
+                if op_timeout_s is not None:
+                    # the connection is shared: restore the default
+                    # timeout for whatever RPC reuses it next
+                    self._sock.settimeout(self._timeout)
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                self._close_sock()    # next attempt re-dials
+                raise
+            if not resp.get("ok"):
+                # a server-side error is not a connectivity problem:
+                # surface it immediately (non-retryable)
+                raise RuntimeError(f"master error: {resp.get('error')}")
+            return resp
+
         with self._lock:
-            deadline = time.monotonic() + self._reconnect_timeout
-            delay = 0.05
-            while True:
-                try:
-                    if self._sock is None:
-                        self._connect()
-                    self._sock.sendall((json.dumps(req) + "\n").encode())
-                    line = self._rfile.readline()
-                    if not line:
-                        raise ConnectionError("master closed connection")
-                    resp = json.loads(line)
-                    if not resp.get("ok"):
-                        raise RuntimeError(
-                            f"master error: {resp.get('error')}")
-                    return resp
-                except (ConnectionError, OSError, json.JSONDecodeError):
-                    self._close_sock()
-                    if time.monotonic() + delay > deadline:
-                        raise
-                    time.sleep(delay)
-                    delay = min(delay * 2, 1.0)
+            try:
+                return (retry or self._retry).call(
+                    attempt, what=str(req.get("method")))
+            except RetryError as e:
+                raise MasterUnavailableError(
+                    f"{self._addr[0]}:{self._addr[1]}", e.attempts,
+                    e.elapsed_s, e.__cause__) from e.__cause__
 
     # -- Master duck interface ------------------------------------------
     def get_task(self) -> Optional[Task]:
@@ -353,6 +526,63 @@ class MasterClient:
         except Exception:
             return False
 
+    # -- liveness ---------------------------------------------------------
+    def heartbeat(self) -> bool:
+        """One liveness beat to the server's worker registry (requires a
+        ``worker_id``; a server without heartbeat reaping replies
+        ``beat: false`` and the beat is a harmless ping). Beats get a
+        near-zero retry budget AND a ~1s socket timeout on purpose: a
+        beat must never hold the client lock for the full connect/read
+        budget during an outage (blackholed master included) — losing
+        one is fine, the next tick replaces it."""
+        if not self.worker_id:
+            self.worker_id = uuid.uuid4().hex
+        if self._hb_retry is None:
+            self._hb_retry = RetryPolicy(
+                max_attempts=2, base_delay_s=0.01, max_delay_s=0.05,
+                deadline_s=1.0,
+                retryable=(ConnectionError, OSError,
+                           json.JSONDecodeError))
+        return bool(self._call({"method": "heartbeat"},
+                               retry=self._hb_retry,
+                               op_timeout_s=1.0).get("beat"))
+
+    def start_heartbeat(self, interval_s: float = 1.0):
+        """Beat in the background until :meth:`close`. The FIRST beat is
+        sent synchronously so the registration precedes any lease this
+        worker takes afterwards — a lease leased before the worker is
+        registered is invisible to the reaper (it falls back to plain
+        lease-expiry). Subsequent beats are best-effort: one lost to a
+        master outage is replaced by the next tick (the reaper tolerates
+        gaps up to its heartbeat timeout)."""
+        if self._hb_thread is not None:
+            return
+        if not self.worker_id:
+            self.worker_id = uuid.uuid4().hex
+        try:
+            self.heartbeat()          # register before the first lease
+        except Exception:
+            pass                      # master briefly away: next tick
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat()
+                except Exception:
+                    pass
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def workers(self) -> Optional[dict]:
+        """Heartbeat registry snapshot ({worker_id: {age_s, leases}}), or
+        None when the server runs without heartbeat reaping."""
+        return self._call({"method": "workers"}).get("workers")
+
     def close(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
         with self._lock:
             self._close_sock()

@@ -104,5 +104,26 @@ class fleet:
         )()
         jax.block_until_ready(x)
 
-from paddle_tpu.distributed.async_pserver import (  # noqa: E402,F401
-    AsyncPServer, AsyncTrainerClient)
+from paddle_tpu.distributed.resilience import (  # noqa: E402,F401
+    CircuitBreaker, CircuitOpenError, RetryError, RetryPolicy, Unretryable)
+
+
+def __dir__():
+    # lazy attributes must still show up on the documented surface
+    # (tools/diff_api.py enumerates via dir())
+    return sorted(set(globals())
+                  | {"AsyncPServer", "AsyncTrainerClient", "async_pserver"})
+
+
+def __getattr__(name):
+    # Lazy: async_pserver pulls fluid.framework/transpiler, and this
+    # package is imported (via data.master_service → resilience) while
+    # fluid/__init__ is still mid-execution — importing it eagerly here
+    # would re-enter the partially initialized fluid package.
+    if name in ("AsyncPServer", "AsyncTrainerClient", "async_pserver"):
+        import importlib
+        mod = importlib.import_module("paddle_tpu.distributed.async_pserver")
+        if name == "async_pserver":
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

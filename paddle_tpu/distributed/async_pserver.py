@@ -261,33 +261,89 @@ class AsyncTrainerClient:
     """Trainer-side connection: push gradients the moment the backward
     produces them, pull current params whenever convenient — no barriers
     (reference trainer half in async mode: send without send_barrier,
-    distribute_transpiler.py sync_mode=False)."""
+    distribute_transpiler.py sync_mode=False).
+
+    Hardened: RPCs run under a shared :class:`RetryPolicy` (exponential
+    backoff + full jitter) behind a :class:`CircuitBreaker` so a flapping
+    pserver is re-dialed with bounded patience and a dead one fast-fails
+    instead of hanging every step. Idempotency-aware: ``pull`` is
+    retried across any connection failure; ``push_grad`` is retried only
+    while *establishing* the connection — once the push was sent, a
+    connection death is NOT retried (the server may already have applied
+    the gradient; resending would apply it twice)."""
 
     def __init__(self, address, authkey: bytes = b"paddle_tpu",
-                 trainer_id: int = 0):
-        from multiprocessing.connection import Client
-        self._conn = Client(tuple(address), authkey=authkey)
+                 trainer_id: int = 0, retry_policy=None, breaker=None):
+        from paddle_tpu.distributed.resilience import (CircuitBreaker,
+                                                       RetryPolicy)
+        self._addr = tuple(address)
+        self._authkey = authkey
         self.trainer_id = int(trainer_id)
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=6, base_delay_s=0.02, max_delay_s=0.5,
+            deadline_s=15.0,
+            retryable=(ConnectionError, OSError, EOFError))
+        self._breaker = breaker or CircuitBreaker(failure_threshold=8,
+                                                  reset_timeout_s=2.0)
+        self._conn = None
+        self._connect()       # fail fast on a bad address, like before
+
+    def _connect(self):
+        from multiprocessing.connection import Client
+        self._conn = Client(self._addr, authkey=self._authkey)
+
+    def _drop_conn(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def _rpc(self, msg, site: str, idempotent: bool = True):
+        from paddle_tpu.distributed.resilience import Unretryable
+        from paddle_tpu.utils import faults
+
+        def attempt():
+            faults.inject(site)
+            if self._conn is None:
+                self._connect()          # connect errors are retryable
+            try:
+                self._conn.send(msg)
+                return self._conn.recv()
+            except (EOFError, OSError, ConnectionError) as e:
+                self._drop_conn()
+                if idempotent:
+                    raise
+                # the request may have been applied before the wire died:
+                # surface instead of resending (at-most-once for pushes)
+                raise Unretryable(e)
+
+        return self._breaker.call(
+            lambda: self._retry.call(attempt, what=msg[0]))
 
     def push_grad(self, name: str, value) -> None:
-        self._conn.send(("push", name, np.asarray(value), self.trainer_id))
-        kind, *rest = self._conn.recv()
+        kind, *rest = self._rpc(
+            ("push", name, np.asarray(value), self.trainer_id),
+            "pserver.push_grad", idempotent=False)
         if kind != "ok":
             raise RuntimeError(f"push_grad {name}: {rest}")
 
     def pull(self, names: List[str]) -> Dict[str, np.ndarray]:
-        self._conn.send(("pull", list(names), self.trainer_id))
-        kind, *rest = self._conn.recv()
+        kind, *rest = self._rpc(("pull", list(names), self.trainer_id),
+                                "pserver.pull")
         if kind != "params":
             raise RuntimeError(f"pull: {rest}")
         return rest[0]
 
     def stop_server(self):
         try:
+            if self._conn is None:
+                self._connect()
             self._conn.send(("stop",))
             self._conn.recv()
         except (EOFError, OSError):
             pass
 
     def close(self):
-        self._conn.close()
+        self._drop_conn()

@@ -113,6 +113,16 @@ define("fraction_of_gpu_memory_to_use", float, 1.0,
        "Accepted for API parity (reference allocator knob) — PJRT "
        "preallocation is controlled by XLA_PYTHON_CLIENT_* instead; "
        "no-op.")
+define("fault_plan", str, "",
+       "Deterministic fault-injection plan for the chaos harness "
+       "(paddle_tpu.utils.faults): 'site:mode[@sched][:k=v]...' specs "
+       "joined by ';', e.g. "
+       "'master.rpc.send:raise@2:exc=ConnectionError;"
+       "ckpt.write_shard:truncate@1:to=16'. Loaded lazily at the first "
+       "instrumented site hit; see docs/robustness.md.")
+define("fault_seed", int, 0,
+       "Seed for probabilistic fault schedules ('p0.1'): per-site RNG "
+       "streams are keyed by (seed, site) so chaos runs replay exactly.")
 
 
 def _main():

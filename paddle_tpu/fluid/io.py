@@ -37,13 +37,22 @@ def _persistable_names(program) -> List[str]:
 def _write_snapshot_dir(dirname: str, snapshot) -> List[str]:
     """Serialize {name: ndarray} to dirname with the manifest — the single
     definition of the on-disk layout shared by save_vars and the async
-    checkpointer (load_vars reads this layout back)."""
+    checkpointer (load_vars reads this layout back). Each file's CRC32 is
+    recorded in the manifest and re-verified by load_vars, so a var file
+    torn after the save looked complete fails loudly instead of loading
+    garbage weights."""
+    from paddle_tpu.fluid.sharded_io import _crc32_file
+    from paddle_tpu.utils import faults
     os.makedirs(dirname, exist_ok=True)
+    crcs = {}
     for name, arr in snapshot.items():
-        np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
-                arr)
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        faults.inject("ckpt.write_var")
+        np.save(path, arr)
+        crcs[name] = _crc32_file(path)
+        faults.mutate_file("ckpt.write_var", path)   # tear post-checksum
     with open(os.path.join(dirname, _MANIFEST), "w") as f:
-        json.dump({"vars": sorted(snapshot)}, f)
+        json.dump({"vars": sorted(snapshot), "crc32": crcs}, f)
     return sorted(snapshot)
 
 
@@ -95,19 +104,33 @@ def load_vars(executor, dirname, main_program=None,
     dp=8/dp=1)."""
     scope = scope or global_scope()
     from paddle_tpu.fluid import sharded_io
-    if not os.path.exists(os.path.join(dirname, _MANIFEST)) and \
-            sharded_io.is_sharded_dir(dirname):
+    mpath = os.path.join(dirname, _MANIFEST)
+    if not os.path.exists(mpath) and sharded_io.is_sharded_dir(dirname):
         return sharded_io.load_sharded(dirname, scope, vars=vars,
                                        sharding_fn=sharding_fn)
-    if vars is None:
-        with open(os.path.join(dirname, _MANIFEST)) as f:
-            vars = json.load(f)["vars"]
+    crcs = {}
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            mdata = json.load(f)
+        crcs = mdata.get("crc32") or {}
+        if vars is None:
+            vars = mdata["vars"]
+    elif vars is None:
+        raise FileNotFoundError(f"no manifest at {mpath}")
     import jax
     loaded = []
     for name in vars:
         path = os.path.join(dirname, name.replace("/", "__") + ".npy")
         if not os.path.exists(path):
             raise FileNotFoundError(f"no saved tensor for var {name!r} at {path}")
+        want = crcs.get(name)
+        if want is not None:
+            got = sharded_io._crc32_file(path)
+            if got != want:
+                raise sharded_io.ChecksumError(
+                    f"var file {path} fails its manifest checksum "
+                    f"(recorded {want:#010x}, file is {got:#010x}) — torn "
+                    "or corrupt; restore from an older serial")
         val = np.load(path)
         target = sharding_fn(name) if sharding_fn is not None else None
         if target is not None:
@@ -352,10 +375,11 @@ class AsyncCheckpointer:
         mesh layout — save dp=4, restore dp=8.
 
         With no explicit ``serial``, a serial whose data turns out torn
-        (e.g. a host crashed between writing shard files and its marker
-        in a way the markers could not catch) is skipped and the next
-        -older complete serial is tried — restore recovers automatically
-        instead of dying on the newest dir."""
+        (a manifest CRC32 mismatch — sharded_io.ChecksumError — a missing
+        manifest, or json/np parse errors from truncated files) is
+        skipped and the next-older complete serial is tried — restore
+        recovers automatically to the newest *verified* serial instead
+        of dying on the newest dir."""
         self.wait()
         serials = self.serials()
         if not serials:

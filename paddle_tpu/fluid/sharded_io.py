@@ -30,11 +30,34 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu.utils import faults
+
 _SHARD_MANIFEST_PREFIX = "__shards_p"
+
+FAULT_WRITE_SHARD = "ckpt.write_shard"    # chaos site (utils.faults)
+
+
+class ChecksumError(IOError):
+    """A shard file's bytes no longer match the CRC32 its manifest
+    recorded at save time — torn write or bit rot. IOError subclass on
+    purpose: AsyncCheckpointer.restore's fallback loop catches IOError
+    and moves to the next-older verified serial."""
+
+
+def _crc32_file(path: str, _bufsize: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(_bufsize)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
 
 
 def _safe(name: str) -> str:
@@ -71,8 +94,16 @@ def save_sharded(dirname: str, snapshot: Dict[str, dict]) -> List[str]:
         for bounds, data in rec["shards"]:
             tag = "_".join(str(b[0]) for b in bounds) or "scalar"
             fname = f"{_safe(name)}.s{tag}.npy"
-            np.save(os.path.join(dirname, fname), data)
-            entries.append({"file": fname, "bounds": bounds})
+            fpath = os.path.join(dirname, fname)
+            faults.inject(FAULT_WRITE_SHARD)      # die/stall mid-save
+            np.save(fpath, data)
+            # integrity: CRC32 of the file as written, recorded in the
+            # manifest and re-verified on restore — a shard torn AFTER
+            # the _COMPLETE marker (crash during a late flush, bit rot)
+            # is caught instead of silently poisoning the restore
+            crc = _crc32_file(fpath)
+            faults.mutate_file(FAULT_WRITE_SHARD, fpath)  # tear post-crc
+            entries.append({"file": fname, "bounds": bounds, "crc32": crc})
         manifest["vars"][name] = {
             "shape": rec["shape"], "dtype": rec["dtype"],
             "spec": rec.get("spec"), "shards": entries,
@@ -185,17 +216,30 @@ class _ShardReader:
     Files are mmap'd and cached, so reading a slice touches only the
     overlapping bytes."""
 
-    def __init__(self, dirname: str, meta: dict):
+    def __init__(self, dirname: str, meta: dict, verify: bool = True):
         self.dirname = dirname
         self.meta = meta
         self.shape = tuple(meta["shape"])
         self.dtype = np.dtype(meta["dtype"])
+        self.verify = verify
+        self._crcs = {e["file"]: e.get("crc32") for e in meta["shards"]}
         self._files: Dict[str, np.ndarray] = {}
 
     def _file(self, fname: str) -> np.ndarray:
         if fname not in self._files:
-            self._files[fname] = np.load(os.path.join(self.dirname, fname),
-                                         mmap_mode="r")
+            path = os.path.join(self.dirname, fname)
+            want = self._crcs.get(fname)
+            # verify once per file, on first open; pre-CRC checkpoints
+            # (no crc32 key) load unverified for back-compat
+            if self.verify and want is not None:
+                got = _crc32_file(path)
+                if got != want:
+                    raise ChecksumError(
+                        f"shard {fname} under {self.dirname} fails its "
+                        f"manifest checksum (recorded {want:#010x}, file "
+                        f"is {got:#010x}) — torn or corrupt; restore from "
+                        "an older serial")
+            self._files[fname] = np.load(path, mmap_mode="r")
         return self._files[fname]
 
     def read(self, index) -> np.ndarray:
@@ -229,9 +273,27 @@ class _ShardReader:
         return self.read(tuple(slice(0, d) for d in self.shape))
 
 
+def verify_sharded(dirname: str) -> List[str]:
+    """Audit every shard file under ``dirname`` against its manifest
+    CRC32. Returns the (sorted) list of missing or corrupt files — empty
+    means the checkpoint verifies clean. Files saved before checksums
+    existed (no crc32 key) are skipped."""
+    manifest = _merged_manifest(dirname)
+    bad = set()
+    for meta in manifest.values():
+        for entry in meta["shards"]:
+            path = os.path.join(dirname, entry["file"])
+            want = entry.get("crc32")
+            if not os.path.exists(path):
+                bad.add(entry["file"])
+            elif want is not None and _crc32_file(path) != want:
+                bad.add(entry["file"])
+    return sorted(bad)
+
+
 def load_sharded(dirname: str, scope, vars: Optional[List[str]] = None,
-                 sharding_fn: Optional[Callable[[str], object]] = None
-                 ) -> List[str]:
+                 sharding_fn: Optional[Callable[[str], object]] = None,
+                 verify: bool = True) -> List[str]:
     """Restore a sharded checkpoint into ``scope``.
 
     ``sharding_fn(name)`` returns the TARGET jax sharding for each var
@@ -239,7 +301,12 @@ def load_sharded(dirname: str, scope, vars: Optional[List[str]] = None,
     exposes exactly this); restoration builds each device's shard from
     only the overlapping files via jax.make_array_from_callback. With no
     ``sharding_fn`` the var is assembled and placed on the default device
-    (single-chip restore of a dp-sharded save)."""
+    (single-chip restore of a dp-sharded save).
+
+    ``verify=True`` (default) checks each shard file's manifest CRC32 on
+    first open and raises :class:`ChecksumError` on mismatch — only the
+    files a restore actually touches are read, so resharded restores
+    keep their proportional-IO property."""
     import jax
     manifest = _merged_manifest(dirname)
     names = vars if vars is not None else sorted(manifest)
@@ -248,7 +315,7 @@ def load_sharded(dirname: str, scope, vars: Optional[List[str]] = None,
         if name not in manifest:
             raise FileNotFoundError(f"no saved shards for var {name!r} "
                                     f"under {dirname}")
-        reader = _ShardReader(dirname, manifest[name])
+        reader = _ShardReader(dirname, manifest[name], verify=verify)
         target = sharding_fn(name) if sharding_fn is not None else None
         if target is None:
             scope.set_var(name, jax.device_put(reader.full()))

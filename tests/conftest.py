@@ -28,3 +28,15 @@ def fresh_programs():
     framework.reset_default_programs()
     scope_mod._reset_global_scope_for_tests()
     yield
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_faults():
+    """A chaos test that dies mid-plan must not leave armed fault sites
+    behind for the rest of the suite. Zero-cost unless the registry
+    module was actually imported."""
+    yield
+    import sys
+    faults_mod = sys.modules.get("paddle_tpu.utils.faults")
+    if faults_mod is not None:
+        faults_mod.reset()

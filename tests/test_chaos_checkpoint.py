@@ -1,0 +1,134 @@
+"""Chaos: checkpoint integrity under injected write faults.
+
+Recovery invariants exercised (docs/robustness.md):
+* a shard torn AFTER the _COMPLETE marker (crc mismatch) is detected at
+  restore and the loader falls back to the newest *verified* serial —
+  a corrupt checkpoint can delay recovery but never poison it;
+* a write that dies MID-save leaves no _COMPLETE marker, so the serial
+  never counts as restorable;
+* a background-thread write error surfaces on the next save()/wait()
+  exactly once and does not wedge subsequent saves."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import sharded_io
+from paddle_tpu.fluid.io import AsyncCheckpointer, load_vars, save_vars
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+
+def _scope_with(value: float):
+    s = fluid.Scope()
+    s.set_var("w", np.full((4, 3), value, np.float32))
+    return s
+
+
+def test_corrupt_after_complete_falls_back_to_verified_serial(tmp_path):
+    """Acceptance (a): serial 2's shard is torn after its _COMPLETE
+    marker is durable; restore skips it and loads serial 1."""
+    root = str(tmp_path / "ckpt")
+    ckpt = AsyncCheckpointer(root, max_to_keep=5)
+    ckpt.save(1, vars=["w"], scope=_scope_with(1.0))
+    ckpt.wait()
+    # the truncate fires AFTER the manifest checksum is recorded and the
+    # writer proceeds to the _COMPLETE marker — the exact torn-late-flush
+    # case the old restore silently loaded
+    with faults.active("ckpt.write_shard:truncate@1:to=8"):
+        ckpt.save(2, vars=["w"], scope=_scope_with(2.0))
+        ckpt.wait()
+    assert ckpt.serials() == [1, 2], "serial 2 must LOOK complete"
+    bad = sharded_io.verify_sharded(os.path.join(root, "checkpoint_2"))
+    assert bad, "audit must flag the torn shard"
+
+    restored_scope = fluid.Scope()
+    serial = ckpt.restore(None, scope=restored_scope)
+    assert serial == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored_scope.find_var("w")),
+        np.full((4, 3), 1.0, np.float32))
+    # an explicitly requested corrupt serial still fails loudly
+    with pytest.raises(sharded_io.ChecksumError):
+        ckpt.restore(None, serial=2, scope=fluid.Scope())
+
+
+def test_death_mid_write_leaves_serial_incomplete(tmp_path):
+    root = str(tmp_path / "ckpt")
+    ckpt = AsyncCheckpointer(root)
+    ckpt.save(1, vars=["w"], scope=_scope_with(1.0))
+    ckpt.wait()
+    with faults.active("ckpt.write_shard:raise@1"):
+        ckpt.save(2, vars=["w"], scope=_scope_with(2.0))
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ckpt.wait()
+    assert ckpt.serials() == [1], "no _COMPLETE marker → not restorable"
+    assert ckpt.restore(None, scope=fluid.Scope()) == 1
+
+
+def test_background_error_surfaces_once_and_does_not_wedge(tmp_path):
+    """Satellite: the async writer's failure must surface on the *next*
+    save()/wait() exactly once, and the checkpointer keeps working."""
+    root = str(tmp_path / "ckpt")
+    ckpt = AsyncCheckpointer(root)
+    with faults.active("ckpt.write_shard:raise@1"):
+        ckpt.save(1, vars=["w"], scope=_scope_with(1.0))
+        # surfaces on the NEXT save (which refuses to start)...
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ckpt.save(2, vars=["w"], scope=_scope_with(2.0))
+    # ...exactly once: wait() after the raise is clean
+    ckpt.wait()
+    # and the checkpointer is not wedged: the retried save succeeds
+    ckpt.save(2, vars=["w"], scope=_scope_with(2.0))
+    ckpt.wait()
+    assert ckpt.serials() == [2]
+    s = fluid.Scope()
+    assert ckpt.restore(None, scope=s) == 2
+    np.testing.assert_array_equal(np.asarray(s.find_var("w")),
+                                  np.full((4, 3), 2.0, np.float32))
+
+
+def test_plain_layout_checksum_detects_corruption(tmp_path):
+    """The non-sharded npy+manifest layout records per-var CRC32 too."""
+    d = str(tmp_path / "snap")
+    save_vars(None, d, vars=["w"], scope=_scope_with(3.0))
+    with open(os.path.join(d, "w.npy"), "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\x00\x00\x00\x01")       # flip tail bytes
+    with pytest.raises(sharded_io.ChecksumError):
+        load_vars(None, d, scope=fluid.Scope())
+
+
+def test_plain_async_checkpointer_falls_back(tmp_path):
+    root = str(tmp_path / "ckpt")
+    ckpt = AsyncCheckpointer(root, sharded=False)
+    ckpt.save(1, vars=["w"], scope=_scope_with(1.0))
+    ckpt.wait()
+    ckpt.save(2, vars=["w"], scope=_scope_with(2.0))
+    ckpt.wait()
+    with open(os.path.join(root, "checkpoint_2", "w.npy"), "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.truncate(size // 2)              # torn after _COMPLETE
+    s = fluid.Scope()
+    assert ckpt.restore(None, scope=s) == 1
+    np.testing.assert_array_equal(np.asarray(s.find_var("w")),
+                                  np.full((4, 3), 1.0, np.float32))
+
+
+def test_pre_checksum_checkpoints_still_load(tmp_path):
+    """Back-compat: manifests written before CRCs existed (no crc32 key)
+    load unverified instead of erroring."""
+    import json
+    d = str(tmp_path / "old")
+    os.makedirs(d)
+    np.save(os.path.join(d, "w.npy"), np.ones((2, 2), np.float32))
+    with open(os.path.join(d, "__manifest__.json"), "w") as f:
+        json.dump({"vars": ["w"]}, f)      # legacy: no crc32 map
+    s = fluid.Scope()
+    assert load_vars(None, d, scope=s) == ["w"]
+    np.testing.assert_array_equal(np.asarray(s.find_var("w")),
+                                  np.ones((2, 2), np.float32))

@@ -1,0 +1,93 @@
+"""Chaos satellites on the elastic trainer loop: capped-exponential idle
+polling (configurable, resetting on granted work) and startup cleanup of
+orphaned master_snapshot tmp files leaked by a crash between the queue
+capture and the checkpointer's promote."""
+
+import os
+
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.data.elastic import ElasticTrainer
+
+pytestmark = pytest.mark.chaos
+
+
+class _DuckMaster:
+    """Scripted Master duck: a fixed sequence of get_task() results."""
+
+    def __init__(self, script):
+        self._script = list(script)        # None = idle poll, str = task
+        self._finished = 0
+        self._total = sum(1 for x in script if x is not None)
+
+    def stats(self):
+        return {"todo": self._total - self._finished, "pending": 0,
+                "done": self._finished}
+
+    def get_task(self):
+        from paddle_tpu.data.master import Task
+        while self._script:
+            item = self._script.pop(0)
+            if item is None:
+                return None
+            return Task(id=hash(item) % 1000, epoch=0, path=item,
+                        chunk_begin=0, chunk_end=1)
+        return None
+
+    def task_finished(self, task):
+        self._finished += 1
+        return True
+
+    def task_failed(self, task):
+        return True
+
+    @property
+    def done(self):
+        return self._finished >= self._total
+
+
+def test_idle_poll_backs_off_exponentially_and_resets(tmp_path):
+    duck = _DuckMaster([None, None, None, None, "a", None, None, "b"])
+    t = ElasticTrainer(str(tmp_path / "w"), master=duck,
+                       checkpoint_every=10 ** 6,
+                       poll_interval_s=0.01, max_poll_interval_s=0.04)
+    sleeps = []
+    t._sleep = sleeps.append               # virtual time
+    t.run(lambda task: None)
+    # 4 idle polls double to the cap, then a granted lease resets the
+    # backoff for the next idle stretch
+    assert sleeps == [0.01, 0.02, 0.04, 0.04, 0.01, 0.02], sleeps
+    assert duck.done
+
+
+def test_poll_interval_is_configurable(tmp_path):
+    duck = _DuckMaster([None, "a"])
+    t = ElasticTrainer(str(tmp_path / "w"), master=duck,
+                       checkpoint_every=10 ** 6, poll_interval_s=0.25,
+                       max_poll_interval_s=2.0)
+    sleeps = []
+    t._sleep = sleeps.append
+    t.run(lambda task: None)
+    assert sleeps == [0.25]
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native runtime unavailable")
+def test_orphaned_snapshot_tmp_files_cleaned_on_startup(tmp_path):
+    work = str(tmp_path / "elastic")
+    os.makedirs(work)
+    snap = os.path.join(work, "master_snapshot.json")
+    orphans = [snap + ".tmp3", snap + ".tmp17_12345"]
+    for p in orphans:
+        with open(p, "w") as f:
+            f.write("{}")
+    t = ElasticTrainer(work, paths=["shard_0"], checkpoint_every=1)
+    for p in orphans:
+        assert not os.path.exists(p), f"orphan {p} must be removed"
+    # owner-mode startup must not touch the LIVE snapshot path
+    t.master.snapshot(snap)
+    assert os.path.exists(snap)
+    t2 = ElasticTrainer(work, paths=["shard_0"], checkpoint_every=1)
+    assert os.path.exists(snap), "cleanup must never remove the snapshot"
+    assert t2.master.stats()["todo"] == 1

@@ -1,0 +1,255 @@
+"""Chaos harness foundations: the deterministic fault registry
+(paddle_tpu.utils.faults) and the unified resilience policy
+(paddle_tpu.distributed.resilience). Everything here runs in virtual
+time — injected clocks/sleeps — so the suite is fast and replayable."""
+
+import os
+
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.distributed.resilience import (CircuitBreaker,
+                                               CircuitOpenError, RetryError,
+                                               RetryPolicy, Unretryable)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.faults import FaultInjected, FaultSpec
+
+pytestmark = pytest.mark.chaos
+
+
+# -- registry schedules ----------------------------------------------------
+
+def test_at_schedule_fires_on_exact_hits():
+    faults.arm("t.site", "raise@2,4")
+    hits = []
+    for _ in range(5):
+        try:
+            faults.inject("t.site")
+            hits.append(False)
+        except FaultInjected:
+            hits.append(True)
+    assert hits == [False, True, False, True, False]
+
+
+def test_every_schedule_and_times_cap():
+    faults.arm("t.site", "raise@every2:times=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            faults.inject("t.site")
+        except FaultInjected:
+            fired += 1
+    assert fired == 2                      # every 2nd hit, capped at 2
+    assert faults.stats()["t.site"]["hits"] == 10
+
+
+def test_probability_schedule_replays_exactly():
+    def pattern(seed):
+        faults.reset()
+        faults.seed(seed)
+        faults.arm("t.p", "raise@p0.4")
+        out = []
+        for _ in range(32):
+            try:
+                faults.inject("t.p")
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    a, b = pattern(7), pattern(7)
+    assert a == b, "same seed must replay the identical fault schedule"
+    assert 0 < sum(a) < 32                 # actually probabilistic
+
+
+def test_custom_exception_class():
+    faults.arm("t.exc", "raise@1:exc=ConnectionError")
+    with pytest.raises(ConnectionError):
+        faults.inject("t.exc")
+
+
+def test_delay_mode_sleeps_not_raises():
+    faults.arm("t.d", "delay@1:s=0.001")
+    faults.inject("t.d")                   # must not raise
+
+
+def test_truncate_mode_tears_file_and_mode_gating(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    with open(p, "wb") as f:
+        f.write(b"x" * 100)
+    faults.arm("t.f", "truncate@1:to=10")
+    # inject() services raise/delay only: a truncate spec neither fires
+    # nor consumes hits there (one logical write = one hit)
+    for _ in range(3):
+        faults.inject("t.f")
+    faults.mutate_file("t.f", p)           # hit 1 → fires
+    assert os.path.getsize(p) == 10
+
+
+def test_plan_parsing_and_flag_install():
+    flags.set("fault_plan", "a.b:raise@2:exc=OSError;c.d:truncate@1:to=0")
+    flags.set("fault_seed", 3)
+    try:
+        faults.reload_from_flags()
+        faults.inject("a.b")               # hit 1: quiet
+        with pytest.raises(OSError):
+            faults.inject("a.b")           # hit 2: fires
+        assert faults.stats()["c.d"]["mode"] == "truncate"
+    finally:
+        flags.reset("fault_plan")
+        flags.reset("fault_seed")
+        faults.reset()
+
+
+def test_plan_grammar_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.parse_plan("site:explode@1")
+    with pytest.raises(ValueError):
+        faults.parse_plan("site:raise@1:exc=Nope")
+    with pytest.raises(ValueError):
+        faults.parse_plan("just-a-site")
+
+
+def test_active_context_manager_clears_on_exit():
+    with faults.active("t.cm:raise@1"):
+        with pytest.raises(FaultInjected):
+            faults.inject("t.cm")
+    faults.inject("t.cm")                  # disarmed after the block
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+def _fake_time():
+    """(clock, sleep) pair advancing virtual time."""
+    state = {"t": 0.0}
+
+    def clock():
+        return state["t"]
+
+    def sleep(s):
+        state["t"] += s
+
+    return clock, sleep, state
+
+
+def test_retry_succeeds_after_transient_failures():
+    clock, sleep, _ = _fake_time()
+    delays = []
+    policy = RetryPolicy(max_attempts=8, base_delay_s=0.05, max_delay_s=1.0,
+                         deadline_s=None, sleep=lambda s: (
+                             delays.append(s), sleep(s)), clock=clock)
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] < 4:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert n[0] == 4 and len(delays) == 3
+    # full jitter: each delay within the exponentially growing cap
+    for i, d in enumerate(delays):
+        assert 0.0 <= d <= min(1.0, 0.05 * 2 ** i)
+
+
+def test_retry_attempt_bound_raises_retry_error():
+    clock, sleep, _ = _fake_time()
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                         deadline_s=None, sleep=sleep, clock=clock)
+    with pytest.raises(RetryError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(OSError("down")),
+                    what="probe")
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, OSError)
+    assert "probe" in str(ei.value)
+
+
+def test_retry_deadline_bound():
+    clock, sleep, state = _fake_time()
+    policy = RetryPolicy(max_attempts=0, base_delay_s=1.0, max_delay_s=1.0,
+                         deadline_s=2.5, jitter=False, sleep=sleep,
+                         clock=clock)
+    calls = [0]
+
+    def always_down():
+        calls[0] += 1
+        state["t"] += 0.1                  # each attempt costs wall time
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError):
+        policy.call(always_down)
+    assert state["t"] <= 2.5 + 1.0         # never sleeps past the deadline
+    assert calls[0] >= 2
+
+
+def test_unretryable_escapes_immediately():
+    policy = RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                         deadline_s=None, sleep=lambda s: None)
+    n = [0]
+
+    def poisoned():
+        n[0] += 1
+        raise Unretryable(ValueError("already applied"))
+
+    with pytest.raises(ValueError, match="already applied"):
+        policy.call(poisoned)
+    assert n[0] == 1                       # no resend
+
+
+def test_non_retryable_exception_passes_through():
+    policy = RetryPolicy(max_attempts=10, deadline_s=None,
+                         sleep=lambda s: None)
+    with pytest.raises(KeyError):
+        policy.call(lambda: (_ for _ in ()).throw(KeyError("nope")))
+
+
+def test_policy_requires_a_finite_bound():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0, deadline_s=None)
+
+
+# -- CircuitBreaker --------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_half_open_recovers():
+    clock, _, state = _fake_time()
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                        clock=clock)
+
+    def boom():
+        raise ConnectionError("down")
+
+    for _ in range(3):
+        with pytest.raises(ConnectionError):
+            br.call(boom)
+    assert br.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")      # fast-fail while open
+
+    state["t"] += 5.0                      # cooldown elapses → half-open
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.call(lambda: "probe ok") == "probe ok"
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_half_open_failure_reopens():
+    clock, _, state = _fake_time()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=2.0,
+                        clock=clock)
+    with pytest.raises(ConnectionError):
+        br.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    state["t"] += 2.0
+    with pytest.raises(ConnectionError):
+        br.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    assert br.state == CircuitBreaker.OPEN  # half-open probe failed
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "no")
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0)
+    for _ in range(5):                     # alternate fail/success forever
+        with pytest.raises(ConnectionError):
+            br.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+        br.call(lambda: "fine")
+    assert br.state == CircuitBreaker.CLOSED

@@ -1,0 +1,205 @@
+"""Chaos: the chunk-lease master's control plane under injected RPC
+faults — socket drops mid-get_task ride the retry policy without
+double-issuing leases, silent workers are reaped by heartbeat well
+before the lease timeout, and an unreachable master raises a clear
+MasterUnavailableError instead of an opaque socket error."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.data.master import Master
+from paddle_tpu.data.master_service import (MasterClient, MasterServer,
+                                            MasterUnavailableError)
+from paddle_tpu.distributed.resilience import RetryPolicy
+from paddle_tpu.utils import faults
+
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.skipif(not native.available(),
+                       reason="native runtime unavailable"),
+]
+
+
+def _fast_policy(delays, max_attempts=8):
+    """Real (tiny) sleeps, recorded — asserts backoff actually engaged."""
+    return RetryPolicy(
+        max_attempts=max_attempts, base_delay_s=0.005, max_delay_s=0.02,
+        deadline_s=5.0,
+        retryable=(ConnectionError, OSError, json.JSONDecodeError),
+        sleep=lambda s: (delays.append(s), time.sleep(s)))
+
+
+def _served_master(n_tasks, timeout_s=30.0, **server_kw):
+    m = Master(timeout_s=timeout_s)
+    for i in range(n_tasks):
+        m.add_task(f"shard_{i}", 0, 1)
+    return m, MasterServer(m, **server_kw)
+
+
+def test_send_drop_mid_get_task_retried_with_backoff(tmp_path):
+    """Acceptance (b), first half: the request never reached the master,
+    so the retried get_task issues exactly ONE lease."""
+    m, srv = _served_master(4)
+    delays = []
+    client = MasterClient(srv.endpoint, retry_policy=_fast_policy(delays))
+    try:
+        with faults.active(
+                "master.rpc.send:raise@1:exc=ConnectionError"):
+            t = client.get_task()
+        assert t is not None
+        assert len(delays) == 1, "one drop → one backoff sleep"
+        s = m.stats()
+        assert s["pending"] == 1 and s["todo"] == 3, \
+            f"exactly one lease issued: {s}"
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_reply_drop_never_double_trains(tmp_path):
+    """Acceptance (b), second half: the reply is dropped AFTER the master
+    issued the lease. The retry takes a different task; the orphan lease
+    expires and re-issues with a bumped epoch — every chunk still trains
+    exactly once."""
+    m, srv = _served_master(4, timeout_s=0.3)
+    delays = []
+    client = MasterClient(srv.endpoint, retry_policy=_fast_policy(delays))
+    trained = []
+    try:
+        with faults.active(
+                "master.rpc.recv:raise@1:exc=ConnectionError"):
+            t = client.get_task()       # retried; an orphan lease exists
+        assert t is not None and len(delays) >= 1
+        assert m.stats()["pending"] == 2      # orphan + the held lease
+        deadline = time.monotonic() + 10
+        while not client.done:
+            if t is None:
+                t = client.get_task()
+            if t is not None:
+                trained.append(t.path)
+                assert client.task_finished(t)
+                t = None
+            else:
+                assert time.monotonic() < deadline, m.stats()
+                time.sleep(0.02)
+    finally:
+        client.close()
+        srv.stop()
+    assert sorted(trained) == sorted(f"shard_{i}" for i in range(4)), \
+        f"dup or lost chunks: {trained}"
+    s = m.stats()
+    assert s["done"] == 4 and s["dropped"] == 0, s
+
+
+def test_heartbeat_reap_reissues_before_lease_timeout(tmp_path):
+    """Acceptance (c): worker A leases a chunk and goes silent; the
+    heartbeat reaper re-issues it to worker B in well under the 30s
+    lease timeout, and A's eventual stale report is rejected."""
+    m, srv = _served_master(2, timeout_s=30.0,
+                            heartbeat_timeout_s=0.15, reap_interval_s=0.04)
+    a = MasterClient(srv.endpoint, worker_id="worker-a")
+    b = MasterClient(srv.endpoint, worker_id="worker-b")
+    try:
+        assert a.heartbeat()
+        ta = a.get_task()
+        assert ta is not None
+        b.start_heartbeat(0.05)
+        # A now goes silent. B drains: it must receive BOTH tasks —
+        # including A's, re-issued with a bumped epoch — quickly.
+        start = time.monotonic()
+        got = []
+        while len(got) < 2:
+            t = b.get_task()
+            if t is None:
+                assert time.monotonic() - start < 5.0, \
+                    f"reap too slow: {m.stats()}"
+                time.sleep(0.02)
+                continue
+            got.append(t)
+            assert b.task_finished(t)
+        elapsed = time.monotonic() - start
+        assert elapsed < 5.0 < 30.0, \
+            f"re-issue took {elapsed:.1f}s — not faster than the lease"
+        reissued = [t for t in got if t.id == ta.id]
+        assert reissued and reissued[0].epoch > ta.epoch
+        # registry observability: A was reaped, B is registered (checked
+        # before A speaks again — any identified request re-registers)
+        workers = b.workers()
+        assert "worker-b" in workers and "worker-a" not in workers
+        # A's late report lands on a consumed epoch: stale, rejected
+        assert not a.task_finished(ta)
+        s = m.stats()
+        assert s["done"] == 2 and s["dropped"] == 0, s
+    finally:
+        a.close()
+        b.close()
+        srv.stop()
+
+
+def test_id_only_worker_is_never_reaped(tmp_path):
+    """A worker that carries a worker_id but never heartbeats keeps pure
+    lease-expiry semantics: silently training a long chunk must NOT look
+    like death (reaping is opt-in via the first beat)."""
+    m, srv = _served_master(1, timeout_s=30.0,
+                            heartbeat_timeout_s=0.1, reap_interval_s=0.03)
+    c = MasterClient(srv.endpoint, worker_id="slow-but-alive")
+    try:
+        t = c.get_task()
+        assert t is not None
+        time.sleep(0.3)           # > heartbeat timeout: silent, training
+        assert m.stats()["pending"] == 1, \
+            "id-only worker must not be reaped"
+        assert c.task_finished(t) and m.stats()["done"] == 1
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_unreachable_master_raises_clear_error():
+    """Satellite: bounded reconnects surface MasterUnavailableError with
+    the endpoint and attempt count, not a bare socket error."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                              # nothing listens here now
+    client = MasterClient(
+        f"127.0.0.1:{port}",
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                 max_delay_s=0.002, deadline_s=5.0))
+    with pytest.raises(MasterUnavailableError) as ei:
+        client.stats()
+    assert ei.value.attempts == 3
+    assert ei.value.endpoint == f"127.0.0.1:{port}"
+    assert f"127.0.0.1:{port}" in str(ei.value)
+    assert "3 attempt" in str(ei.value)
+
+
+def test_snapshot_failure_fails_lease_back_not_strands(tmp_path):
+    """A persist failure on the durable master must fail the just-issued
+    lease straight back to the queue (documented invariant: disk trouble
+    must not strand chunks for a lease window)."""
+    snap = str(tmp_path / "m.snap")
+    m = Master(timeout_s=30.0)
+    m.add_task("shard_0", 0, 1)
+    m.add_task("shard_1", 0, 1)
+    srv = MasterServer(m, snapshot_path=snap)   # snapshot hit 1 (startup)
+    client = MasterClient(
+        srv.endpoint,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                 deadline_s=5.0))
+    try:
+        with faults.active("master.snapshot:raise@1"):
+            with pytest.raises(RuntimeError, match="master error"):
+                client.get_task()
+        s = m.stats()
+        assert s["pending"] == 0 and s["todo"] == 2, \
+            f"lease must be failed back immediately: {s}"
+        t = client.get_task()              # disk recovered → serves again
+        assert t is not None and client.task_finished(t)
+    finally:
+        client.close()
+        srv.stop()
