@@ -51,3 +51,4 @@ def batch(reader, batch_size, drop_last=False):
 
 from paddle_tpu import compat  # noqa: F401,E402
 from paddle_tpu import dataset, imperative, reader, trainer  # noqa: F401,E402
+from paddle_tpu import observability  # noqa: F401,E402  (metrics/tracing)

@@ -12,14 +12,18 @@ programs for parity and skipped at lowering.
 
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
 
+from paddle_tpu import observability
 from paddle_tpu.core import ir
 from paddle_tpu.core.lowering import CompiledBlock
 from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu.observability import tracing as _obs_tracing
 
 
 class Place:
@@ -331,17 +335,30 @@ class Executor:
 
         from paddle_tpu import flags
         bench = flags.get("benchmark")
+        obs_on = observability.enabled()
+        if obs_on:
+            # flags asked for telemetry: idempotently bring up the dump
+            # thread / scrape endpoint (no-op bool check after the first)
+            from paddle_tpu.observability import exporters as _obs_exp
+            _obs_exp.ensure_started()
         if bench:
-            import time
             t0 = time.time()
-        if iterations > 1:
-            seed0 = self._step + 1
-            self._step += iterations
-            outs = cb.run_steps(scope, feeds, seed0, iterations,
-                                stacked=stacked)
-        else:
-            self._step += 1
-            outs = cb(scope, feeds, self._step)
+        t_dispatch = time.perf_counter()
+        # span recorded only under an active profiler or telemetry —
+        # the flags-unset hot path pays nothing here (<2% overhead
+        # contract on the bench step loop)
+        span = (_obs_tracing.span("executor.run", iterations=iterations)
+                if (obs_on or _obs_tracing.default_tracer().enabled)
+                else contextlib.nullcontext())
+        with span:
+            if iterations > 1:
+                seed0 = self._step + 1
+                self._step += iterations
+                outs = cb.run_steps(scope, feeds, seed0, iterations,
+                                    stacked=stacked)
+            else:
+                self._step += 1
+                outs = cb(scope, feeds, self._step)
         if bench:
             # dispatch wall time (async: device completion lands later;
             # reference capability: FLAGS_benchmark per-run executor timing)
@@ -360,8 +377,71 @@ class Executor:
                 if v is not None:
                     _assert_finite(name, v)
         if return_numpy:
-            return [np.asarray(o) for o in outs]
-        return list(outs)
+            outs = [np.asarray(o) for o in outs]   # D2H sync point
+        else:
+            outs = list(outs)
+        if obs_on and return_numpy:
+            # step-time sample covers dispatch + the D2H fetch — the
+            # per-step wall time a training loop sees. return_numpy=
+            # False hands back ASYNC device handles: elapsed would be
+            # dispatch-only (microseconds) and the steps/s / MFU gauges
+            # would read garbage (>1 MFU), so those dispatches are not
+            # sampled — callers that fence themselves (bench.py) publish
+            # their own measured window instead.
+            self._record_telemetry(
+                cb, program, scope, feeds, feed_names, iterations,
+                stacked, time.perf_counter() - t_dispatch)
+        return outs
+
+    def _record_telemetry(self, cb, program, scope, feeds, feed_names,
+                          iterations, stacked, elapsed_s):
+        """One step-stats sample per dispatch (observability.runtime):
+        step time, examples inferred from the feed batch dim, and the
+        MFU numerator from compiled-cost analysis with the analytic
+        model-FLOP walk as fallback. Never raises."""
+        from paddle_tpu.observability import runtime as obs_runtime
+        # batch size = the most common leading dim across feeds (data +
+        # label share it; a stray lr scalar or lengths vector can't win
+        # the vote the way first-feed-wins would let it)
+        votes: Dict[int, int] = {}
+        for name in feed_names:
+            shape = getattr(feeds.get(name), "shape", None)
+            if not shape:
+                continue
+            is_st = stacked is True or (isinstance(stacked, list)
+                                        and name in stacked)
+            dim = (shape[1] if len(shape) > 1 else None) if is_st \
+                else shape[0]
+            if dim:
+                votes[int(dim)] = votes.get(int(dim), 0) + 1
+        examples = max(votes, key=votes.get) if votes else None
+        flops = None
+        try:
+            flops = cb.analyzed_flops(scope, feeds, iterations, stacked)
+        except Exception:
+            flops = None
+        if flops is None and examples:
+            # analytic fallback, cached on the compiled block — the IR
+            # walk over every op must not run once per dispatch
+            cache = getattr(cb, "_analytic_flops", None)
+            if cache is None:
+                cache = cb._analytic_flops = {}
+            flops = cache.get(int(examples), "miss")
+            if flops == "miss":
+                try:
+                    from paddle_tpu.utils import flops as flops_mod
+                    flops = flops_mod.program_flops(
+                        program, int(examples)) or None
+                except Exception:
+                    flops = None
+                cache[int(examples)] = flops
+        try:
+            obs_runtime.record_dispatch(
+                elapsed_s / max(iterations, 1), steps=iterations,
+                examples=int(examples) if examples else None,
+                flops_per_step=flops)
+        except Exception:
+            pass
 
 
 # convenience used by tests and io

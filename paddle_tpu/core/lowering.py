@@ -12,6 +12,7 @@ in HBM — the functional equivalent of the reference's mutable Scope.
 
 from __future__ import annotations
 
+import itertools
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -204,9 +205,15 @@ class CompiledBlock:
     gradient reduction over ICI that the reference ran as NCCL allreduce
     op-handles (details/all_reduce_op_handle.cc:103)."""
 
+    # monotonic instance tag for observability caches (id() would be
+    # reused after GC and inherit a dead block's FLOPs; itertools.count
+    # is atomic under concurrent construction)
+    _SEQ = itertools.count(1)
+
     def __init__(self, program: ir.ProgramDesc, block_idx: int,
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  is_test: bool = False, donate: bool = True, dist=None):
+        self._obs_tag = next(CompiledBlock._SEQ)
         block = program.block(block_idx)
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block
@@ -311,6 +318,34 @@ class CompiledBlock:
         self._multi_cache[key] = jitted
         return jitted
 
+    def _gather_state(self, scope) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(state, consts) dicts pulled from the scope — the argument
+        prefix every executable (single- and multi-step, and the
+        observability cost-analysis lowering) shares."""
+        state = {}
+        for n in self.sig.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} not initialized in scope — run the "
+                    f"startup program first (reference: two-program "
+                    f"convention, framework.py default_startup_program)")
+            state[n] = v
+        consts = {}
+        for n in self.sig.const_names:
+            v = scope.find_var(n)
+            if v is None:
+                if self.block.has_var(n) and not self.block.var(n).persistable:
+                    raise RuntimeError(
+                        f"variable {n!r} is neither fed nor initialized — "
+                        f"add it to the feed dict (an op in the program "
+                        f"consumes it)")
+                raise RuntimeError(
+                    f"persistable variable {n!r} not found in scope — run "
+                    f"the startup program first")
+            consts[n] = v
+        return state, consts
+
     def run_steps(self, scope, feeds: Dict[str, Any], step_seed0: int,
                   iterations: int, stacked=False):
         """Run `iterations` training steps in one device-side loop.
@@ -320,24 +355,44 @@ class CompiledBlock:
         Returns per-step stacked fetches. Reference capability: amortized
         multi-step execution (executor.cc:448 interpreter loop,
         threaded_ssa_graph_executor.cc)."""
-        state = {}
-        for n in self.sig.state_names:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"variable {n!r} not initialized in scope — run the "
-                    f"startup program first")
-            state[n] = v
-        consts = {n: scope.find_var(n) for n in self.sig.const_names}
-        for n, v in consts.items():
-            if v is None:
-                raise RuntimeError(
-                    f"variable {n!r} is neither fed nor initialized")
+        state, consts = self._gather_state(scope)
         fn = self._multi_fn(iterations, stacked)
         fetches, new_state = fn(state, consts, feeds, np.uint32(step_seed0))
         for n, v in new_state.items():
             scope.set_var(n, v)
         return fetches
+
+    def analyzed_flops(self, scope, feeds: Dict[str, Any],
+                       iterations: int = 1, stacked=False):
+        """Per-step FLOPs of this executable from XLA's compiled-cost
+        analysis (observability MFU numerator), cached per (iterations,
+        stacked) jit signature. The lower/compile round trip runs once
+        per signature — call AFTER a real dispatch so jax's executable
+        caches are warm. None when the backend reports no FLOPs (the
+        caller falls back to utils/flops.py's analytic walk)."""
+        from paddle_tpu.observability import runtime as obs_runtime
+        snames = (stacked if isinstance(stacked, bool)
+                  else tuple(sorted(stacked)))
+        # feed shapes belong in the key: jit retraces per shape behind
+        # one jitted fn, so a partial tail batch must not serve the full
+        # batch's cached FLOPs
+        feed_sig = tuple(sorted(
+            (n, tuple(getattr(v, "shape", ()) or ()))
+            for n, v in feeds.items()))
+        key = (self._obs_tag, iterations, snames, feed_sig)
+        hit, val = obs_runtime.cost_cache_peek(key)
+        if hit:
+            # resolved signature: skip the scope walk / fn lookup — this
+            # runs once per dispatch on the telemetry path
+            return val
+        if iterations > 1:
+            fn = self._multi_fn(iterations, stacked)
+        else:
+            fn = self.fn
+        state, consts = self._gather_state(scope)
+        return obs_runtime.compiled_flops(
+            fn, state, consts, feeds, np.uint32(0), cache_key=key,
+            per_call_steps=iterations)
 
     def _input_shardings(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -453,29 +508,9 @@ class CompiledBlock:
         return self._param_sharding_fn(name)
 
     def __call__(self, scope, feeds: Dict[str, Any], step_seed: int):
-        state = {}
-        for n in self.sig.state_names:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"variable {n!r} not initialized in scope — run the "
-                    f"startup program first (reference: two-program "
-                    f"convention, framework.py default_startup_program)")
-            state[n] = v
-        consts = {}
-        for n in self.sig.const_names:
-            v = scope.find_var(n)
-            if v is None:
-                if self.block.has_var(n) and not self.block.var(n).persistable:
-                    raise RuntimeError(
-                        f"variable {n!r} is neither fed nor initialized — "
-                        f"add it to the feed dict (an op in the program "
-                        f"consumes it)")
-                raise RuntimeError(
-                    f"persistable variable {n!r} not found in scope — run "
-                    f"the startup program first")
-            consts[n] = v
-        fetches, new_state = self.fn(state, consts, feeds, np.uint32(step_seed))
+        state, consts = self._gather_state(scope)
+        fetches, new_state = self.fn(state, consts, feeds,
+                                     np.uint32(step_seed))
         for n, v in new_state.items():
             scope.set_var(n, v)
         return fetches

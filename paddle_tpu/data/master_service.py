@@ -50,9 +50,40 @@ from typing import Optional, Tuple
 
 from paddle_tpu.data.master import Master, Task
 from paddle_tpu.distributed.resilience import RetryError, RetryPolicy
+from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.utils import faults
 
 MASTER_ENV = "PADDLE_MASTER"
+
+# chunk-lease control-plane telemetry (docs/observability.md). The
+# `cause` label on failed-back leases distinguishes the reaper's
+# dead-worker failback, the persist-failure failback, and a client's own
+# task_failed report — the second witness chaos tests assert against.
+LEASES_GRANTED = _metrics.counter(
+    "paddle_master_leases_granted_total",
+    "Chunk leases issued by get_task")
+LEASES_FAILED_BACK = _metrics.counter(
+    "paddle_master_leases_failed_back_total",
+    "Leases returned to the queue before finishing",
+    labelnames=("cause",))      # reaped | persist_error | report
+TASKS_FINISHED = _metrics.counter(
+    "paddle_master_tasks_finished_total",
+    "task_finished reports accepted")
+STALE_REPORTS = _metrics.counter(
+    "paddle_master_stale_reports_total",
+    "task_finished/task_failed reports rejected by the lease-epoch check")
+WORKERS_REAPED = _metrics.counter(
+    "paddle_master_workers_reaped_total",
+    "Workers whose heartbeat went silent past the timeout")
+HEARTBEATS = _metrics.counter(
+    "paddle_master_heartbeats_total", "Heartbeat RPCs handled")
+HEARTBEAT_AGE = _metrics.gauge(
+    "paddle_master_heartbeat_age_seconds",
+    "Oldest registered worker's heartbeat age, sampled by the reaper "
+    "tick (0 with no registered workers)")
+SNAPSHOT_PERSIST = _metrics.histogram(
+    "paddle_master_snapshot_persist_seconds",
+    "Durable-queue snapshot latency (persist-before-reply path)")
 
 
 class MasterUnavailableError(ConnectionError):
@@ -100,7 +131,11 @@ class _Handler(socketserver.StreamRequestHandler):
         go/master/service.go:207)."""
         sp = getattr(server, "snapshot_path", None)
         if sp:
+            t0 = time.perf_counter()
             master.snapshot(sp)
+            # successful persists only: a failed snapshot is accounted by
+            # the persist_error failback counter, not the latency curve
+            SNAPSHOT_PERSIST.observe(time.perf_counter() - t0)
 
     @staticmethod
     def _touch_worker(server, wid: str, add_lease=None, drop_lease=None,
@@ -149,8 +184,10 @@ class _Handler(socketserver.StreamRequestHandler):
                 # the queue NOW instead of stranding the chunk for a
                 # full lease window (disk trouble must not stall drains)
                 master.task_failed(t)
+                LEASES_FAILED_BACK.labels(cause="persist_error").inc()
                 raise
             _Handler._touch_worker(server, wid, add_lease=(t.id, t.epoch))
+            LEASES_GRANTED.inc()
             return {"ok": True, "done": False,
                     "task": {"id": t.id, "epoch": t.epoch, "path": t.path,
                              "chunk_begin": t.chunk_begin,
@@ -162,6 +199,10 @@ class _Handler(socketserver.StreamRequestHandler):
             accepted = bool(fn(t))
             if accepted:
                 _Handler._persist(master, server)
+                (TASKS_FINISHED if method == "task_finished"
+                 else LEASES_FAILED_BACK.labels(cause="report")).inc()
+            else:
+                STALE_REPORTS.inc()
             _Handler._touch_worker(server, wid, drop_lease=(t.id, t.epoch))
             return {"ok": True, "accepted": accepted}
         if method == "heartbeat":
@@ -170,6 +211,7 @@ class _Handler(socketserver.StreamRequestHandler):
             # leases well before the full lease timeout (the reference
             # only discovers dead workers by lease expiry,
             # go/master checkTimeoutFunc)
+            HEARTBEATS.inc()
             return {"ok": True, "beat": _Handler._touch_worker(
                 server, wid, register=True)}
         if method == "workers":
@@ -309,15 +351,22 @@ class MasterServer:
             now = time.monotonic()
             dead = []
             with self._server.workers_lock:
+                oldest = 0.0
                 for wid, rec in list(self._server.workers.items()):
-                    if now - rec["last"] > self._hb_timeout:
+                    age = now - rec["last"]
+                    if age > self._hb_timeout:
                         dead.append((wid, set(rec["leases"])))
                         del self._server.workers[wid]
+                    elif age > oldest:
+                        oldest = age
+            HEARTBEAT_AGE.set(oldest)
             changed = False
             for wid, leases in dead:
+                WORKERS_REAPED.inc()
                 for tid, epoch in leases:
                     if self.master.task_failed(Task(tid, epoch, "", 0, 0)):
                         changed = True
+                        LEASES_FAILED_BACK.labels(cause="reaped").inc()
             if changed and getattr(self._server, "snapshot_path", None):
                 try:
                     self.master.snapshot(self._server.snapshot_path)

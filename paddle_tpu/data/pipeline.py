@@ -12,9 +12,35 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterable, Optional
 
 import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+
+# input-pipeline telemetry (docs/observability.md): the queue-depth
+# gauge says whether the pipeline is producer- or consumer-bound at a
+# glance; the stall counters attribute the imbalance (producer blocked
+# on a full queue vs. trainer waiting on an empty one). The `loader`
+# label separates concurrent pipelines (train vs eval) — pass
+# DataLoader(name=...); unnamed loaders share the "default" child.
+QUEUE_DEPTH = _metrics.gauge(
+    "paddle_data_queue_depth",
+    "Prefetch-queue occupancy after the last put/get",
+    labelnames=("loader",))
+BATCHES_PRODUCED = _metrics.counter(
+    "paddle_data_batches_produced_total",
+    "Batches converted + enqueued by DataLoader produce threads",
+    labelnames=("loader",))
+PRODUCER_STALL = _metrics.counter(
+    "paddle_data_producer_stall_seconds_total",
+    "Seconds produce threads spent blocked on a full queue "
+    "(consumer-bound pipeline)", labelnames=("loader",))
+CONSUMER_WAIT = _metrics.counter(
+    "paddle_data_consumer_wait_seconds_total",
+    "Seconds consumers spent blocked on an empty queue "
+    "(producer-bound pipeline)", labelnames=("loader",))
 
 
 class DataLoader:
@@ -28,12 +54,17 @@ class DataLoader:
     _END = object()
 
     def __init__(self, feed_names, batch_reader: Callable[[], Iterable],
-                 capacity: int = 2, device=None, feeder=None):
+                 capacity: int = 2, device=None, feeder=None,
+                 name: Optional[str] = None):
+        """``name`` tags this loader's telemetry (the ``loader`` label
+        on the paddle_data_* metrics) — a short tag like "train"/"eval",
+        so concurrent pipelines don't share one gauge."""
         self.feed_names = list(feed_names)
         self.batch_reader = batch_reader
         self.capacity = capacity
         self.device = device
         self.feeder = feeder
+        self.name = name or "default"
 
     def _convert(self, batch) -> Dict[str, object]:
         import jax
@@ -53,10 +84,22 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.capacity)
         exc: list = []
 
+        depth = QUEUE_DEPTH.labels(loader=self.name)
+        produced = BATCHES_PRODUCED.labels(loader=self.name)
+        stalled = PRODUCER_STALL.labels(loader=self.name)
+        waited = CONSUMER_WAIT.labels(loader=self.name)
+
         def produce():
             try:
                 for b in self.batch_reader():
-                    q.put(self._convert(b))
+                    item = self._convert(b)
+                    t0 = time.perf_counter()
+                    q.put(item)
+                    stall = time.perf_counter() - t0
+                    if stall > 1e-4:      # actually blocked, not a no-op
+                        stalled.inc(stall)
+                    produced.inc()
+                    depth.set(q.qsize())
             except Exception as e:  # surfaced on the consumer side
                 exc.append(e)
             finally:
@@ -65,7 +108,12 @@ class DataLoader:
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         while True:
+            t0 = time.perf_counter()
             item = q.get()
+            wait = time.perf_counter() - t0
+            if wait > 1e-4:
+                waited.inc(wait)
+            depth.set(q.qsize())
             if item is self._END:
                 if exc:
                     raise exc[0]

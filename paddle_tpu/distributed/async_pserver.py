@@ -44,6 +44,22 @@ import numpy as np
 from paddle_tpu.fluid import framework
 
 from paddle_tpu.fluid.transpiler import GRAD_SUFFIX
+from paddle_tpu.observability import metrics as _metrics
+
+# async-pserver telemetry (docs/observability.md): RPC latency by op,
+# client-side retries by op, server-side applies. The trainer client's
+# breaker publishes paddle_breaker_state{name="pserver"} (resilience.py).
+PS_RPC_SECONDS = _metrics.histogram(
+    "paddle_pserver_rpc_seconds",
+    "Trainer-side push/pull round-trip latency (includes retries/backoff)",
+    labelnames=("op",))
+PS_RPC_RETRIES = _metrics.counter(
+    "paddle_pserver_rpc_retries_total",
+    "Trainer-side pserver RPC retries (one per backoff sleep)",
+    labelnames=("op",))
+PS_GRADS_APPLIED = _metrics.counter(
+    "paddle_pserver_grads_applied_total",
+    "Gradients applied by AsyncPServer.apply_grad")
 
 
 class AsyncPServer:
@@ -159,6 +175,7 @@ class AsyncPServer:
             self.exe.run(prog, feed={gname: g},
                          fetch_list=[], scope=self.scope)
             self.n_applied += 1
+            PS_GRADS_APPLIED.inc()
 
     def get_params(self, names: List[str],
                    trainer_id: Optional[int] = None) -> Dict[str, np.ndarray]:
@@ -284,7 +301,8 @@ class AsyncTrainerClient:
             deadline_s=15.0,
             retryable=(ConnectionError, OSError, EOFError))
         self._breaker = breaker or CircuitBreaker(failure_threshold=8,
-                                                  reset_timeout_s=2.0)
+                                                  reset_timeout_s=2.0,
+                                                  name="pserver")
         self._conn = None
         self._connect()       # fail fast on a bad address, like before
 
@@ -301,6 +319,8 @@ class AsyncTrainerClient:
             self._conn = None
 
     def _rpc(self, msg, site: str, idempotent: bool = True):
+        import time as _time
+
         from paddle_tpu.distributed.resilience import Unretryable
         from paddle_tpu.utils import faults
 
@@ -319,8 +339,26 @@ class AsyncTrainerClient:
                 # surface instead of resending (at-most-once for pushes)
                 raise Unretryable(e)
 
-        return self._breaker.call(
-            lambda: self._retry.call(attempt, what=msg[0]))
+        from paddle_tpu.distributed.resilience import CircuitOpenError
+        op = msg[0]
+        t0 = _time.perf_counter()
+        try:
+            result = self._breaker.call(
+                lambda: self._retry.call(
+                    attempt, what=op,
+                    on_retry=lambda *_:
+                        PS_RPC_RETRIES.labels(op=op).inc()))
+        except CircuitOpenError:
+            # breaker fast-fail: a microsecond rejection is not a round
+            # trip — keeping it out of the histogram stops an outage
+            # from dragging the latency percentiles toward zero
+            raise
+        except BaseException:
+            PS_RPC_SECONDS.labels(op=op).observe(
+                _time.perf_counter() - t0)
+            raise
+        PS_RPC_SECONDS.labels(op=op).observe(_time.perf_counter() - t0)
+        return result
 
     def push_grad(self, name: str, value) -> None:
         kind, *rest = self._rpc(

@@ -27,6 +27,34 @@ import threading
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from paddle_tpu.observability import metrics as _metrics
+
+# control-plane resilience telemetry (docs/observability.md): `what` /
+# `name` labels carry the operation/breaker tag callers already pass
+# (bounded, enum-like strings — never ids or endpoints)
+RETRY_ATTEMPTS = _metrics.counter(
+    "paddle_retry_attempts_total",
+    "Retries performed by RetryPolicy.call (one per backoff sleep)",
+    labelnames=("what",))
+RETRY_EXHAUSTED = _metrics.counter(
+    "paddle_retry_exhausted_total",
+    "RetryPolicy budgets spent (RetryError raised)", labelnames=("what",))
+UNRETRYABLE = _metrics.counter(
+    "paddle_unretryable_total",
+    "Failures surfaced immediately because the effect may already have "
+    "applied (Unretryable escape hatch)", labelnames=("what",))
+BREAKER_STATE = _metrics.gauge(
+    "paddle_breaker_state",
+    "CircuitBreaker state: 0 closed, 1 half-open, 2 open. One logical "
+    "breaker per name: same-named instances share the child "
+    "(last-writer-wins) — give concurrent breakers distinct names",
+    labelnames=("name",))
+BREAKER_OPENS = _metrics.counter(
+    "paddle_breaker_opens_total",
+    "Times a CircuitBreaker tripped open", labelnames=("name",))
+
+_STATE_CODE = {"closed": 0, "half-open": 1, "open": 2}
+
 
 class RetryError(Exception):
     """Retry budget exhausted. ``__cause__`` is the last attempt's error;
@@ -101,6 +129,7 @@ class RetryPolicy:
             try:
                 return fn()
             except Unretryable as u:
+                UNRETRYABLE.labels(what=what).inc()
                 raise u.cause
             except self.retryable as e:
                 elapsed = self._clock() - start
@@ -110,9 +139,11 @@ class RetryPolicy:
                 out_of_time = (self.deadline_s is not None
                                and elapsed + delay > self.deadline_s)
                 if out_of_attempts or out_of_time:
+                    RETRY_EXHAUSTED.labels(what=what).inc()
                     raise RetryError(
                         f"{what} failed after {attempt} attempt(s) over "
                         f"{elapsed:.2f}s: {e!r}", attempt, elapsed) from e
+                RETRY_ATTEMPTS.labels(what=what).inc()
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 self._sleep(delay)
@@ -136,14 +167,24 @@ class CircuitBreaker:
 
     def __init__(self, failure_threshold: int = 5,
                  reset_timeout_s: float = 10.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "default"):
+        """``name`` tags this breaker's telemetry (the
+        ``paddle_breaker_state`` gauge / ``paddle_breaker_opens_total``
+        counter label) — a short enum-like tag, not an endpoint."""
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
+        self.name = name
         self._clock = clock
         self._lock = threading.Lock()
         self._failures = 0
         self._state = self.CLOSED
         self._opened_at = 0.0
+        self._publish_state()
+
+    def _publish_state(self):
+        BREAKER_STATE.labels(name=self.name).set(
+            _STATE_CODE[self._state])
 
     @property
     def state(self) -> str:
@@ -154,6 +195,7 @@ class CircuitBreaker:
         if (self._state == self.OPEN
                 and self._clock() - self._opened_at >= self.reset_timeout_s):
             self._state = self.HALF_OPEN
+            self._publish_state()
         return self._state
 
     def allow(self) -> bool:
@@ -164,14 +206,19 @@ class CircuitBreaker:
         with self._lock:
             self._failures = 0
             self._state = self.CLOSED
+            self._publish_state()
 
     def record_failure(self):
         with self._lock:
             self._failures += 1
             if (self._failures >= self.failure_threshold
                     or self._state == self.HALF_OPEN):
+                was_open = self._state == self.OPEN
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+                self._publish_state()
+                if not was_open:
+                    BREAKER_OPENS.labels(name=self.name).inc()
 
     def call(self, fn: Callable):
         if not self.allow():

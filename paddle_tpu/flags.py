@@ -123,6 +123,31 @@ define("fault_plan", str, "",
 define("fault_seed", int, 0,
        "Seed for probabilistic fault schedules ('p0.1'): per-site RNG "
        "streams are keyed by (seed, site) so chaos runs replay exactly.")
+define("metrics_dump_path", str, "",
+       "Directory the observability dump thread writes to: steps.jsonl "
+       "(one record per executor dispatch: step_time, steps/s, "
+       "examples/s, MFU) and metrics.prom (full registry, Prometheus "
+       "text). Empty (default) disables the dump thread "
+       "(paddle_tpu.observability.exporters; docs/observability.md).")
+define("metrics_dump_interval", float, 10.0,
+       "Seconds between observability dump-thread writes "
+       "(FLAGS_metrics_dump_path). Records are queued per dispatch; the "
+       "interval only controls disk-write frequency, and stop/atexit "
+       "flushes the tail.")
+define("metrics_port", int, -1,
+       "Prometheus scrape endpoint (GET /metrics) on this port via a "
+       "stdlib http.server thread. -1 (default) disables; 0 binds an "
+       "ephemeral port (observability.exporters.active_server().port). "
+       "Binds FLAGS_metrics_host (loopback by default).")
+define("metrics_host", str, "127.0.0.1",
+       "Interface the scrape endpoint binds. The loopback default is "
+       "deliberate (the registry is unauthenticated); set 0.0.0.0 to "
+       "expose it to an off-host Prometheus scraper.")
+define("peak_flops", float, 0.0,
+       "Override the peak-FLOP/s denominator of the MFU gauge "
+       "(paddle_mfu_ratio). 0 (default) autodetects from the attached "
+       "chip's spec sheet (utils.flops.device_peak_flops) — set this on "
+       "CPU runs/tests to get a real MFU instead of none.")
 
 
 def _main():

@@ -41,18 +41,26 @@ def _write_snapshot_dir(dirname: str, snapshot) -> List[str]:
     recorded in the manifest and re-verified by load_vars, so a var file
     torn after the save looked complete fails loudly instead of loading
     garbage weights."""
+    import time
+    from paddle_tpu.fluid import sharded_io
     from paddle_tpu.fluid.sharded_io import _crc32_file
     from paddle_tpu.utils import faults
+    t_start = time.perf_counter()
     os.makedirs(dirname, exist_ok=True)
     crcs = {}
+    n_bytes = 0
     for name, arr in snapshot.items():
         path = os.path.join(dirname, name.replace("/", "__") + ".npy")
         faults.inject("ckpt.write_var")
         np.save(path, arr)
         crcs[name] = _crc32_file(path)
         faults.mutate_file("ckpt.write_var", path)   # tear post-checksum
+        n_bytes += os.path.getsize(path)
     with open(os.path.join(dirname, _MANIFEST), "w") as f:
         json.dump({"vars": sorted(snapshot), "crc32": crcs}, f)
+    sharded_io.CKPT_SAVE_BYTES.labels(layout="plain").inc(n_bytes)
+    sharded_io.CKPT_SAVE_SECONDS.labels(layout="plain").observe(
+        time.perf_counter() - t_start)
     return sorted(snapshot)
 
 
@@ -117,7 +125,9 @@ def load_vars(executor, dirname, main_program=None,
             vars = mdata["vars"]
     elif vars is None:
         raise FileNotFoundError(f"no manifest at {mpath}")
+    import time
     import jax
+    t_start = time.perf_counter()
     loaded = []
     for name in vars:
         path = os.path.join(dirname, name.replace("/", "__") + ".npy")
@@ -127,6 +137,7 @@ def load_vars(executor, dirname, main_program=None,
         if want is not None:
             got = sharded_io._crc32_file(path)
             if got != want:
+                sharded_io.CKPT_CRC_FAILURES.inc()
                 raise sharded_io.ChecksumError(
                     f"var file {path} fails its manifest checksum "
                     f"(recorded {want:#010x}, file is {got:#010x}) — torn "
@@ -138,6 +149,8 @@ def load_vars(executor, dirname, main_program=None,
         else:
             scope.set_var(name, jax.device_put(val))
         loaded.append(name)
+    sharded_io.CKPT_RESTORE_SECONDS.labels(layout="plain").observe(
+        time.perf_counter() - t_start)
     return loaded
 
 

@@ -3,59 +3,49 @@ manager :221, start/stop_profiler :125,165, cuda_profiler :39, reset_profiler;
 C++ side platform/profiler.cc + CUPTI DeviceTracer + tools/timeline.py).
 
 TPU-native design: device-side tracing is jax.profiler (XPlane → TensorBoard
-/ Perfetto, replacing the CUPTI→chrome-trace path); host-side per-run event
-timing is kept as a lightweight table with the reference's sorted-summary
-report (EventSortingKey profiler.h:114)."""
+/ Perfetto, replacing the CUPTI→chrome-trace path); host-side span
+recording delegates to ``paddle_tpu.observability.tracing`` (the
+process-default :class:`Tracer`) — lock-protected and thread-id-aware,
+fixing the old module-global ``_events``/``_spans`` lists that raced the
+DataLoader's produce thread and stacked every span on tid 0. The public
+API here is unchanged; the sorted-summary report keeps the reference's
+shape (EventSortingKey profiler.h:114)."""
 
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 from typing import Optional
 
-_events = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": float("inf"),
-                               "max": 0.0})
-_spans = []          # (name, start_s, end_s) while active — timeline source
-_active = False
+from paddle_tpu.observability import tracing as _tracing
+
+_tracer = _tracing.default_tracer()
 
 
-@contextlib.contextmanager
 def record_event(name: str):
-    """Host-side RAII event (reference: platform/profiler.h:27 RecordEvent)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        t1 = time.perf_counter()
-        dt = t1 - t0
-        e = _events[name]
-        e["calls"] += 1
-        e["total"] += dt
-        e["min"] = min(e["min"], dt)
-        e["max"] = max(e["max"], dt)
-        if _active:
-            _spans.append((name, t0, t1))
+    """Host-side RAII event (reference: platform/profiler.h:27 RecordEvent).
+    Thread-safe: aggregates update under the tracer's lock and spans carry
+    the recording thread's real id."""
+    return _tracer.span(name)
 
 
 def reset_profiler():
-    _events.clear()
-    _spans.clear()
+    _tracer.reset()
 
 
 def export_spans(path: str):
-    """Write (name, start, end) span rows (csv-quoted — names are arbitrary
-    caller strings) — input for tools/timeline.py."""
+    """Write (name, start, end, tid) span rows (csv-quoted — names are
+    arbitrary caller strings) — input for tools/timeline.py."""
     import csv
     with open(path, "w", newline="") as f:
         w = csv.writer(f)
-        for name, t0, t1 in _spans:
-            w.writerow([name, t0, t1])
+        for s in _tracer.spans():
+            w.writerow([s.name, s.start_s, s.end_s, s.tid])
 
 
 def spans_to_chrome_trace(spans, pid=0):
     """(name, start_s, end_s[, tid]) rows → chrome://tracing JSON dict
-    (reference capability: tools/timeline.py output format)."""
+    (reference capability: tools/timeline.py output format). Rows from
+    :func:`export_spans` carry the real thread id in column 4."""
     events = []
     for row in spans:
         name, start, end = row[0], float(row[1]), float(row[2])
@@ -67,17 +57,14 @@ def spans_to_chrome_trace(spans, pid=0):
 
 
 def export_chrome_trace(path: str):
-    import json
-    with open(path, "w") as f:
-        json.dump(spans_to_chrome_trace(_spans), f)
+    _tracer.export_chrome_trace(path)
 
 
 def start_profiler(state: str = "All", tracer_option: Optional[str] = None,
                    trace_dir: Optional[str] = None):
     """reference: profiler.py:125. state/tracer_option accepted for parity;
     device tracing delegates to jax.profiler when a trace_dir is given."""
-    global _active
-    _active = True
+    _tracer.start()
     if trace_dir:
         import jax
         jax.profiler.start_trace(trace_dir)
@@ -86,15 +73,14 @@ def start_profiler(state: str = "All", tracer_option: Optional[str] = None,
 def stop_profiler(sorted_key: Optional[str] = "total",
                   profile_path: Optional[str] = None, trace_dir=None):
     """reference: profiler.py:165 — prints the per-event summary table."""
-    global _active
     if trace_dir:
         import jax
         jax.profiler.stop_trace()
-    if not _active:
+    if not _tracer.enabled:
         return
-    _active = False
+    _tracer.stop()
     rows = []
-    for name, e in _events.items():
+    for name, e in _tracer.event_stats().items():
         ave = e["total"] / max(e["calls"], 1)
         rows.append((name, e["calls"], e["total"], ave, e["min"], e["max"]))
     key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(

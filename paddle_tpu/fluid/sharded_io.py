@@ -35,7 +35,26 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.utils import faults
+
+# checkpoint-I/O telemetry, shared with fluid/io.py's plain layout
+# (docs/observability.md): durations + bytes by layout, and the CRC
+# counter that makes a torn/corrupt shard visible even when restore
+# recovers by falling back to an older serial
+CKPT_SAVE_SECONDS = _metrics.histogram(
+    "paddle_checkpoint_save_seconds",
+    "Snapshot-serialization wall time (host-side write phase)",
+    labelnames=("layout",))       # plain | sharded
+CKPT_RESTORE_SECONDS = _metrics.histogram(
+    "paddle_checkpoint_restore_seconds",
+    "Checkpoint load wall time", labelnames=("layout",))
+CKPT_SAVE_BYTES = _metrics.counter(
+    "paddle_checkpoint_save_bytes_total",
+    "Bytes of checkpoint data written", labelnames=("layout",))
+CKPT_CRC_FAILURES = _metrics.counter(
+    "paddle_checkpoint_crc_failures_total",
+    "Files that failed their manifest CRC32 on verify/restore")
 
 _SHARD_MANIFEST_PREFIX = "__shards_p"
 
@@ -81,7 +100,9 @@ def save_sharded(dirname: str, snapshot: Dict[str, dict]) -> List[str]:
     to ``dirname``. Separated from the D2H phase so AsyncCheckpointer can
     run this on its background thread."""
     os.makedirs(dirname, exist_ok=True)
+    import time
     import jax
+    t_start = time.perf_counter()
     pidx = jax.process_index()
     # process_count lets the loader verify it found every host's manifest
     # — a crashed host can't silently produce a partial-looking-complete
@@ -89,6 +110,7 @@ def save_sharded(dirname: str, snapshot: Dict[str, dict]) -> List[str]:
     # closed by etcd registration, go/pserver/etcd_client.go)
     manifest = {"process": pidx, "process_count": jax.process_count(),
                 "vars": {}}
+    n_bytes = 0
     for name, rec in snapshot.items():
         entries = []
         for bounds, data in rec["shards"]:
@@ -103,6 +125,7 @@ def save_sharded(dirname: str, snapshot: Dict[str, dict]) -> List[str]:
             # is caught instead of silently poisoning the restore
             crc = _crc32_file(fpath)
             faults.mutate_file(FAULT_WRITE_SHARD, fpath)  # tear post-crc
+            n_bytes += os.path.getsize(fpath)
             entries.append({"file": fname, "bounds": bounds, "crc32": crc})
         manifest["vars"][name] = {
             "shape": rec["shape"], "dtype": rec["dtype"],
@@ -111,6 +134,9 @@ def save_sharded(dirname: str, snapshot: Dict[str, dict]) -> List[str]:
     mpath = os.path.join(dirname, f"{_SHARD_MANIFEST_PREFIX}{pidx}__.json")
     with open(mpath, "w") as f:
         json.dump(manifest, f)
+    CKPT_SAVE_BYTES.labels(layout="sharded").inc(n_bytes)
+    CKPT_SAVE_SECONDS.labels(layout="sharded").observe(
+        time.perf_counter() - t_start)
     return sorted(snapshot)
 
 
@@ -234,6 +260,7 @@ class _ShardReader:
             if self.verify and want is not None:
                 got = _crc32_file(path)
                 if got != want:
+                    CKPT_CRC_FAILURES.inc()
                     raise ChecksumError(
                         f"shard {fname} under {self.dirname} fails its "
                         f"manifest checksum (recorded {want:#010x}, file "
@@ -280,14 +307,21 @@ def verify_sharded(dirname: str) -> List[str]:
     existed (no crc32 key) are skipped."""
     manifest = _merged_manifest(dirname)
     bad = set()
+    n_crc = 0
     for meta in manifest.values():
         for entry in meta["shards"]:
             path = os.path.join(dirname, entry["file"])
             want = entry.get("crc32")
             if not os.path.exists(path):
-                bad.add(entry["file"])
+                bad.add(entry["file"])     # missing, not a CRC mismatch
             elif want is not None and _crc32_file(path) != want:
+                if entry["file"] not in bad:
+                    n_crc += 1
                 bad.add(entry["file"])
+    if n_crc:
+        # only true checksum mismatches: the counter is the bitrot/torn
+        # -write alert signal, a plain missing file is not that
+        CKPT_CRC_FAILURES.inc(n_crc)
     return sorted(bad)
 
 
@@ -307,7 +341,9 @@ def load_sharded(dirname: str, scope, vars: Optional[List[str]] = None,
     first open and raises :class:`ChecksumError` on mismatch — only the
     files a restore actually touches are read, so resharded restores
     keep their proportional-IO property."""
+    import time
     import jax
+    t_start = time.perf_counter()
     manifest = _merged_manifest(dirname)
     names = vars if vars is not None else sorted(manifest)
     loaded = []
@@ -324,4 +360,6 @@ def load_sharded(dirname: str, scope, vars: Optional[List[str]] = None,
                 reader.shape, target, lambda idx, r=reader: r.read(idx))
             scope.set_var(name, arr)
         loaded.append(name)
+    CKPT_RESTORE_SECONDS.labels(layout="sharded").observe(
+        time.perf_counter() - t_start)
     return loaded

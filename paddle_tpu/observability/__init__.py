@@ -1,0 +1,65 @@
+"""Runtime observability: metrics registry, structured tracing, step
+telemetry, exporters.
+
+The chaos-hardened control plane (retries, circuit breakers,
+heartbeats, CRC-verified checkpoints — docs/robustness.md) is provable
+in tests but was invisible in production. This package makes it
+watchable:
+
+- :mod:`~paddle_tpu.observability.metrics` — thread-safe registry of
+  labeled Counter/Gauge/Histogram families, Prometheus-text + JSON
+  rendering, one process-default registry;
+- :mod:`~paddle_tpu.observability.tracing` — lock-protected,
+  thread-id-aware span recorder (context manager / decorator) with
+  chrome-trace/Perfetto export; ``fluid.profiler`` delegates here;
+- :mod:`~paddle_tpu.observability.runtime` — per-compiled-step stats:
+  step-time ring buffer → steps/s, examples/s, tokens/s gauges, and an
+  MFU gauge from XLA's compiled-cost analysis (analytic-FLOPs
+  fallback);
+- :mod:`~paddle_tpu.observability.exporters` — background JSONL step
+  log + Prometheus text file (``FLAGS_metrics_dump_path`` /
+  ``FLAGS_metrics_dump_interval``) and an optional stdlib http scrape
+  endpoint (``FLAGS_metrics_port``).
+
+Everything is off by default; with no observability flag set the hot
+path pays one flag lookup per executor dispatch. Metric catalog and
+label conventions: docs/observability.md.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.observability import metrics  # noqa: F401
+from paddle_tpu.observability import tracing  # noqa: F401
+from paddle_tpu.observability import runtime  # noqa: F401
+from paddle_tpu.observability import exporters  # noqa: F401
+from paddle_tpu.observability.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
+    gauge, histogram)
+from paddle_tpu.observability.tracing import (  # noqa: F401
+    Tracer, default_tracer, span, trace)
+
+_force_enabled = False
+
+
+def enable():
+    """Programmatically switch step telemetry on for this process (the
+    flag-free path tests and bench use)."""
+    global _force_enabled
+    _force_enabled = True
+
+
+def disable():
+    global _force_enabled
+    _force_enabled = False
+
+
+def enabled() -> bool:
+    """True when step telemetry should be recorded: an observability
+    flag is set (dump path / scrape port) or :func:`enable` was called.
+    The executor checks this once per dispatch — with everything off
+    the whole subsystem costs two flag lookups."""
+    if _force_enabled:
+        return True
+    from paddle_tpu import flags
+    return bool(flags.get("metrics_dump_path")) \
+        or flags.get("metrics_port") >= 0

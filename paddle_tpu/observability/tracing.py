@@ -1,0 +1,185 @@
+"""Structured host-side tracing: a lock-protected, thread-id-aware span
+recorder with a context-manager/decorator API and chrome-trace export.
+
+This replaces ``fluid/profiler.py``'s module-global ``_events``/``_spans``
+lists, which were mutated without a lock from reader/producer threads
+(the DataLoader's produce thread races the training thread) and recorded
+no thread ids, so ``spans_to_chrome_trace`` stacked every thread on
+tid 0. ``fluid.profiler`` now delegates here (public API unchanged);
+new code uses :func:`span` / :func:`trace` directly.
+
+Two always-cheap layers:
+- **event aggregates** — per-name {calls, total, min, max}, updated on
+  every :func:`span` exit (a dict update under one lock);
+- **span records** — (name, t0, t1, tid, args) appended only while the
+  tracer is *enabled* (``start()``/``stop()``), bounded by ``max_spans``
+  so a forgotten ``start()`` cannot grow memory without bound.
+
+Export: :func:`to_chrome_trace` emits the chrome://tracing JSON dict,
+which Perfetto (ui.perfetto.dev) opens natively — the host-side half of
+the timeline; device-side traces stay with jax.profiler (XPlane).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float            # time.perf_counter() timebase
+    end_s: float
+    tid: int                  # real thread id (threading.get_ident())
+    args: Optional[dict] = None
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_s - self.start_s
+
+
+@dataclass
+class _EventStat:
+    calls: int = 0
+    total: float = 0.0
+    min: float = float("inf")
+    max: float = 0.0
+
+
+class Tracer:
+    """Thread-safe span recorder. One process-default instance
+    (:func:`default_tracer`) backs both ``fluid.profiler`` and the
+    ``observability`` API, so spans from either land on one timeline."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self._lock = threading.Lock()
+        self._events: Dict[str, _EventStat] = {}
+        self._spans: List[Span] = []
+        self._dropped = 0
+        self._enabled = False
+        self.max_spans = int(max_spans)
+
+    # -- control ---------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def start(self):
+        self._enabled = True
+
+    def stop(self):
+        self._enabled = False
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._spans.clear()
+            self._dropped = 0
+
+    # -- recording -------------------------------------------------------
+    def record(self, name: str, start_s: float, end_s: float,
+               tid: Optional[int] = None, args: Optional[dict] = None):
+        """Record one finished span: aggregates always, the span record
+        only while enabled. Safe from any thread."""
+        dt = end_s - start_s
+        with self._lock:
+            e = self._events.get(name)
+            if e is None:
+                e = self._events[name] = _EventStat()
+            e.calls += 1
+            e.total += dt
+            if dt < e.min:
+                e.min = dt
+            if dt > e.max:
+                e.max = dt
+            if self._enabled:
+                if len(self._spans) < self.max_spans:
+                    self._spans.append(Span(
+                        name, start_s, end_s,
+                        tid if tid is not None else threading.get_ident(),
+                        args))
+                else:
+                    self._dropped += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """``with tracer.span("step"): ...`` — RAII span + aggregate."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.perf_counter(),
+                        args=args or None)
+
+    def trace(self, name_or_fn=None):
+        """Decorator form: ``@tracer.trace`` or ``@tracer.trace("name")``."""
+        def deco(fn, name=None):
+            label = name or f"{fn.__module__}.{fn.__qualname__}"
+
+            @functools.wraps(fn)
+            def wrapper(*a, **kw):
+                with self.span(label):
+                    return fn(*a, **kw)
+            return wrapper
+
+        if callable(name_or_fn):
+            return deco(name_or_fn)
+        return lambda fn: deco(fn, name_or_fn)
+
+    # -- reading ---------------------------------------------------------
+    def event_stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"calls": e.calls, "total": e.total,
+                        "min": e.min, "max": e.max}
+                    for n, e in self._events.items()}
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped_spans(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    # -- export ----------------------------------------------------------
+    def to_chrome_trace(self, pid: int = 0) -> dict:
+        """chrome://tracing / Perfetto JSON ('X' complete events, µs)."""
+        events = []
+        for s in self.spans():
+            ev = {"name": s.name, "cat": "host", "ph": "X",
+                  "ts": s.start_s * 1e6, "dur": s.duration_s * 1e6,
+                  "pid": pid, "tid": s.tid}
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str, pid: int = 0):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(pid), f)
+
+
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _DEFAULT
+
+
+def span(name: str, **args):
+    """Module-level convenience on the default tracer:
+    ``with tracing.span("master.get_task"): ...``"""
+    return _DEFAULT.span(name, **args)
+
+
+def trace(name_or_fn=None):
+    """``@tracing.trace`` / ``@tracing.trace("name")`` on the default
+    tracer."""
+    return _DEFAULT.trace(name_or_fn)

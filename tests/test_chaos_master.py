@@ -2,7 +2,11 @@
 faults — socket drops mid-get_task ride the retry policy without
 double-issuing leases, silent workers are reaped by heartbeat well
 before the lease timeout, and an unreachable master raises a clear
-MasterUnavailableError instead of an opaque socket error."""
+MasterUnavailableError instead of an opaque socket error.
+
+The observability counters (paddle_master_* / paddle_retry_*) are
+asserted against the injected fault schedule — a second witness for the
+recovery behavior beyond the queue's own stats."""
 
 import json
 import socket
@@ -11,9 +15,11 @@ import time
 import pytest
 
 from paddle_tpu.core import native
+from paddle_tpu.data import master_service as ms
 from paddle_tpu.data.master import Master
 from paddle_tpu.data.master_service import (MasterClient, MasterServer,
                                             MasterUnavailableError)
+from paddle_tpu.distributed import resilience
 from paddle_tpu.distributed.resilience import RetryPolicy
 from paddle_tpu.utils import faults
 
@@ -42,9 +48,12 @@ def _served_master(n_tasks, timeout_s=30.0, **server_kw):
 
 def test_send_drop_mid_get_task_retried_with_backoff(tmp_path):
     """Acceptance (b), first half: the request never reached the master,
-    so the retried get_task issues exactly ONE lease."""
+    so the retried get_task issues exactly ONE lease — and the counters
+    say so: one retry attempt recorded, one lease granted."""
     m, srv = _served_master(4)
     delays = []
+    retries0 = resilience.RETRY_ATTEMPTS.labels(what="get_task").value
+    granted0 = ms.LEASES_GRANTED.value
     client = MasterClient(srv.endpoint, retry_policy=_fast_policy(delays))
     try:
         with faults.active(
@@ -55,6 +64,11 @@ def test_send_drop_mid_get_task_retried_with_backoff(tmp_path):
         s = m.stats()
         assert s["pending"] == 1 and s["todo"] == 3, \
             f"exactly one lease issued: {s}"
+        # counters match the fault schedule: exactly one injected drop →
+        # exactly one recorded retry; exactly one lease counted
+        assert resilience.RETRY_ATTEMPTS.labels(what="get_task").value \
+            - retries0 == 1
+        assert ms.LEASES_GRANTED.value - granted0 == 1
     finally:
         client.close()
         srv.stop()
@@ -101,6 +115,8 @@ def test_heartbeat_reap_reissues_before_lease_timeout(tmp_path):
     lease timeout, and A's eventual stale report is rejected."""
     m, srv = _served_master(2, timeout_s=30.0,
                             heartbeat_timeout_s=0.15, reap_interval_s=0.04)
+    reaped0 = ms.WORKERS_REAPED.value
+    failed_back0 = ms.LEASES_FAILED_BACK.labels(cause="reaped").value
     a = MasterClient(srv.endpoint, worker_id="worker-a")
     b = MasterClient(srv.endpoint, worker_id="worker-b")
     try:
@@ -134,6 +150,11 @@ def test_heartbeat_reap_reissues_before_lease_timeout(tmp_path):
         assert not a.task_finished(ta)
         s = m.stats()
         assert s["done"] == 2 and s["dropped"] == 0, s
+        # counters witness the schedule: exactly one worker (A) reaped,
+        # exactly one lease (A's chunk) failed back by the reaper
+        assert ms.WORKERS_REAPED.value - reaped0 == 1
+        assert ms.LEASES_FAILED_BACK.labels(cause="reaped").value \
+            - failed_back0 == 1
     finally:
         a.close()
         b.close()
@@ -186,6 +207,9 @@ def test_snapshot_failure_fails_lease_back_not_strands(tmp_path):
     m = Master(timeout_s=30.0)
     m.add_task("shard_0", 0, 1)
     m.add_task("shard_1", 0, 1)
+    persist_fail0 = ms.LEASES_FAILED_BACK.labels(
+        cause="persist_error").value
+    persists0 = ms.SNAPSHOT_PERSIST.labels().count
     srv = MasterServer(m, snapshot_path=snap)   # snapshot hit 1 (startup)
     client = MasterClient(
         srv.endpoint,
@@ -200,6 +224,14 @@ def test_snapshot_failure_fails_lease_back_not_strands(tmp_path):
             f"lease must be failed back immediately: {s}"
         t = client.get_task()              # disk recovered → serves again
         assert t is not None and client.task_finished(t)
+        # exactly the one injected persist failure is accounted as a
+        # persist_error failback; the snapshot-latency histogram saw the
+        # successful persists (lease + finished report) that followed
+        assert ms.LEASES_FAILED_BACK.labels(
+            cause="persist_error").value - persist_fail0 == 1
+        # exactly the two successful persists after recovery (the lease
+        # and the finished report); the failed one is not in the curve
+        assert ms.SNAPSHOT_PERSIST.labels().count - persists0 == 2
     finally:
         client.close()
         srv.stop()

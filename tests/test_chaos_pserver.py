@@ -1,13 +1,19 @@
 """Chaos: the async-pserver trainer client under injected faults — a
 connection drop before the push is sent is retried (and applied exactly
 once), while a persistently dead pserver trips the circuit breaker into
-fast-fail instead of hanging every training step."""
+fast-fail instead of hanging every training step.
+
+The paddle_pserver_* / paddle_breaker_* counters are asserted against
+the injected fault schedule — the telemetry is a second witness for the
+retry/breaker behavior."""
 
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.distributed import AsyncPServer, AsyncTrainerClient
+from paddle_tpu.distributed import async_pserver as aps
+from paddle_tpu.distributed import resilience
 from paddle_tpu.distributed.resilience import (CircuitBreaker,
                                                CircuitOpenError, RetryError,
                                                RetryPolicy)
@@ -50,6 +56,9 @@ def test_push_retried_through_connect_fault_applies_exactly_once():
     ps, g, pname = _server()
     listener, port = _bound_listener()
     ps.serve(listener=listener)
+    retries0 = aps.PS_RPC_RETRIES.labels(op="push").value
+    applied0 = aps.PS_GRADS_APPLIED.value
+    push_lat0 = aps.PS_RPC_SECONDS.labels(op="push").count
     try:
         c = AsyncTrainerClient(("127.0.0.1", port), trainer_id=0,
                                retry_policy=_fast_retry())
@@ -61,6 +70,13 @@ def test_push_retried_through_connect_fault_applies_exactly_once():
                 "pserver.push_grad:raise@1:exc=ConnectionError"):
             c.push_grad(g, np.ones(w0.shape, np.float32))
         assert ps.n_applied == 1, "retried push must apply exactly once"
+        # counters match the schedule: one injected drop → one recorded
+        # push retry, one applied gradient, one latency sample
+        assert aps.PS_RPC_RETRIES.labels(op="push").value \
+            - retries0 == 1
+        assert aps.PS_GRADS_APPLIED.value - applied0 == 1
+        assert aps.PS_RPC_SECONDS.labels(op="push").count \
+            - push_lat0 == 1
         w1 = c.pull([pname])[pname]
         np.testing.assert_allclose(w1, w0 - 0.1 * np.ones(w0.shape),
                                    rtol=1e-6)
@@ -88,12 +104,15 @@ def test_breaker_fast_fails_a_dead_pserver():
     ps, g, pname = _server()
     listener, port = _bound_listener()
     ps.serve(listener=listener)
+    opens0 = resilience.BREAKER_OPENS.labels(name="chaos-ps").value
+    exhausted0 = resilience.RETRY_EXHAUSTED.labels(what="push").value
     try:
         c = AsyncTrainerClient(
             ("127.0.0.1", port), trainer_id=0,
             retry_policy=_fast_retry(max_attempts=1),
             breaker=CircuitBreaker(failure_threshold=2,
-                                   reset_timeout_s=60.0))
+                                   reset_timeout_s=60.0,
+                                   name="chaos-ps"))
         with faults.active(
                 "pserver.push_grad:raise@every1:exc=ConnectionError"):
             for _ in range(2):             # exhaust the breaker threshold
@@ -103,6 +122,13 @@ def test_breaker_fast_fails_a_dead_pserver():
             with pytest.raises(CircuitOpenError):
                 c.push_grad(g, np.zeros((4, 1), np.float32))
         assert ps.n_applied == 0
+        # telemetry matches the schedule: two spent retry budgets, one
+        # breaker trip, and the state gauge reads open (2)
+        assert resilience.RETRY_EXHAUSTED.labels(what="push").value \
+            - exhausted0 == 2
+        assert resilience.BREAKER_OPENS.labels(
+            name="chaos-ps").value - opens0 == 1
+        assert resilience.BREAKER_STATE.labels(name="chaos-ps").value == 2
         c.close()
     finally:
         ps.stop()

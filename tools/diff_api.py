@@ -39,6 +39,11 @@ MODULES = [
     "paddle_tpu.dataset",
     "paddle_tpu.reader",
     "paddle_tpu.contrib",
+    "paddle_tpu.observability",
+    "paddle_tpu.observability.metrics",
+    "paddle_tpu.observability.tracing",
+    "paddle_tpu.observability.runtime",
+    "paddle_tpu.observability.exporters",
 ]
 
 
