@@ -442,6 +442,23 @@ class Executor:
                 flops_per_step=flops)
         except Exception:
             pass
+        # sparse-apply sites registered at trace time by the row-sparse
+        # optimizer path (core/selected_rows.record_sparse_apply):
+        # rows-touched counts advance once per dispatched step
+        try:
+            desc = program.desc if hasattr(program, "desc") else program
+            sites = getattr(desc, "_sparse_sites", None)
+            if sites:
+                from paddle_tpu.observability import metrics as obs_metrics
+                fam = obs_metrics.counter(
+                    "paddle_sparse_rows_touched_total",
+                    "embedding-table rows (incl. duplicates) carried by "
+                    "row-sparse gradients into the sparse optimizer "
+                    "apply, per param", ("param",))
+                for pname, (k, _height) in sites.items():
+                    fam.labels(param=pname).inc(k * iterations)
+        except Exception:
+            pass
 
 
 # convenience used by tests and io

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import ir
+from paddle_tpu.core import selected_rows as sr
 from paddle_tpu.core.registry import EmitContext, get_op
 
 # ensure all builtin emitters are registered on import
@@ -115,7 +116,7 @@ def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
         # parent-block ops at the same index
         ctx = EmitContext(base_key=base_key, step_base_key=step_base,
                           op_index=block.idx * 100_000 + i, is_test=is_test,
-                          program=program, dist=dist)
+                          program=program, dist=dist, op=op)
         ins = {}
         for slot, names in op.inputs.items():
             try:
@@ -124,7 +125,18 @@ def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
                 raise KeyError(
                     f"op {op.type!r} input {slot} references undefined var "
                     f"{e.args[0]!r}; did you run the startup program?") from e
-        outs = spec.emit(ctx, ins, op.attrs)
+        # row-sparse grad plumbing (core/selected_rows.py): the sparse-apply
+        # optimizer ops consume the (rows, values) pair natively; the linear
+        # plumbing ops (sum/scale/isfinite/...) rewrite sparsely; everything
+        # else gets an exact densify — a consumer can never observe the
+        # difference, only the fast path's cost profile
+        if any(sr.is_sparse(v) for vals in ins.values() for v in vals) \
+                and op.type not in sr.SPARSE_APPLY_OPS:
+            outs = sr.try_sparse_emit(op.type, ins, op.attrs)
+            if outs is None:
+                outs = spec.emit(ctx, sr.densify_ins(ins), op.attrs)
+        else:
+            outs = spec.emit(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:
@@ -178,6 +190,10 @@ def build_block_fn(program: ir.ProgramDesc, block_idx: int,
         fetches = []
         for n in sig.fetch_names:
             v = env[n]
+            if sr.is_sparse(v):
+                # a fetched @GRAD var densifies at the boundary — users
+                # (and the numeric-grad checker) see the dense gradient
+                v = v.densify()
             # contrib.layout NHWC-resident intermediates come back to the
             # user in the declared NCHW layout
             if (getattr(v, "ndim", 0) == 4 and block.has_var(n)

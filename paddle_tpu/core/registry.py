@@ -66,6 +66,10 @@ class EmitContext:
     # recursively lower their sub-blocks through this handle
     # (reference: sub-blocks interpreted with child scopes, while_op.cc:64)
     program: Any = None
+    # the OpDesc being emitted (set by the lowering loop; None for direct
+    # emitter calls) — lets emitters read their own var NAMES, e.g. the
+    # sparse-apply telemetry site needs the Param name
+    op: Any = None
 
     def key(self, salt: int = 0):
         return jax.random.fold_in(

@@ -1,0 +1,248 @@
+"""Row-sparse gradients: the TPU-native SelectedRows fast path.
+
+The reference framework carries embedding-table gradients as SelectedRows —
+``(rows, values)`` pairs the optimizer ops consume directly
+(reference: framework/selected_rows.h, operators/lookup_table_op.cc grad
+kernel with is_sparse=True, sgd_op.cc / adam_op.h lazy_mode sparse apply).
+The first TPU port densified them ("XLA wants dense", ops/infra_ops.py),
+which makes every embedding step pay a full ``[V, D]`` gradient
+materialization plus a vocab-sized optimizer update even though a batch
+touches only ``B*T << V`` rows.
+
+This module restores the sparse path with *static* shapes so it lives
+happily under jit/scan: :class:`RowSparseGrad` is a registered pytree of
+``rows [K] int32`` / ``values [K, ...]`` with the table height as static
+aux data. ``K = B*T`` is fixed at trace time, so no dynamic-shape
+compaction is needed — duplicate rows are legal (consumers that square the
+gradient call :meth:`RowSparseGrad.deduped`, a ``jnp.unique(size=K)``
+bucket + segment-sum, to merge them first, the analogue of the reference's
+merge_selected_rows pre-pass).
+
+Plumbing contract (core/lowering.py):
+- the ``__vjp__`` emitter produces RowSparseGrad for lookup_table /
+  fused_embedding_seq_pool W-grads (ops/grad_ops.py);
+- sparse-APPLY ops (sgd/momentum/adam) receive it intact and update the
+  table in ``O(K*D)``;
+- a small rewrite set (:func:`try_sparse_emit`) keeps the pair sparse
+  through the linear grad plumbing ops (sum aggregation, AMP grad
+  scaling, isfinite overflow checks, casts);
+- every other consumer gets the pair densified transparently
+  (:func:`densify_ins`) — exact fallback, never an error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# the carrier
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class RowSparseGrad:
+    """Static-shape row-sparse gradient of a ``[height, ...]`` table.
+
+    rows:   [K] int32 row indices (duplicates allowed unless ``unique``)
+    values: [K, ...] per-row gradient values (tail dims match the table)
+    height: static table height V (out-of-range rows act as masked-out —
+            scatter consumers drop them, which is how the ``unique``
+            padding bucket is expressed)
+    unique: static flag — rows are deduplicated (padding slots carry
+            ``rows == height`` with zero values)
+    """
+
+    def __init__(self, rows, values, height: int, unique: bool = False):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+        self.unique = bool(unique)
+
+    # -- pytree protocol (height/unique are static aux data) ---------------
+    def tree_flatten(self):
+        return (self.rows, self.values), (self.height, self.unique)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        rows, values = children
+        return cls(rows, values, aux[0], aux[1])
+
+    # -- views -------------------------------------------------------------
+    @property
+    def nnz_rows(self) -> int:
+        """Static number of carried rows (K, including duplicates)."""
+        return int(self.rows.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def __repr__(self):
+        return (f"RowSparseGrad(rows={self.nnz_rows}, height={self.height}, "
+                f"tail={tuple(self.values.shape[1:])}, "
+                f"dtype={self.values.dtype}, unique={self.unique})")
+
+    # -- transforms --------------------------------------------------------
+    def densify(self):
+        """Exact dense gradient: scatter-add values into a zero table
+        (what the old always-dense path produced)."""
+        zeros = jnp.zeros(self.dense_shape, self.values.dtype)
+        return zeros.at[self.rows].add(self.values, mode="drop")
+
+    def astype(self, dtype):
+        return RowSparseGrad(self.rows, self.values.astype(dtype),
+                             self.height, self.unique)
+
+    def scale(self, s):
+        return RowSparseGrad(self.rows, self.values * s,
+                             self.height, self.unique)
+
+    def deduped(self) -> "RowSparseGrad":
+        """Merge duplicate rows (sum of their values) into a unique-row
+        bucket of the same static size K; padding slots get
+        ``rows == height`` (dropped by scatter consumers) and zero values.
+        Required before any consumer that is non-linear in the gradient
+        (adam's g^2 moments) or that scatter-*writes* rather than adds."""
+        if self.unique:
+            return self
+        k = self.nnz_rows
+        uniq, inv = jnp.unique(self.rows, return_inverse=True, size=k,
+                               fill_value=self.height)
+        merged = jnp.zeros_like(self.values).at[inv.reshape(-1)].add(
+            self.values)
+        return RowSparseGrad(uniq.astype(jnp.int32), merged, self.height,
+                             unique=True)
+
+
+def is_sparse(v) -> bool:
+    return isinstance(v, RowSparseGrad)
+
+
+def sparse_grads_enabled() -> bool:
+    from paddle_tpu import flags
+    return not flags.get("disable_sparse_grad")
+
+
+# ---------------------------------------------------------------------------
+# lowering hooks
+# ---------------------------------------------------------------------------
+
+# optimizer ops whose emitters apply a RowSparseGrad natively
+# (ops/optimizer_ops.py sparse branches)
+SPARSE_APPLY_OPS = frozenset({"sgd", "momentum", "adam"})
+
+
+def densify_ins(ins: Dict[str, List[Any]]) -> Dict[str, List[Any]]:
+    """Densify every RowSparseGrad input — the exact fallback for
+    consumers outside the sparse-aware set."""
+    return {slot: [v.densify() if is_sparse(v) else v for v in vals]
+            for slot, vals in ins.items()}
+
+
+def _scalarish(v) -> bool:
+    """A broadcast-safe scalar multiplier ([, [1], or scalar array) —
+    the AMP grad-scale shape."""
+    return (not is_sparse(v) and v is not None
+            and int(getattr(v, "size", 0) or 0) == 1)
+
+
+def try_sparse_emit(op_type: str, ins: Dict[str, List[Any]],
+                    attrs: Dict[str, Any]) -> Optional[Dict[str, List[Any]]]:
+    """Sparse-preserving rewrites for the linear grad-plumbing ops that sit
+    between the backward pass and the optimizer apply. Returns the op's
+    output dict, or None when the pattern is not sparse-safe (the caller
+    then densifies and runs the normal emitter — exact, never wrong)."""
+    if op_type == "sum":
+        xs = ins.get("X", [])
+        sps = [x for x in xs if is_sparse(x)]
+        if len(sps) == len(xs) and xs and \
+                len({x.dense_shape for x in xs}) == 1:
+            # all-sparse fan-in over one table: concatenation IS the sum
+            # (reference: sum_op.cc SelectedRows branch appends rows)
+            rows = jnp.concatenate([x.rows for x in xs])
+            vals = jnp.concatenate([x.values for x in xs])
+            return {"Out": [RowSparseGrad(rows, vals, xs[0].height)]}
+        return None
+    if op_type == "scale":
+        x = (ins.get("X") or [None])[0]
+        if is_sparse(x) and float(attrs.get("bias", 0.0)) == 0.0:
+            return {"Out": [x.scale(attrs.get("scale", 1.0))]}
+        return None
+    if op_type in ("elementwise_mul", "elementwise_div"):
+        x = (ins.get("X") or [None])[0]
+        y = (ins.get("Y") or [None])[0]
+        if is_sparse(x) and _scalarish(y):
+            s = jnp.reshape(y, ())
+            if op_type == "elementwise_div":
+                s = 1.0 / s
+            return {"Out": [x.scale(s.astype(x.dtype))]}
+        return None
+    if op_type == "isfinite":
+        x = (ins.get("X") or [None])[0]
+        if is_sparse(x):
+            # densified zeros are always finite — values decide alone
+            return {"Out": [jnp.all(jnp.isfinite(x.values)).reshape(1)]}
+        return None
+    if op_type == "cast":
+        x = (ins.get("X") or [None])[0]
+        if is_sparse(x):
+            return {"Out": [x.astype(attrs.get("out_dtype", "float32"))]}
+        return None
+    if op_type == "merge_selected_rows":
+        # the reference's duplicate-row merge IS deduped() — keep the
+        # pair sparse instead of letting the identity emitter densify it
+        x = (ins.get("X") or [None])[0]
+        if is_sparse(x):
+            return {"Out": [x.deduped()]}
+        return None
+    if op_type == "get_tensor_from_selected_rows":
+        # contract: SelectedRows -> dense tensor; densify IS the op
+        x = (ins.get("X") or [None])[0]
+        if is_sparse(x):
+            return {"Out": [x.densify()]}
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# observability (docs/observability.md "Sparse embedding gradients")
+# ---------------------------------------------------------------------------
+
+
+def record_sparse_apply(ctx, grad: RowSparseGrad) -> None:
+    """Trace-time registration of a sparse-apply site: remembers
+    (param -> rows-per-step, table height) on the enclosing ProgramDesc so
+    the executor can advance ``paddle_sparse_rows_touched_total`` per
+    dispatch, and sets the static per-table sparsity gauge. A program
+    jitted at several batch shapes keeps the most recent trace's K (the
+    counter is telemetry, not accounting — docs/observability.md). Never
+    raises — telemetry must not fail a trace."""
+    try:
+        prog = getattr(ctx, "program", None)
+        op = getattr(ctx, "op", None)
+        if prog is None or op is None:
+            return
+        pname = (op.inputs.get("Param") or [None])[0]
+        if not pname:
+            return
+        sites = getattr(prog, "_sparse_sites", None)
+        if sites is None:
+            sites = prog._sparse_sites = {}
+        sites[pname] = (grad.nnz_rows, grad.height)
+        from paddle_tpu.observability import metrics as obs_metrics
+        obs_metrics.gauge(
+            "paddle_sparse_table_density_ratio",
+            "gradient rows carried per step / table height (duplicate "
+            "ids inflate the numerator, so this is an UPPER BOUND on "
+            "true touched-row density; clamped to 1)",
+            ("param",)).labels(param=pname).set(
+                min(1.0, grad.nnz_rows / max(grad.height, 1)))
+    except Exception:
+        pass

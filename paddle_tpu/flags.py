@@ -106,6 +106,13 @@ define("tpu_prng", str, "rbg",
 define("disable_pallas", bool, False,
        "Force the refer (jnp) tier instead of Pallas kernels "
        "(ops/pallas kernel_pool gate; PADDLE_TPU_DISABLE_PALLAS compat).")
+define("disable_sparse_grad", bool, False,
+       "Densify embedding-table gradients instead of carrying the "
+       "SelectedRows-style (rows, values) pair from the lookup_table / "
+       "fused_embedding_seq_pool VJP to the sparse optimizer apply "
+       "(core/selected_rows.py). The sparse path is exact (parity suite "
+       "tests/test_sparse_grad.py); this flag exists for A/B timing and "
+       "as an escape hatch.")
 define("eager_delete_tensor_gb", float, 0.0,
        "Accepted for API parity (reference: FLAGS_eager_delete_tensor_gb "
        "GC threshold) — XLA/PJRT owns buffer lifetime on TPU; no-op.")

@@ -201,6 +201,12 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # lazy_mode rides to the adam op: with a row-sparse gradient
+        # (core/selected_rows.py) only touched rows update their moments/
+        # param (adam_op.h lazy_mode semantics — untouched rows' moments
+        # don't decay); with a dense gradient it is a no-op, like the
+        # reference
+        self._lazy_mode = bool(lazy_mode)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -225,7 +231,7 @@ class AdamOptimizer(Optimizer):
             outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
                      "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode})
 
 
 class AdamaxOptimizer(Optimizer):

@@ -64,7 +64,13 @@ def build(is_train: bool = True, num_fields: int = 26,
     loss = layers.mean(loss_vec)
     prob = layers.sigmoid(logit)
     if is_train:
-        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+        # lazy_mode: the [V, 1+K] table's gradient stays a row-sparse
+        # (rows, values) pair end-to-end (core/selected_rows.py) and adam
+        # touches only the B*F gathered rows' moments per step — the
+        # O(V*D) dense update was the dominant step cost at 2.1% MFU
+        # (BENCH_r05; ISSUE 3)
+        fluid.optimizer.Adam(learning_rate=lr,
+                             lazy_mode=True).minimize(loss)
     feed_specs = {"feat_ids": ([-1, num_fields, 1], "int64"),
                   "label": ([-1, 1], "float32")}
     return loss, [prob], feed_specs

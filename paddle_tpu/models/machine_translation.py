@@ -79,7 +79,11 @@ def build(is_train=True, src_vocab=30, tgt_vocab=30, max_len=8,
             layers.reshape(logits, shape=[-1, tgt_vocab]),
             layers.reshape(tgt_out, shape=[-1, 1]))
         avg = layers.mean(loss)
-        fluid.optimizer.Adam(learning_rate=lr).minimize(avg)
+        # lazy_mode: src/tgt embedding-table grads ride the row-sparse
+        # path, so adam updates the B*T touched rows instead of rewriting
+        # both [V, D] tables every step (ISSUE 3; dense params are
+        # unaffected — lazy adam with a dense grad is plain adam)
+        fluid.optimizer.Adam(learning_rate=lr, lazy_mode=True).minimize(avg)
         feed_specs = {"src": ([-1, max_len], "int64"),
                       "tgt_in": ([-1, max_len], "int64"),
                       "tgt_out": ([-1, max_len], "int64")}

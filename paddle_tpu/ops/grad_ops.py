@@ -17,11 +17,73 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core import ir
+from paddle_tpu.core import selected_rows as sr
 from paddle_tpu.core.registry import EmitContext, get_op, register_op
 
 
 def _slot_layout(slots: Dict[str, List[str]]) -> List[Tuple[str, int]]:
     return [(slot, len(names)) for slot, names in sorted(slots.items())]
+
+
+# ---------------------------------------------------------------------------
+# row-sparse embedding VJP fast path (core/selected_rows.py)
+# ---------------------------------------------------------------------------
+
+# fwd op types whose W-gradient is a pure row gather transpose: instead of
+# scattering B*T rows into a dense [V, D] zeros (the reference's
+# is_sparse=False lookup_table_grad kernel), emit the (rows, values) pair
+# directly (the is_sparse=True SelectedRows kernel, lookup_table_op.cc:85).
+# lookup_sparse_table delegates to the lookup_table emitter with the same
+# slots (infra_ops.py), so it shares the fast path.
+SPARSE_EMB_OPS = ("lookup_table", "lookup_sparse_table",
+                  "fused_embedding_seq_pool")
+
+
+def og_matches_single(og_mask, pos) -> bool:
+    """True when exactly one output cotangent is provided and it is the
+    flat output at `pos` (the embedding ops' single 'Out')."""
+    return bool(og_mask[pos]) and sum(1 for m in og_mask if m) == 1
+
+
+def _sparse_embedding_vjp(fwd_op, ins_by_slot, grads_by_slot):
+    """RowSparseGrad of W for the embedding-family ops, or None when the
+    pattern doesn't apply (caller falls back to the generic re-trace).
+
+    ins_by_slot: {slot: [vals]} forward inputs; grads_by_slot: {slot:
+    cotangent or None} for the forward outputs. Returns the W gradient
+    only — the remaining inputs (Ids, SeqLens) are integer-typed and never
+    differentiable."""
+    w = (ins_by_slot.get("W") or [None])[0]
+    ids = (ins_by_slot.get("Ids") or [None])[0]
+    g = grads_by_slot.get("Out")
+    if w is None or ids is None or g is None or w.ndim != 2:
+        return None
+    v, d = w.shape
+    ids = ids.astype(jnp.int32)
+    if fwd_op.type != "fused_embedding_seq_pool":   # lookup_table family
+        rows = ids.reshape(-1)
+        if g.size != rows.shape[0] * d:
+            return None
+        vals = g.reshape(rows.shape[0], d)
+        padding_idx = fwd_op.attrs.get("padding_idx", -1)
+        if padding_idx is not None and padding_idx >= 0:
+            # forward zeroes padding rows, so their cotangent is dead
+            vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    else:  # fused_embedding_seq_pool: Out [B, D] fans out over T gathers
+        if ids.ndim == 3:
+            ids = ids[..., 0]
+        if ids.ndim != 2 or g.shape != (ids.shape[0], d):
+            return None
+        b, t = ids.shape
+        vals = jnp.broadcast_to(g[:, None, :], (b, t, d))
+        lens = (ins_by_slot.get("SeqLens") or [None])[0]
+        if lens is not None:
+            from paddle_tpu.ops.sequence_ops import _mask_bt
+            mask = _mask_bt(lens, b, t)
+            vals = vals * mask[:, :, None].astype(vals.dtype)
+        rows = ids.reshape(-1)
+        vals = vals.reshape(b * t, d)
+    return sr.RowSparseGrad(rows, vals.astype(w.dtype), height=v)
 
 
 def _flatten(d: Dict[str, List[Any]], layout) -> List[Any]:
@@ -61,6 +123,31 @@ def _vjp_emit(ctx: EmitContext, ins, attrs):
                           program=ctx.program, dist=ctx.dist)
 
     diff_idx = [i for i, m in enumerate(diff_mask) if m]
+
+    def flat_pos(layout, slot):
+        pos = 0
+        out = []
+        for s, n in layout:
+            for _ in range(n):
+                if s == slot:
+                    out.append(pos)
+                pos += 1
+        return out
+
+    if fwd_op.type in SPARSE_EMB_OPS and sr.sparse_grads_enabled():
+        # fast path: W is the only differentiable input, so the whole VJP
+        # is the gather transpose — emit it as a static-shape RowSparseGrad
+        # instead of re-tracing the forward under jax.vjp (whose transpose
+        # scatters into a dense [V, D] zeros)
+        w_pos = flat_pos(in_layout, "W")
+        out_pos = flat_pos(out_layout, "Out")
+        if (len(w_pos) == 1 and diff_idx == w_pos and len(out_pos) == 1
+                and og_matches_single(attrs["out_grad_mask"], out_pos[0])):
+            g = ins.get("OutGrad", [])[0]
+            wgrad = _sparse_embedding_vjp(
+                fwd_op, _unflatten(flat_in, in_layout), {"Out": g})
+            if wgrad is not None:
+                return {"InGrad": [wgrad]}
 
     def forward_flat(diff_vals):
         vals = list(flat_in)

@@ -205,8 +205,17 @@ def _fused_embedding_seq_pool(ctx, ins, attrs):
     ids = first(ins, "Ids").astype(jnp.int32)
     if ids.ndim == 3:
         ids = ids[..., 0]
-    emb = w[ids]                                   # [B, T, D]
     lens = first(ins, "SeqLens")
+    # Pallas tier (ops/pallas/embed_pool.py): gather + masked sum-pool in
+    # ONE pass on TPU for lane-aligned tables — the [B, T, D] gathered
+    # intermediate never reaches HBM. The jnp composition below is the
+    # refer/interpreter tier (and the only tier off-TPU).
+    if w.ndim == 2 and ids.ndim == 2:
+        from paddle_tpu.ops import pallas as pk
+        if pk.kernel_enabled(128, w.shape[1]):
+            return single(pk.fused_embed_seq_pool(w, ids, lens,
+                                                  pk.interpret_mode()))
+    emb = w[ids]                                   # [B, T, D]
     if lens is not None:
         mask = _mask_bt(lens, ids.shape[0], ids.shape[1]).astype(emb.dtype)
         emb = emb * mask[:, :, None]

@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import selected_rows as sr
 from paddle_tpu.core.registry import first, register_op
 
 
@@ -24,6 +25,14 @@ def _sgd(ctx, ins, attrs):
     p = first(ins, "Param")
     g = first(ins, "Grad")
     lr = first(ins, "LearningRate")
+    if sr.is_sparse(g):
+        # SelectedRows apply (sgd_op.cc sparse branch): scatter-add the
+        # scaled rows straight into the table — O(K*D), never materializes
+        # a [V, D] gradient. Duplicate rows sum, exactly like the dense
+        # scatter-add densify would.
+        sr.record_sparse_apply(ctx, g)
+        upd = (lr.reshape(()) * g.values).astype(p.dtype)
+        return {"ParamOut": [p.at[g.rows].add(-upd, mode="drop")]}
     return {"ParamOut": [p - lr.reshape(()) * g]}
 
 
@@ -34,6 +43,20 @@ def _momentum(ctx, ins, attrs):
     v = first(ins, "Velocity")
     lr = first(ins, "LearningRate").reshape(())
     mu = attrs.get("mu", 0.9)
+    if sr.is_sparse(g):
+        # momentum_op.h SparseMomentumFunctor semantics: exact dense parity
+        # (untouched rows still decay their velocity and move the param) —
+        # the saving is the skipped [V, D] gradient materialization; the
+        # velocity/param updates stay elementwise and XLA-fused.
+        sr.record_sparse_apply(ctx, g)
+        vals = g.values.astype(v.dtype)
+        v_out = (mu * v).at[g.rows].add(vals, mode="drop")
+        if attrs.get("use_nesterov", False):
+            p_out = (p - lr * mu * v_out).at[g.rows].add(
+                -(lr * vals).astype(p.dtype), mode="drop")
+        else:
+            p_out = p - lr * v_out
+        return {"ParamOut": [p_out], "VelocityOut": [v_out]}
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -75,9 +98,47 @@ def _adam(ctx, ins, attrs):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if sr.is_sparse(g):
+        # adam_op.h SelectedRows branch. Duplicate rows must merge BEFORE
+        # the squared-gradient moment ((v1+v2)^2 != v1^2+v2^2), the same
+        # reason the reference runs merge_selected_rows first.
+        sr.record_sparse_apply(ctx, g)
+        gs = g.deduped()
+        rows = gs.rows
+        vals = gs.values.astype(p.dtype)
+        if attrs.get("lazy_mode", False):
+            # lazy adam (adam_op.h lazy_mode=true): ONLY touched rows
+            # update — untouched rows' moments don't decay and their
+            # params don't move; beta powers advance globally. O(K*D)
+            # gather/update/scatter instead of an O(V*D) table rewrite.
+            m1_r = b1 * m1[rows] + (1.0 - b1) * vals
+            m2_r = b2 * m2[rows] + (1.0 - b2) * jnp.square(vals)
+            p_r = p[rows] - lr_t * m1_r / (jnp.sqrt(m2_r) + eps)
+            # rows are unique (deduped); padding slots carry row==height
+            # and are dropped by the scatter
+            return {
+                "ParamOut": [p.at[rows].set(p_r, mode="drop")],
+                "Moment1Out": [m1.at[rows].set(m1_r, mode="drop")],
+                "Moment2Out": [m2.at[rows].set(m2_r, mode="drop")],
+                "Beta1PowOut": [b1p.reshape(1) * b1],
+                "Beta2PowOut": [b2p.reshape(1) * b2],
+            }
+        # non-lazy: exact dense parity (untouched rows decay moments and
+        # re-bias the param) without materializing the dense gradient
+        m1_out = (b1 * m1).at[rows].add((1.0 - b1) * vals, mode="drop")
+        m2_out = (b2 * m2).at[rows].add((1.0 - b2) * jnp.square(vals),
+                                        mode="drop")
+        p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+        return {
+            "ParamOut": [p_out],
+            "Moment1Out": [m1_out],
+            "Moment2Out": [m2_out],
+            "Beta1PowOut": [b1p.reshape(1) * b1],
+            "Beta2PowOut": [b2p.reshape(1) * b2],
+        }
     m1_out = b1 * m1 + (1.0 - b1) * g
     m2_out = b2 * m2 + (1.0 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
     p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
     return {
         "ParamOut": [p_out],

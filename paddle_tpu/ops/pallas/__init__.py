@@ -59,3 +59,5 @@ from paddle_tpu.ops.pallas.fused_ce import fused_linear_ce  # noqa: E402,F401
 from paddle_tpu.ops.pallas.fused_rnn import (fused_gru_train,  # noqa: E402,F401
                                              fused_lstm_train)
 from paddle_tpu.ops.pallas.seqpool import masked_seqpool  # noqa: E402,F401
+from paddle_tpu.ops.pallas.embed_pool import (  # noqa: E402,F401
+    fused_embed_seq_pool)

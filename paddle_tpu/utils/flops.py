@@ -54,6 +54,34 @@ def _var_shape(block, name, batch, desc=None):
     return None
 
 
+def _var_itemsize(block, name, desc=None) -> int:
+    """Element size in bytes (4 when unresolvable — the fp32 default)."""
+    b = block
+    while b is not None and name:
+        if b.has_var(name):
+            try:
+                import numpy as np
+                return int(np.dtype(b.var(name).dtype).itemsize)
+            except Exception:
+                return 4
+        if desc is None or b.parent_idx is None or b.parent_idx < 0 \
+                or b.parent_idx == b.idx:
+            break
+        b = desc.block(b.parent_idx)
+    return 4
+
+
+def _emb_rows_cols(ishape):
+    """(B*T, D) for the embedding-family ops: ids [B, T(,1)] x W [V, D]."""
+    ids, w = ishape("Ids"), ishape("W")
+    if ids is None or w is None or len(w) != 2:
+        return None
+    dims = list(ids)
+    if len(dims) >= 2 and dims[-1] == 1:
+        dims = dims[:-1]
+    return _prod(dims), w[-1]
+
+
 def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
                  desc=None) -> float:
     """Forward FLOPs of one op (2 FLOPs per multiply-accumulate)."""
@@ -184,7 +212,91 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
         d = x[-1] // 3
         t, b = x[-2], _prod(x[:-2])
         return 2.0 * b * t * d * 3 * d
+    # -- embedding/pool tier: mask-multiply + add per gathered element
+    # (2*B*T*D). The gather itself is 0 FLOPs (pure data movement — see
+    # op_gather_bytes); without this credit embedding-bound programs
+    # (deepfm, machine_translation) report a near-zero MFU numerator and
+    # the gauge silently under-credits them (ISSUE 3 satellite).
+    if op_type == "sequence_pool":
+        x = ishape("X")                  # [B, T, D]
+        return 2.0 * _prod(x) if x else 0.0
+    if op_type == "fused_embedding_seq_pool":
+        rc = _emb_rows_cols(ishape)
+        return 2.0 * rc[0] * rc[1] if rc else 0.0
+    if op_type == "fusion_seqpool_concat":
+        names = inputs.get("X") or []
+        return sum(2.0 * _prod(s) for s in
+                   (_var_shape(block, n, batch, desc) for n in names) if s)
     return 0.0
+
+
+def op_gather_bytes(block, op_type, inputs, outputs, attrs, batch,
+                    desc=None) -> float:
+    """HBM bytes moved by the gather/pool family's forward pass — the
+    roofline-side accounting for ops whose cost is bandwidth, not FLOPs
+    (lookup_table reads B*T table rows and writes them back out;
+    the pool variants read the rows and write one pooled row per
+    sequence). The row-sparse gradient path (core/selected_rows.py)
+    makes the backward cost symmetric — K rows scattered, not a [V, D]
+    densify — so `__vjp__` of these ops counts 2x forward in
+    program_gather_bytes, mirroring the FLOPs convention."""
+
+    def ishape(slot):
+        names = inputs.get(slot) or []
+        return _var_shape(block, names[0], batch, desc) if names else None
+
+    def itemsize(slot):
+        names = inputs.get(slot) or []
+        return _var_itemsize(block, names[0], desc) if names else 4
+
+    if op_type in ("lookup_table", "lookup_sparse_table"):
+        rc = _emb_rows_cols(ishape)
+        if not rc:
+            return 0.0
+        return 2.0 * rc[0] * rc[1] * itemsize("W")      # rows in + out
+    if op_type == "fused_embedding_seq_pool":
+        rc = _emb_rows_cols(ishape)
+        if not rc:
+            return 0.0
+        bt, d = rc
+        ids = ishape("Ids") or [1]
+        b = ids[0]
+        return (bt + b) * d * itemsize("W")             # gather + pooled out
+    if op_type == "sequence_pool":
+        x = ishape("X")
+        if not x:
+            return 0.0
+        return (_prod(x) + _prod(x[:1] + x[2:])) * itemsize("X")
+    return 0.0
+
+
+def _op_gather_bytes(desc, block, op, batch):
+    if op.type == "__vjp__":
+        fwd = op.attrs.get("fwd_op", {})
+        fop = type("O", (), {"type": fwd.get("type"),
+                             "inputs": fwd.get("inputs", {}),
+                             "outputs": fwd.get("outputs", {}),
+                             "attrs": fwd.get("attrs", {})})()
+        return 2.0 * _op_gather_bytes(desc, block, fop, batch)
+    if op.type in ("while", "scan"):
+        trips = _subblock_trip_count(desc, block, op, batch)
+        sub = desc.block(int(op.attrs["sub_block"]))
+        return trips * sum(_op_gather_bytes(desc, sub, o, batch)
+                           for o in sub.ops)
+    return op_gather_bytes(block, op.type, op.inputs, op.outputs,
+                           op.attrs, batch, desc=desc)
+
+
+def program_gather_bytes(program, batch_size: int,
+                         block_idx: int = 0) -> float:
+    """Total embedding/pool gather-scatter bytes for one execution of the
+    program's block (forward 1x, `__vjp__` 2x). Divide by step time and
+    the chip's peak HBM bandwidth (device_peak_hbm) for the bandwidth-
+    utilization twin of the MFU gauge on embedding-bound programs."""
+    desc = program.desc if hasattr(program, "desc") else program
+    block = desc.block(block_idx)
+    return sum(_op_gather_bytes(desc, block, op, batch_size)
+               for op in block.ops)
 
 
 def _subblock_trip_count(desc, block, op, batch):

@@ -1,0 +1,436 @@
+"""Row-sparse embedding-gradient parity suite (ISSUE 3).
+
+The sparse path (core/selected_rows.py: lookup_table /
+fused_embedding_seq_pool VJP -> RowSparseGrad -> sparse optimizer apply)
+must be OBSERVABLY identical to the dense path it replaces — same training
+curves, same final tables — for SGD / Momentum / Adam, including repeated
+ids within a batch (dedup/merge correctness), padding_idx rows, AMP-bf16
+embeddings, and the iterations>1 device-side scan. lazy_mode Adam is the
+one *intentional* divergence (untouched rows' moments don't decay —
+adam_op.h lazy_mode semantics), asserted against an explicit numpy
+reference.
+
+FLAGS_disable_sparse_grad=1 is the dense control arm in every A/B here.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu import flags
+
+V, D = 40, 8
+
+
+@pytest.fixture(autouse=True)
+def _sparse_enabled_after():
+    yield
+    flags.set("disable_sparse_grad", False)
+
+
+def _build(opt_fn, padding_idx=None, amp=False, seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[6, 1], dtype="int64")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        emb = layers.embedding(ids, size=[V, D], padding_idx=padding_idx,
+                               param_attr=fluid.ParamAttr(name="emb_w"))
+        pooled = layers.reduce_sum(emb, dim=1)
+        pred = layers.fc(pooled, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        opt_fn().minimize(loss)
+        if amp:
+            from paddle_tpu.contrib.mixed_precision import \
+                rewrite_program_amp
+            rewrite_program_amp(main)
+    return main, startup, loss
+
+
+def _batches(n, repeat_id=3, lo=0, hi=V, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(lo, hi, (5, 6, 1)).astype(np.int64)
+        ids[0, :3] = repeat_id            # duplicates within one batch
+        ids[1, 0] = repeat_id
+        out.append({"ids": ids,
+                    "y": rng.rand(5, 1).astype(np.float32)})
+    return out
+
+
+def _train(opt_fn, disable_sparse, batches, padding_idx=None, amp=False,
+           iterations=None):
+    """Returns (per-step losses, final embedding table)."""
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+    framework.reset_default_programs()
+    scope_mod._reset_global_scope_for_tests()
+    flags.set("disable_sparse_grad", disable_sparse)
+    try:
+        main, startup, loss = _build(opt_fn, padding_idx=padding_idx,
+                                     amp=amp)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        if iterations:
+            (stacked,) = exe.run(main, feed=batches, fetch_list=[loss],
+                                 iterations=iterations)
+            losses = [float(v) for v in np.asarray(stacked).ravel()]
+        else:
+            losses = [float(exe.run(main, feed=b, fetch_list=[loss])[0])
+                      for b in batches]
+        from paddle_tpu.core.scope import global_scope
+        w = np.asarray(global_scope().find_var("emb_w"))
+        return losses, w
+    finally:
+        flags.set("disable_sparse_grad", False)
+
+
+OPTIMIZERS = {
+    "sgd": lambda: fluid.optimizer.SGD(learning_rate=0.1),
+    "momentum": lambda: fluid.optimizer.Momentum(learning_rate=0.1,
+                                                 momentum=0.9),
+    "nesterov": lambda: fluid.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9, use_nesterov=True),
+    "adam": lambda: fluid.optimizer.Adam(learning_rate=0.01),
+}
+
+
+@pytest.mark.parametrize("name", sorted(OPTIMIZERS))
+def test_sparse_apply_matches_dense(name):
+    """Sparse-apply == dense-apply on a curve with repeated ids (the
+    dedup/merge stressor: (v1+v2)^2 != v1^2+v2^2 if adam skipped it)."""
+    batches = _batches(4)
+    ls, ws = _train(OPTIMIZERS[name], False, batches)
+    ld, wd = _train(OPTIMIZERS[name], True, batches)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ws, wd, rtol=1e-4, atol=1e-6)
+    assert ls[-1] < ls[0]                 # actually trained
+
+
+def test_padding_idx_rows_stay_zero_grad():
+    """padding_idx rows produce zero gradient on BOTH paths and the
+    padding row of the table never moves."""
+    batches = _batches(4, repeat_id=7)
+    for b in batches:
+        b["ids"][2, :2] = 7               # force padding hits
+    ls, ws = _train(OPTIMIZERS["adam"], False, batches, padding_idx=7)
+    ld, wd = _train(OPTIMIZERS["adam"], True, batches, padding_idx=7)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ws, wd, rtol=1e-4, atol=1e-6)
+    # adam's bias-corrected zero-grad update is exactly zero, so the
+    # padding row equals its initializer on both arms
+    np.testing.assert_allclose(ws[7], wd[7], rtol=0, atol=0)
+
+
+def test_amp_bf16_embedding_parity():
+    """Pure-AMP tags lookup_table __amp_keep_bf16__: the bf16 cotangent is
+    cast back up into the fp32 RowSparseGrad values, same as the dense
+    vjp's astype transpose."""
+    batches = _batches(5)
+    ls, ws = _train(OPTIMIZERS["adam"], False, batches, amp=True)
+    ld, wd = _train(OPTIMIZERS["adam"], True, batches, amp=True)
+    np.testing.assert_allclose(ls, ld, rtol=2e-2, atol=1e-3)
+    np.testing.assert_allclose(ws, wd, rtol=2e-2, atol=1e-3)
+
+
+def test_multi_step_scan_parity():
+    """iterations>1: the sparse pair is created and consumed inside the
+    lax.scan body; N scanned steps == N single steps == dense."""
+    batches = _batches(4)
+    ls, ws = _train(OPTIMIZERS["adam"], False, batches)
+    lsc, wsc = _train(OPTIMIZERS["adam"], False, batches, iterations=4)
+    ld, wd = _train(OPTIMIZERS["adam"], True, batches, iterations=4)
+    np.testing.assert_allclose(ls, lsc, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(lsc, ld, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ws, wsc, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(wsc, wd, rtol=1e-4, atol=1e-6)
+
+
+def test_lazy_adam_matches_numpy_reference():
+    """lazy_mode: only touched rows update; untouched rows' moments don't
+    decay and their params don't move (adam_op.h lazy_mode). Verified
+    against an explicit numpy lazy-adam over varying id sets."""
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+    rng = np.random.RandomState(3)
+    step_ids = [rng.randint(0, V, (5, 6, 1)).astype(np.int64)
+                for _ in range(4)]
+    step_ids[1][:] = step_ids[0][0, 0]    # revisit one row, abandon rest
+
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+
+    def run(lazy):
+        framework.reset_default_programs()
+        scope_mod._reset_global_scope_for_tests()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[6, 1], dtype="int64")
+            emb = layers.embedding(
+                ids, size=[V, D],
+                param_attr=fluid.ParamAttr(name="emb_w"))
+            loss = layers.mean(emb)
+            fluid.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                                 epsilon=eps, lazy_mode=lazy).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        from paddle_tpu.core.scope import global_scope
+        w0 = np.asarray(global_scope().find_var("emb_w")).copy()
+        for sid in step_ids:
+            exe.run(main, feed={"ids": sid}, fetch_list=[loss])
+        return w0, np.asarray(global_scope().find_var("emb_w"))
+
+    w0, w_lazy = run(True)
+
+    # numpy lazy-adam reference: mean-loss grad = 1/(N*D) per gathered
+    # occurrence, duplicates merged per row
+    p = w0.copy()
+    m1 = np.zeros_like(p)
+    m2 = np.zeros_like(p)
+    b1p, b2p = b1, b2
+    for sid in step_ids:
+        flat = sid.reshape(-1)
+        g = np.zeros_like(p)
+        np.add.at(g, flat, 1.0 / (flat.size * D))
+        rows = np.unique(flat)
+        m1[rows] = b1 * m1[rows] + (1 - b1) * g[rows]
+        m2[rows] = b2 * m2[rows] + (1 - b2) * g[rows] ** 2
+        lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+        p[rows] -= lr_t * m1[rows] / (np.sqrt(m2[rows]) + eps)
+        b1p *= b1
+        b2p *= b2
+    # numpy ref runs partly in float64 — compare at fp32-accumulation
+    # tolerance
+    np.testing.assert_allclose(w_lazy, p, rtol=2e-3, atol=1e-5)
+
+    # and the divergence from non-lazy is real: rows touched at step 0
+    # but never again stay frozen under lazy, keep moving under dense
+    _, w_dense = run(False)
+    touched_once = np.setdiff1d(step_ids[0].ravel(),
+                                np.concatenate(
+                                    [s.ravel() for s in step_ids[1:]]))
+    if touched_once.size:
+        assert not np.allclose(w_lazy[touched_once], w_dense[touched_once],
+                               rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(w_lazy[touched_once], p[touched_once],
+                                   rtol=2e-3, atol=1e-5)
+
+
+def test_shared_table_grad_fanin_concat():
+    """One table gathered twice: the two RowSparseGrads aggregate through
+    the `sum` op as a row concatenation — parity with the dense sum."""
+    def build_shared():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 9
+        startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            a = layers.data(name="a", shape=[4, 1], dtype="int64")
+            b = layers.data(name="b", shape=[4, 1], dtype="int64")
+            attr = fluid.ParamAttr(name="emb_w")
+            ea = layers.embedding(a, size=[V, D], param_attr=attr)
+            eb = layers.embedding(b, size=[V, D], param_attr=attr)
+            merged = layers.elementwise_add(layers.reduce_sum(ea, dim=1),
+                                            layers.reduce_sum(eb, dim=1))
+            loss = layers.mean(layers.square(merged))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.core import scope as scope_mod
+    rng = np.random.RandomState(1)
+    feed = {"a": rng.randint(0, V, (3, 4, 1)).astype(np.int64),
+            "b": rng.randint(0, V, (3, 4, 1)).astype(np.int64)}
+
+    results = {}
+    for arm, disable in (("sparse", False), ("dense", True)):
+        framework.reset_default_programs()
+        scope_mod._reset_global_scope_for_tests()
+        flags.set("disable_sparse_grad", disable)
+        try:
+            main, startup, loss = build_shared()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(3)]
+            from paddle_tpu.core.scope import global_scope
+            results[arm] = (ls, np.asarray(
+                global_scope().find_var("emb_w")))
+        finally:
+            flags.set("disable_sparse_grad", False)
+    np.testing.assert_allclose(results["sparse"][0], results["dense"][0],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(results["sparse"][1], results["dense"][1],
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fetched_grad_is_dense():
+    """A fetched @GRAD var densifies at the boundary: users see the same
+    [V, D] array the dense path produced (numeric-grad checkers rely on
+    this)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 2
+    with fluid.program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[4, 1], dtype="int64")
+        emb = layers.embedding(ids, size=[V, D],
+                               param_attr=fluid.ParamAttr(name="emb_w"))
+        loss = layers.mean(emb)
+        opt = fluid.optimizer.SGD(learning_rate=0.0)
+        _, pg = opt.minimize(loss)
+    gname = {p.name: g.name for p, g in pg}["emb_w"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ids = np.asarray([[1, 1, 2, 3]]).reshape(1, 4, 1).astype(np.int64)
+    (g,) = exe.run(main, feed={"ids": ids}, fetch_list=[gname])
+    g = np.asarray(g)
+    assert g.shape == (V, D)
+    expect = np.zeros((V, D), np.float32)
+    np.add.at(expect, ids.ravel(), 1.0 / (4 * D))
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-7)
+
+
+def test_fused_embedding_seq_pool_sparse_parity():
+    """fused_embedding_seq_pool emits the same RowSparseGrad fast path:
+    masked rows (t >= seq_len) carry zero values."""
+    def build(disable):
+        from paddle_tpu.fluid import framework
+        from paddle_tpu.core import scope as scope_mod
+        framework.reset_default_programs()
+        scope_mod._reset_global_scope_for_tests()
+        flags.set("disable_sparse_grad", disable)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 4
+        startup.random_seed = 4
+        with fluid.program_guard(main, startup):
+            from paddle_tpu.fluid.layer_helper import LayerHelper
+            block = main.global_block()
+            ids = layers.data(name="ids", shape=[6], dtype="int64")
+            lens = layers.data(name="lens", shape=[1], dtype="int32")
+            LayerHelper("fesp").create_parameter(
+                fluid.ParamAttr(name="emb_w"), shape=[V, D])
+            out = block.create_var(name="fesp_out", dtype="float32")
+            block.append_op("fused_embedding_seq_pool",
+                            inputs={"W": ["emb_w"], "Ids": ["ids"],
+                                    "SeqLens": ["lens"]},
+                            outputs={"Out": ["fesp_out"]})
+            loss = layers.mean(out)
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(8)
+        feed = {"ids": rng.randint(0, V, (5, 6)).astype(np.int64),
+                "lens": np.asarray([6, 3, 1, 6, 2],
+                                   np.int32).reshape(5, 1)}
+        ls = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(3)]
+        from paddle_tpu.core.scope import global_scope
+        wv = np.asarray(global_scope().find_var("emb_w"))
+        flags.set("disable_sparse_grad", False)
+        return ls, wv
+
+    ls, ws = build(False)
+    ld, wd = build(True)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ws, wd, rtol=1e-4, atol=1e-6)
+
+
+def test_pallas_embed_pool_kernel_interpret():
+    """The fused gather+pool Pallas kernel (interpret tier) matches the
+    jnp refer composition, lens and no-lens, plus its densified VJP."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.embed_pool import fused_embed_seq_pool
+
+    rng = np.random.RandomState(0)
+    v, d, b, t = 24, 128, 5, 7        # b % 8 != 0: exercises padding
+    w = jnp.asarray(rng.rand(v, d).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, v, (b, t)).astype(np.int32))
+    lens = jnp.asarray(rng.randint(1, t + 1, (b,)).astype(np.int32))
+
+    out = np.asarray(fused_embed_seq_pool(w, ids, lens, True))
+    mask = np.arange(t)[None, :] < np.asarray(lens)[:, None]
+    ref = (np.asarray(w)[np.asarray(ids)] * mask[:, :, None]).sum(axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    out2 = np.asarray(fused_embed_seq_pool(w, ids, None, True))
+    ref2 = np.asarray(w)[np.asarray(ids)].sum(axis=1)
+    np.testing.assert_allclose(out2, ref2, rtol=1e-5, atol=1e-5)
+
+    g = jax.grad(lambda w_: fused_embed_seq_pool(w_, ids, lens, True)
+                 .sum())(w)
+    gref = np.zeros((v, d), np.float32)
+    for i in range(b):
+        for j in range(int(lens[i])):
+            gref[int(ids[i, j])] += 1.0
+    np.testing.assert_allclose(np.asarray(g), gref, rtol=1e-5, atol=1e-5)
+
+
+def test_rows_touched_metrics_recorded():
+    """The sparse-apply path registers its site: density gauge at trace
+    time, rows-touched counter advanced per telemetry-sampled dispatch."""
+    from paddle_tpu import observability
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    main, startup, loss = _build(OPTIMIZERS["adam"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    observability.enable()
+    try:
+        before = obs_metrics.counter(
+            "paddle_sparse_rows_touched_total", "", ("param",)) \
+            .labels(param="emb_w").value
+        exe.run(main, feed=_batches(1)[0], fetch_list=[loss])
+    finally:
+        observability.disable()
+    sites = getattr(main.desc, "_sparse_sites", {})
+    assert sites.get("emb_w") == (30, V)          # 5*6 rows, height V
+    gauge = obs_metrics.gauge("paddle_sparse_table_density_ratio", "",
+                              ("param",)).labels(param="emb_w")
+    np.testing.assert_allclose(gauge.value, 30 / V)
+    after = obs_metrics.counter(
+        "paddle_sparse_rows_touched_total", "", ("param",)) \
+        .labels(param="emb_w").value
+    assert after - before == 30
+
+
+def test_selected_rows_idiom_rewrites():
+    """The reference's SelectedRows manipulation ops stay sparse:
+    merge_selected_rows == deduped(), get_tensor_from_selected_rows ==
+    densify() — no silent dense round trip for the canonical idiom."""
+    import jax.numpy as jnp
+    from paddle_tpu.core import selected_rows as sr
+
+    g = sr.RowSparseGrad(jnp.asarray([2, 2, 5], jnp.int32),
+                         jnp.asarray([[1.0], [2.0], [4.0]]), height=8)
+    (merged,) = sr.try_sparse_emit("merge_selected_rows",
+                                   {"X": [g]}, {})["Out"]
+    assert sr.is_sparse(merged) and merged.unique
+    np.testing.assert_allclose(np.asarray(merged.densify()),
+                               np.asarray(g.densify()))
+    (dense,) = sr.try_sparse_emit("get_tensor_from_selected_rows",
+                                  {"X": [g]}, {})["Out"]
+    assert not sr.is_sparse(dense)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(g.densify()))
+
+
+def test_unaware_consumer_densifies_exactly():
+    """A grad consumer outside the sparse-aware set (global-norm clip's
+    squared_l2_norm) transparently densifies — same curve as the dense
+    arm, duplicates included."""
+    batches = _batches(4)
+
+    def with_clip():
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=0.5))
+        return fluid.optimizer.Adam(learning_rate=0.05)
+
+    try:
+        ls, ws = _train(with_clip, False, batches)
+        ld, wd = _train(with_clip, True, batches)
+    finally:
+        fluid.clip.set_gradient_clip(None)
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ws, wd, rtol=1e-4, atol=1e-6)
