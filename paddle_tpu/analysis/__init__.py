@@ -1,0 +1,76 @@
+"""Build-time program verifier and lint framework.
+
+Runs over a ``ProgramDesc`` *before* lowering and rejects malformed
+programs with diagnostics naming the offending op and rule, instead of
+letting them surface as opaque JAX trace errors inside
+``lowering.emit_op_seq`` (or as silent wrong training):
+
+- **structural verifier** (:mod:`~paddle_tpu.analysis.structural`) —
+  unknown ops, dangling input/output vars, def-before-use ordering,
+  control-flow attr schemas, sub-block parent-scope bindings,
+  forward/grad var pairing;
+- **shape/dtype checker** (:mod:`~paddle_tpu.analysis.shapes`) —
+  fixpoints abstract evaluation across blocks (threading the ``-1``
+  batch sentinel) and reports every drift between inferred and declared
+  ``VarDesc`` shape/dtype, plus genuine emitter failures the old
+  inference swallowed;
+- **dataflow analyses** (:mod:`~paddle_tpu.analysis.dataflow`) —
+  dead ops / unused outputs against the fetch set, write-after-write
+  hazards on parameters outside optimizer applies, unfed live inputs,
+  RNG-in-inference determinism;
+- **lint framework** (:mod:`~paddle_tpu.analysis.rules`) — rule
+  registry with severities, per-op ``__lint_suppress__`` suppressions,
+  structured :class:`Diagnostic` records, and observability counters.
+
+Entry points: :func:`analyze_program` (returns diagnostics),
+:func:`verify_program` (raises :class:`ProgramVerificationError` on
+ERROR severities — wired into ``CompiledBlock`` via
+``FLAGS_verify_program``), and the ``tools/proglint.py`` CLI.
+Rule catalog and suppression syntax: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from paddle_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic, ProgramVerificationError, Severity, max_severity,
+    partition)
+from paddle_tpu.analysis.rules import (  # noqa: F401
+    RuleSpec, all_rules, register_rule, run_rules, suppress_op)
+
+
+def analyze_program(program, feed_names: Optional[Sequence[str]] = None,
+                    fetch_names: Optional[Sequence[str]] = None,
+                    is_test: bool = False,
+                    rules: Optional[Sequence[str]] = None,
+                    suppress: Sequence[str] = ()) -> List[Diagnostic]:
+    """Run the full rule catalog (or `rules`) over a program.
+
+    `program` is a ``fluid.Program`` or an ``ir.ProgramDesc``. Feed and
+    fetch names are optional: rules that need them (dead-op,
+    unused-output, unfed-input) skip when they are unknown, so a
+    program can be linted standalone (``tools/proglint.py``) or with
+    the exact executor signature (``FLAGS_verify_program``). Returns
+    diagnostics ordered errors-first.
+    """
+    return run_rules(program, feed_names=feed_names,
+                     fetch_names=fetch_names, is_test=is_test,
+                     rules=rules, suppress=suppress)
+
+
+def verify_program(program, feed_names: Optional[Sequence[str]] = None,
+                   fetch_names: Optional[Sequence[str]] = None,
+                   is_test: bool = False,
+                   suppress: Sequence[str] = ()) -> List[Diagnostic]:
+    """Analyze and raise :class:`ProgramVerificationError` when any
+    ERROR-severity diagnostic survives suppression; returns the full
+    diagnostic list (warnings included) otherwise. This is what
+    ``CompiledBlock`` calls under ``FLAGS_verify_program``."""
+    diags = analyze_program(program, feed_names=feed_names,
+                            fetch_names=fetch_names, is_test=is_test,
+                            suppress=suppress)
+    errors, _, _ = partition(diags)
+    if errors:
+        raise ProgramVerificationError(diags)
+    return diags

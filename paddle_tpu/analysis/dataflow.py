@@ -1,0 +1,229 @@
+"""Dataflow analyses: liveness, write hazards, RNG determinism.
+
+These rules look at how values flow through the op list rather than at
+individual op well-formedness:
+
+- ``dead-op`` / ``unused-output`` — liveness against the declared fetch
+  set, mirroring exactly what ``lowering.analyze_block`` will prune;
+- ``waw-param`` — write-after-write hazards on parameters outside the
+  optimizer-apply ops (a param clobbered by two non-optimizer writes is
+  almost always a transpiler/pass bug);
+- ``unfed-input`` — a live op reads a non-persistable var that is
+  neither fed nor produced (the exact case ``CompiledBlock`` dies on
+  with a RuntimeError at dispatch);
+- ``rng-in-inference`` — ``step_key``-consuming ops (dropout, sampling)
+  in an ``is_test`` program make inference nondeterministic across
+  steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.rules import (SKIPPED_OPS, AnalysisContext,
+                                       register_rule)
+from paddle_tpu.core.registry import get_op, has_op
+
+# ops consuming EmitContext.step_key (fresh randomness per executed
+# step). `gated` ops disable their randomness themselves under
+# is_test (ctx.is_test or the is_test attr); ungated ops draw random
+# bits in inference programs unconditionally.
+_RNG_OPS: Dict[str, bool] = {          # type -> self-gates on is_test
+    "dropout": True,
+    "fused_attention_block": True,
+    "attention": True,
+    "nce": False,
+    "sampling_id": False,
+    "random_crop": False,
+    "generate_proposal_labels": False,
+    "rpn_target_assign": False,
+}
+
+
+def _is_optimizer_apply(op_type: str) -> bool:
+    """True for the optimizer-apply emitters (ops/optimizer_ops.py) —
+    the one family allowed to rewrite parameters in place."""
+    if not has_op(op_type):
+        return False
+    mod = getattr(get_op(op_type).emit, "__module__", "")
+    return mod.endswith(".optimizer_ops")
+
+
+@register_rule("dead-op", Severity.WARNING,
+               "op contributes to no fetch and writes no persistable "
+               "state — lowering prunes it silently; if it was meant to "
+               "run, a fetch or persistable flag is missing",
+               category="dataflow")
+def _dead_op(ctx: AnalysisContext):
+    live = ctx.live_ops()
+    if live is None:                       # fetch set unknown: skip
+        return
+    block = ctx.program.global_block
+    for oi, op in enumerate(block.ops):
+        if op.type in SKIPPED_OPS or oi in live:
+            continue
+        yield Diagnostic(
+            rule="dead-op", severity=Severity.WARNING,
+            message=f"op {op.type!r} is dead for fetches "
+                    f"{list(ctx.fetch_names)}: its outputs "
+                    f"{op.output_names()} reach no fetch and update no "
+                    f"persistable var",
+            block_idx=0, op_index=oi, op_type=op.type)
+
+
+# output slots that are auxiliary by op convention (the reference emits
+# them for the grad op or for optional metrics; consumers routinely
+# ignore them) — not worth an unused-output finding
+_AUX_OUTPUT_SLOTS: Dict[str, Tuple[str, ...]] = {
+    "batch_norm": ("SavedMean", "SavedVariance"),
+    "dropout": ("Mask",),
+    "softmax_with_cross_entropy": ("Softmax",),
+    "accuracy": ("Correct", "Total"),
+    "top_k": ("Indices",),
+    "linear_chain_crf": ("Alpha", "EmissionExps", "TransitionExps"),
+    "nce": ("SampleLogits", "SampleLabels"),
+    "chunk_eval": ("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"),
+    "layer_norm": ("Mean", "Variance"),
+    "dynamic_lstm": ("Cell", "LastHidden", "LastCell"),
+    "dynamic_gru": ("LastHidden",),
+    "sequence_pool": ("MaxIndex",),
+    "cos_sim": ("XNorm", "YNorm"),
+    "hierarchical_sigmoid": ("PreOut",),
+}
+
+
+@register_rule("unused-output", Severity.INFO,
+               "a live op output is never read, fetched, or persisted — "
+               "harmless (XLA drops it) but often a sign of a wrong "
+               "slot name", category="dataflow")
+def _unused_output(ctx: AnalysisContext):
+    live = ctx.live_ops()
+    if live is None:
+        return
+    fetches = set(ctx.fetch_names)
+    for oi in sorted(live):
+        op = ctx.program.global_block.ops[oi]
+        for slot, names in op.outputs.items():
+            if slot in _AUX_OUTPUT_SLOTS.get(op.type, ()):
+                continue
+            for n in names:
+                if n in fetches or ctx.readers[0].get(n):
+                    continue
+                if any(n in r for r in ctx.readers):
+                    continue               # read from a sub-block
+                vd = ctx.resolve(0, n)
+                if vd is None or vd.persistable:
+                    continue
+                yield Diagnostic(
+                    rule="unused-output", severity=Severity.INFO,
+                    message=f"output slot {slot!r} var {n!r} is never "
+                            f"consumed",
+                    block_idx=0, op_index=oi, op_type=op.type, var=n)
+
+
+@register_rule("waw-param", Severity.ERROR,
+               "a parameter is written more than once by non-optimizer "
+               "ops — the earlier write is clobbered (ERROR when no "
+               "read intervenes, WARNING otherwise)",
+               category="dataflow")
+def _waw_param(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        for name, vd in block.vars.items():
+            if not vd.is_parameter:
+                continue
+            writes = [(i, block.ops[i]) for i in ctx.writers[bi].get(name, ())
+                      if block.ops[i].type not in SKIPPED_OPS
+                      and not _is_optimizer_apply(block.ops[i].type)]
+            if len(writes) < 2:
+                continue
+            reads = ctx.readers[bi].get(name, [])
+            for (i0, op0), (i1, op1) in zip(writes, writes[1:]):
+                intervening = any(i0 < r <= i1 for r in reads)
+                yield Diagnostic(
+                    rule="waw-param",
+                    severity=(Severity.WARNING if intervening
+                              else Severity.ERROR),
+                    message=f"parameter {name!r} written by op {i0} "
+                            f"({op0.type!r}) is overwritten by op {i1} "
+                            f"({op1.type!r})"
+                            + (" with an intervening read"
+                               if intervening else
+                               " with no intervening read — the first "
+                               "write is dead"),
+                    block_idx=bi, op_index=i1, op_type=op1.type, var=name,
+                    details={"first_write": i0, "second_write": i1,
+                             "intervening_read": intervening})
+
+
+@register_rule("unfed-input", Severity.ERROR,
+               "a live op reads a non-persistable var that is neither "
+               "fed nor produced by an earlier op — CompiledBlock "
+               "raises at dispatch (\"neither fed nor initialized\")",
+               category="dataflow")
+def _unfed_input(ctx: AnalysisContext):
+    live = ctx.live_ops()
+    if live is None or ctx.feed_names is None:
+        return
+    block = ctx.program.global_block
+    seen = set()
+    for oi in sorted(live):
+        op = block.ops[oi]
+        for n in op.input_names():
+            if n in ctx.feed_names or n in seen:
+                continue
+            writes = ctx.writers[0].get(n, [])
+            if any(w < oi for w in writes):
+                continue
+            vd = ctx.resolve(0, n)
+            if vd is None or vd.persistable:
+                continue                   # dangling-input / scope var
+            seen.add(n)
+            yield Diagnostic(
+                rule="unfed-input", severity=Severity.ERROR,
+                message=f"var {n!r} is consumed by live op {oi} "
+                        f"({op.type!r}) but is not in the feed list "
+                        f"{sorted(ctx.feed_names)}, not persistable, "
+                        f"and not produced earlier",
+                block_idx=0, op_index=oi, op_type=op.type, var=n)
+
+
+def _rng_active(op) -> bool:
+    """Does this op actually draw step randomness given its attrs?"""
+    t = op.type
+    if t == "dropout":
+        return not op.attrs.get("is_test") \
+            and float(op.attrs.get("dropout_prob", 0.5)) > 0.0
+    if t in ("fused_attention_block", "attention"):
+        p = op.attrs.get("dropout_prob", op.attrs.get("dropout", 0.0))
+        return not op.attrs.get("is_test") and float(p or 0.0) > 0.0
+    if t == "nce":
+        return op.attrs.get("seed") is None
+    return True
+
+
+@register_rule("rng-in-inference", Severity.WARNING,
+               "a step_key-consuming op (dropout/sampling) appears in "
+               "an is_test program — inference output varies across "
+               "steps unless the op self-gates", category="dataflow")
+def _rng_in_inference(ctx: AnalysisContext):
+    if not ctx.is_test:
+        return
+    for bi, block in enumerate(ctx.program.blocks):
+        for oi, op in enumerate(block.ops):
+            gated = _RNG_OPS.get(op.type)
+            if gated is None or not _rng_active(op):
+                continue
+            if gated:
+                msg = (f"{op.type!r} is declared in train mode inside an "
+                       f"is_test program; lowering forces it off "
+                       f"(ctx.is_test), but the program should declare "
+                       f"is_test=True explicitly")
+            else:
+                msg = (f"{op.type!r} draws fresh randomness every step — "
+                       f"inference results will not be reproducible")
+            yield Diagnostic(
+                rule="rng-in-inference", severity=Severity.WARNING,
+                message=msg, block_idx=bi, op_index=oi, op_type=op.type,
+                details={"self_gating": bool(gated)})

@@ -1,0 +1,106 @@
+"""Structured diagnostics for the build-time program verifier.
+
+The reference surfaces malformed programs through C++ enforce failures
+inside InferShape / op-registry validation at ``append_op`` time
+(reference: framework/op_desc.cc CheckAttrs, operator.cc:963 runtime
+InferShape). paddle_tpu instead lowers whole blocks through JAX, where a
+malformed program dies as an opaque trace error deep in
+``lowering.emit_op_seq`` — or trains silently wrong. This module defines
+the record every analysis rule produces: a :class:`Diagnostic` carrying
+the rule id, severity, and *op provenance* (block index, op index, op
+type) so the user is pointed at the offending op, not at a JAX
+traceback.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Ordered severities: ERROR fails a verified build
+    (``FLAGS_verify_program``), WARNING is reported (and counted in the
+    observability registry) but never blocks, INFO is advisory lint."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding from one rule, anchored to program coordinates.
+
+    ``op_index`` is the index inside ``blocks[block_idx].ops`` (or None
+    for program/var-level findings); ``var`` names the variable the
+    finding is about when there is one.
+    """
+
+    rule: str
+    severity: Severity
+    message: str
+    block_idx: int = 0
+    op_index: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    details: Dict[str, Any] = field(default_factory=dict, compare=False)
+
+    @property
+    def where(self) -> str:
+        loc = f"block {self.block_idx}"
+        if self.op_index is not None:
+            loc += f", op {self.op_index}"
+            if self.op_type:
+                loc += f" ({self.op_type})"
+        if self.var:
+            loc += f", var {self.var!r}"
+        return loc
+
+    def format(self) -> str:
+        return f"[{self.severity}] {self.rule}: {self.message} ({self.where})"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "severity": str(self.severity),
+            "message": self.message,
+            "block_idx": self.block_idx,
+            "op_index": self.op_index,
+            "op_type": self.op_type,
+            "var": self.var,
+            "details": dict(self.details),
+        }
+
+
+def max_severity(diags) -> Optional[Severity]:
+    sevs = [d.severity for d in diags]
+    return max(sevs) if sevs else None
+
+
+def partition(diags) -> Tuple[List[Diagnostic], List[Diagnostic],
+                              List[Diagnostic]]:
+    """(errors, warnings, infos) in stable order."""
+    errs = [d for d in diags if d.severity == Severity.ERROR]
+    warns = [d for d in diags if d.severity == Severity.WARNING]
+    infos = [d for d in diags if d.severity == Severity.INFO]
+    return errs, warns, infos
+
+
+class ProgramVerificationError(ValueError):
+    """Raised at CompiledBlock build (``FLAGS_verify_program``) when the
+    analyzer finds ERROR-severity diagnostics. Carries the full
+    diagnostic list; the message renders every error with provenance."""
+
+    def __init__(self, diagnostics: List[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        errs, warns, _ = partition(self.diagnostics)
+        lines = [f"program verification failed: {len(errs)} error(s), "
+                 f"{len(warns)} warning(s)"]
+        lines += ["  " + d.format() for d in errs]
+        lines += ["  " + d.format() for d in warns]
+        super().__init__("\n".join(lines))

@@ -1,0 +1,272 @@
+"""Lint-rule framework: registry, analysis context, suppressions, runner.
+
+A rule is a function ``rule(ctx: AnalysisContext) -> Iterable[Diagnostic]``
+registered under a stable kebab-case id with a default severity. The
+runner executes every (selected) rule over a program, applies per-op and
+program-level suppressions, and publishes
+``paddle_analysis_diagnostics_total{rule,severity}`` plus a per-program
+duration histogram to the observability registry
+(docs/observability.md conventions; docs/static_analysis.md catalogs the
+rules).
+
+Suppression syntax (docs/static_analysis.md):
+
+- per op: the op attr ``__lint_suppress__`` holds a list of rule ids (or
+  ``"*"``) — diagnostics anchored to that op are dropped. Layer code can
+  set it via :func:`suppress_op`.
+- per run: ``analyze_program(..., suppress=("dead-op", ...))`` drops the
+  rule program-wide.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.core import ir
+
+SUPPRESS_ATTR = "__lint_suppress__"
+
+# control-flow ops and the attrs naming their sub-blocks (block indices;
+# -1 means "no block", e.g. a cond with an identity false branch)
+SUB_BLOCK_ATTRS: Dict[str, Tuple[str, ...]] = {
+    "while": ("sub_block",),
+    "scan": ("sub_block",),
+    "cond": ("sub_block_true", "sub_block_false"),
+    "conditional_block": ("sub_block_true", "sub_block_false"),
+}
+
+# ops accepted in programs but skipped at lowering (executor feeds/fetches
+# are native jit arguments — core/executor.py module docstring)
+SKIPPED_OPS = frozenset({"feed", "fetch"})
+
+
+@dataclass(frozen=True)
+class RuleSpec:
+    id: str
+    severity: Severity
+    help: str
+    fn: Callable
+    category: str = "general"
+
+
+RULES: Dict[str, RuleSpec] = {}
+
+
+def register_rule(rule_id: str, severity: Severity, help_: str,
+                  category: str = "general"):
+    """Register an analysis rule (analogue of the op registry's
+    ``register_op`` — one flat, importable catalog)."""
+
+    def deco(fn: Callable) -> Callable:
+        if rule_id in RULES:
+            raise ValueError(f"rule {rule_id!r} registered twice")
+        RULES[rule_id] = RuleSpec(id=rule_id, severity=severity,
+                                  help=help_, fn=fn, category=category)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, RuleSpec]:
+    _ensure_builtin_rules()
+    return dict(RULES)
+
+
+def suppress_op(op, *rule_ids: str):
+    """Mark an op (framework.Operator or ir.OpDesc) so the given rules
+    skip it (``"*"`` suppresses everything)."""
+    desc = op.desc if hasattr(op, "desc") else op
+    cur = list(desc.attrs.get(SUPPRESS_ATTR, []))
+    for r in rule_ids:
+        if r not in cur:
+            cur.append(r)
+    desc.attrs[SUPPRESS_ATTR] = cur
+
+
+class AnalysisContext:
+    """Shared, precomputed view of one program that every rule reads.
+
+    Indexing is over the serialized IR (``ir.ProgramDesc``) so the same
+    analysis covers programs built through ``fluid.framework``, loaded
+    from a saved ``__model__.json``, or hand-constructed.
+    """
+
+    def __init__(self, program: ir.ProgramDesc,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 is_test: bool = False):
+        self.program = program
+        self.feed_names = (frozenset(feed_names)
+                           if feed_names is not None else None)
+        self.fetch_names = (tuple(fetch_names)
+                            if fetch_names is not None else None)
+        self.is_test = bool(is_test)
+
+        # per block: name -> sorted op indices writing / reading it
+        self.writers: List[Dict[str, List[int]]] = []
+        self.readers: List[Dict[str, List[int]]] = []
+        for block in program.blocks:
+            w: Dict[str, List[int]] = {}
+            r: Dict[str, List[int]] = {}
+            for i, op in enumerate(block.ops):
+                for n in op.input_names():
+                    r.setdefault(n, []).append(i)
+                for n in op.output_names():
+                    w.setdefault(n, []).append(i)
+            self.writers.append(w)
+            self.readers.append(r)
+
+        # block idx -> (parent block idx, parent op index) for blocks
+        # referenced from a control-flow op's sub_block attrs
+        self.sub_block_owner: Dict[int, Tuple[int, int]] = {}
+        for bi, block in enumerate(program.blocks):
+            for oi, op in enumerate(block.ops):
+                for attr in SUB_BLOCK_ATTRS.get(op.type, ()):
+                    sb = op.attrs.get(attr, -1)
+                    if isinstance(sb, int) and 0 <= sb < len(program.blocks):
+                        self.sub_block_owner.setdefault(sb, (bi, oi))
+
+    # -- var resolution ---------------------------------------------------
+    def resolve(self, block_idx: int, name: str) -> Optional[ir.VarDesc]:
+        """VarDesc for `name` in `block_idx` or its ancestor chain."""
+        return ir.find_var_recursive(self.program,
+                                     self.program.block(block_idx), name)
+
+    def written_anywhere(self, name: str) -> bool:
+        return any(name in w for w in self.writers)
+
+    def ancestor_chain(self, block_idx: int) -> List[int]:
+        """[block_idx, parent, ..., 0] following parent_idx links."""
+        out = [block_idx]
+        b = self.program.block(block_idx)
+        while b.idx != 0 and 0 <= b.parent_idx != b.idx:
+            b = self.program.block(b.parent_idx)
+            out.append(b.idx)
+        return out
+
+    # -- liveness (mirrors lowering.analyze_block for block 0) ------------
+    def live_ops(self) -> Optional[frozenset]:
+        """Indices of block-0 ops that would execute for the declared
+        fetch set, or None when fetches are unknown. Matches
+        ``lowering.analyze_block``: an op is live if it contributes to a
+        fetch or writes persistable state."""
+        if self.fetch_names is None:
+            return None
+        cached = getattr(self, "_live_ops", None)
+        if cached is not None:
+            return cached
+        block = self.program.global_block
+
+        def is_persistable(n: str) -> bool:
+            vd = self.resolve(0, n)
+            return vd is not None and vd.persistable
+
+        needed = set(self.fetch_names)
+        live = set()
+        for i in range(len(block.ops) - 1, -1, -1):
+            op = block.ops[i]
+            if op.type in SKIPPED_OPS:
+                continue
+            outs = op.output_names()
+            if (set(outs) & needed) or any(is_persistable(n) for n in outs):
+                live.add(i)
+                needed.update(op.input_names())
+        self._live_ops = frozenset(live)
+        return self._live_ops
+
+
+def _ensure_builtin_rules():
+    # rule modules self-register on import (same pattern as ops/__init__
+    # registering emitters); imported lazily to avoid a cycle with
+    # core.shape_inference
+    from paddle_tpu.analysis import dataflow, shapes, structural  # noqa: F401
+
+
+def _op_suppressions(op: ir.OpDesc) -> frozenset:
+    sup = op.attrs.get(SUPPRESS_ATTR)
+    if not sup:
+        return frozenset()
+    if isinstance(sup, str):
+        sup = [sup]
+    return frozenset(str(s) for s in sup)
+
+
+def _suppressed(ctx: AnalysisContext, d: Diagnostic,
+                program_suppress: frozenset) -> bool:
+    if d.rule in program_suppress or "*" in program_suppress:
+        return True
+    if d.op_index is None:
+        return False
+    try:
+        op = ctx.program.block(d.block_idx).ops[d.op_index]
+    except (IndexError, TypeError):
+        return False
+    sup = _op_suppressions(op)
+    return d.rule in sup or "*" in sup
+
+
+def run_rules(program, feed_names=None, fetch_names=None, is_test=False,
+              rules: Optional[Sequence[str]] = None,
+              suppress: Sequence[str] = ()) -> List[Diagnostic]:
+    """Run the (selected) rule catalog over a program and return the
+    surviving diagnostics, ordered by severity (errors first) then by
+    program position. Accepts a ``fluid.Program`` or an
+    ``ir.ProgramDesc``."""
+    _ensure_builtin_rules()
+    desc = program.desc if hasattr(program, "desc") else program
+    if is_test is False and getattr(program, "_is_test", False):
+        is_test = True
+    if rules is None:
+        selected = list(RULES.values())
+    else:
+        unknown = sorted(set(rules) - set(RULES))
+        if unknown:
+            raise ValueError(f"unknown rule id(s) {unknown}; available: "
+                             f"{sorted(RULES)}")
+        selected = [RULES[r] for r in rules]
+    program_suppress = frozenset(suppress)
+    ctx = AnalysisContext(desc, feed_names=feed_names,
+                          fetch_names=fetch_names, is_test=is_test)
+
+    t0 = time.perf_counter()
+    diags: List[Diagnostic] = []
+    for spec in selected:
+        for d in spec.fn(ctx):
+            if not _suppressed(ctx, d, program_suppress):
+                diags.append(d)
+    diags.sort(key=lambda d: (-int(d.severity), d.block_idx,
+                              -1 if d.op_index is None else d.op_index,
+                              d.rule))
+    _publish_metrics(diags, time.perf_counter() - t0)
+    return diags
+
+
+def declare_metrics():
+    """Get-or-create the analyzer's metric families in the default
+    registry (called per analysis run AND from the exporters' catalog
+    preregistration so a scrape shows them at zero)."""
+    from paddle_tpu.observability import metrics as obs_metrics
+    diags = obs_metrics.counter(
+        "paddle_analysis_diagnostics_total",
+        "diagnostics emitted by the build-time program verifier, "
+        "per rule and severity", ("rule", "severity"))
+    dur = obs_metrics.histogram(
+        "paddle_analysis_duration_seconds",
+        "wall time of one whole-program analysis pass "
+        "(structural + shape/dtype + dataflow rules)")
+    return diags, dur
+
+
+def _publish_metrics(diags: List[Diagnostic], elapsed_s: float):
+    """paddle_analysis_diagnostics_total{rule,severity} + per-program
+    duration histogram (never fails the analysis)."""
+    try:
+        fam, dur = declare_metrics()
+        for d in diags:
+            fam.labels(rule=d.rule, severity=str(d.severity)).inc()
+        dur.observe(elapsed_s)
+    except Exception:
+        pass

@@ -1,0 +1,193 @@
+"""Whole-program shape/dtype checker.
+
+Fixpoints :func:`core.shape_inference.abstract_eval_op` across every
+block (sub-blocks resolve parent-scope vars through the ancestor chain,
+and control-flow ops trace their sub-blocks because the program handle
+is threaded through), compares every inferred output against its
+declared ``VarDesc``, and reports each drift with op provenance. The
+``-1`` dynamic-batch sentinel is threaded by the inference machinery and
+treated as wildcard in comparisons.
+
+This is the build-time analogue of the reference running C++ InferShape
+over the whole program per execution (operator.cc:963) — except
+mismatches become diagnostics naming the producing op instead of
+exceptions at step time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.rules import (SKIPPED_OPS, AnalysisContext,
+                                       register_rule)
+from paddle_tpu.core import ir
+from paddle_tpu.core.shape_inference import (_SENTINEL, _from_abstract,
+                                             abstract_eval_op)
+
+_MAX_PASSES = 4
+
+
+def _is_dynamic(d: int) -> bool:
+    """True for the -1 batch marker and its sentinel-space multiples
+    (B, B*T, ... — batch-derived, unknowable statically)."""
+    return d == -1 or (d >= _SENTINEL and d % _SENTINEL == 0)
+
+
+def _shapes_compatible(declared, inferred_raw) -> bool:
+    """Declared VarDesc shape vs sentinel-space inferred shape: dynamic
+    dims on either side are wildcards, concrete dims must agree."""
+    if declared is None:
+        return True
+    if len(declared) != len(inferred_raw):
+        return False
+    for d, i in zip(declared, inferred_raw):
+        if _is_dynamic(d) or _is_dynamic(i):
+            continue
+        if int(d) != int(i):
+            return False
+    return True
+
+
+def _norm_dtype(dt: str) -> str:
+    try:
+        return str(jnp.dtype(dt))
+    except TypeError:
+        return str(dt)
+
+
+def _check_program_shapes(ctx: AnalysisContext) -> List[Diagnostic]:
+    """One fixpoint run over all blocks; cached on the context so the
+    three rules below share it."""
+    cached = getattr(ctx, "_shape_diags", None)
+    if cached is not None:
+        return cached
+    program = ctx.program
+    # (block_idx, name) -> VarDesc synthesized from inference, in
+    # SENTINEL SPACE (batch-derived dims stay as sentinel multiples so B
+    # and B*T remain distinguishable downstream — a grad var declared
+    # [-1, V] whose value is really [B*T, V] must not re-collapse);
+    # consulted before the declared symbol table so later passes and
+    # later ops see refined shapes
+    inferred_vars: Dict[Tuple[int, str], ir.VarDesc] = {}
+
+    def make_lookup(block_idx: int):
+        chain = ctx.ancestor_chain(block_idx)
+
+        def lookup(name: str) -> Optional[ir.VarDesc]:
+            for b in chain:
+                hit = inferred_vars.get((b, name))
+                if hit is not None:
+                    return hit
+                block = program.block(b)
+                if block.has_var(name):
+                    vd = block.var(name)
+                    if vd.shape is not None:
+                        return vd
+                    # declared but shapeless: keep walking only if an
+                    # ancestor could shadow it — it can't, so report the
+                    # declared desc (inference will skip on it)
+                    return vd
+            return None
+        return lookup
+
+    results: Dict[Tuple[int, int], object] = {}
+    for _ in range(_MAX_PASSES):
+        changed = False
+        for bi, block in enumerate(program.blocks):
+            lookup = make_lookup(bi)
+            for oi, op in enumerate(block.ops):
+                if op.type in SKIPPED_OPS:
+                    continue
+                res = abstract_eval_op(block, op, lookup=lookup,
+                                       is_test=ctx.is_test,
+                                       program=program, raw_dims=True)
+                results[(bi, oi)] = res
+                if not res.ok:
+                    continue
+                for name, (shape, dtype) in res.outputs.items():
+                    vd = ir.VarDesc(name=name, shape=list(shape),
+                                    dtype=_norm_dtype(dtype))
+                    # refine only when inference disagrees with what the
+                    # lookup already resolves (declared VarDesc included)
+                    # — storing an identical desc would force a full
+                    # re-evaluation pass for nothing
+                    prev = lookup(name)
+                    if prev is not None and prev.shape == vd.shape \
+                            and _norm_dtype(prev.dtype) == vd.dtype:
+                        continue
+                    inferred_vars[(bi, name)] = vd
+                    changed = True
+        if not changed:
+            break
+
+    diags: List[Diagnostic] = []
+    for (bi, oi), res in sorted(results.items()):
+        block = program.block(bi)
+        op = block.ops[oi]
+        if res.error is not None:
+            diags.append(Diagnostic(
+                rule="shape-infer-error", severity=Severity.WARNING,
+                message=f"abstract evaluation of op {op.type!r} failed "
+                        f"with {res.error_type}: {res.error} — likely an "
+                        f"emitter bug or malformed attrs (benign "
+                        f"concrete-value cases are skipped, not "
+                        f"reported)",
+                block_idx=bi, op_index=oi, op_type=op.type,
+                details={"error_type": res.error_type}))
+            continue
+        if not res.ok:
+            continue
+        for name, (shape, dtype) in res.outputs.items():
+            vd = ctx.resolve(bi, name)
+            if vd is None:
+                continue                   # dangling-output covers this
+            if not _shapes_compatible(vd.shape, shape):
+                shown = list(_from_abstract(shape))
+                diags.append(Diagnostic(
+                    rule="shape-mismatch", severity=Severity.ERROR,
+                    message=f"op {op.type!r} produces {name!r} with "
+                            f"shape {shown} but the VarDesc "
+                            f"declares {vd.shape}",
+                    block_idx=bi, op_index=oi, op_type=op.type, var=name,
+                    details={"declared": vd.shape,
+                             "inferred": shown}))
+            decl_dt, inf_dt = _norm_dtype(vd.dtype), _norm_dtype(dtype)
+            if decl_dt != inf_dt:
+                diags.append(Diagnostic(
+                    rule="dtype-mismatch", severity=Severity.ERROR,
+                    message=f"op {op.type!r} produces {name!r} as "
+                            f"{inf_dt} but the VarDesc declares "
+                            f"{decl_dt}",
+                    block_idx=bi, op_index=oi, op_type=op.type, var=name,
+                    details={"declared": decl_dt, "inferred": inf_dt}))
+    ctx._shape_diags = diags
+    return diags
+
+
+@register_rule("shape-mismatch", Severity.ERROR,
+               "an op's inferred output shape disagrees with the "
+               "declared VarDesc shape (-1 batch dims are wildcards)",
+               category="shapes")
+def _shape_mismatch(ctx: AnalysisContext):
+    return [d for d in _check_program_shapes(ctx)
+            if d.rule == "shape-mismatch"]
+
+
+@register_rule("dtype-mismatch", Severity.ERROR,
+               "an op's inferred output dtype disagrees with the "
+               "declared VarDesc dtype", category="shapes")
+def _dtype_mismatch(ctx: AnalysisContext):
+    return [d for d in _check_program_shapes(ctx)
+            if d.rule == "dtype-mismatch"]
+
+
+@register_rule("shape-infer-error", Severity.WARNING,
+               "abstract evaluation of an emitter raised a genuine "
+               "error (not a concretization skip) — an emitter bug or "
+               "malformed attrs", category="shapes")
+def _shape_infer_error(ctx: AnalysisContext):
+    return [d for d in _check_program_shapes(ctx)
+            if d.rule == "shape-infer-error"]

@@ -1,0 +1,266 @@
+"""Structural verifier: IR invariants that must hold before lowering.
+
+Capability parity with the reference's build-time validation
+(reference: framework/op_desc.cc CheckAttrs + op_registry OpInfo checks
+run on every append_op): unknown ops, dangling input/output vars,
+def-before-use ordering, control-flow attr schemas, sub-block
+parent-scope bindings, and forward/grad var pairing — each reported as a
+:class:`~paddle_tpu.analysis.diagnostics.Diagnostic` with op provenance
+instead of dying as a KeyError inside ``lowering.emit_op_seq``.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.rules import (SKIPPED_OPS, SUB_BLOCK_ATTRS,
+                                       AnalysisContext, register_rule)
+from paddle_tpu.core.registry import has_op
+from paddle_tpu.ops.grad_ops import GRAD_SUFFIX
+
+# attrs through which a control-flow op binds parent-scope values into
+# its sub-block's trace env (ops/control_flow.py emitters)
+_BINDING_ATTRS = {
+    "while": ("carry_vars", "x_vars"),
+    "scan": ("carry_in_vars", "scan_in_vars", "x_vars"),
+    "cond": ("x_vars",),
+    "conditional_block": ("x_vars",),
+}
+
+
+def entry_bound(ctx: AnalysisContext, block_idx: int) -> Set[str]:
+    """Names available in a sub-block's env at entry: whatever the owning
+    control-flow op binds (carry/scan/x lists). Block 0 has no owner —
+    its entry set is feeds + scope, handled separately."""
+    owner = ctx.sub_block_owner.get(block_idx)
+    if owner is None:
+        return set()
+    bi, oi = owner
+    op = ctx.program.block(bi).ops[oi]
+    bound: Set[str] = set()
+    for attr in _BINDING_ATTRS.get(op.type, ()):
+        vals = op.attrs.get(attr) or []
+        if isinstance(vals, (list, tuple)):
+            bound.update(str(v) for v in vals)
+    return bound
+
+
+@register_rule("unknown-op", Severity.ERROR,
+               "op type has no registered emitter (core/registry.py); "
+               "lowering would raise a KeyError mid-trace",
+               category="structural")
+def _unknown_op(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        for oi, op in enumerate(block.ops):
+            if op.type in SKIPPED_OPS or has_op(op.type):
+                continue
+            yield Diagnostic(
+                rule="unknown-op", severity=Severity.ERROR,
+                message=f"no emitter registered for op {op.type!r}",
+                block_idx=bi, op_index=oi, op_type=op.type)
+
+
+@register_rule("dangling-input", Severity.ERROR,
+               "op input names a var with no VarDesc in the block or its "
+               "ancestors and no producing op — undefined at trace time",
+               category="structural")
+def _dangling_input(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        chain = ctx.ancestor_chain(bi)
+        for oi, op in enumerate(block.ops):
+            if op.type in SKIPPED_OPS:
+                continue
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if ctx.resolve(bi, n) is not None:
+                        continue
+                    if any(n in ctx.writers[b] for b in chain):
+                        continue
+                    yield Diagnostic(
+                        rule="dangling-input", severity=Severity.ERROR,
+                        message=f"input slot {slot!r} references var "
+                                f"{n!r}, which is neither declared nor "
+                                f"produced by any op",
+                        block_idx=bi, op_index=oi, op_type=op.type, var=n)
+
+
+@register_rule("dangling-output", Severity.WARNING,
+               "op writes a var with no VarDesc anywhere in scope — the "
+               "IR symbol table has drifted from the op list",
+               category="structural")
+def _dangling_output(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        for oi, op in enumerate(block.ops):
+            if op.type in SKIPPED_OPS:
+                continue
+            for slot, names in op.outputs.items():
+                for n in names:
+                    if ctx.resolve(bi, n) is None:
+                        yield Diagnostic(
+                            rule="dangling-output",
+                            severity=Severity.WARNING,
+                            message=f"output slot {slot!r} writes var "
+                                    f"{n!r}, which has no VarDesc",
+                            block_idx=bi, op_index=oi, op_type=op.type,
+                            var=n)
+
+
+@register_rule("def-before-use", Severity.ERROR,
+               "a non-persistable var is read before every op that "
+               "writes it, so the trace env cannot contain it yet",
+               category="structural")
+def _def_before_use(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        bound = entry_bound(ctx, bi)
+        for oi, op in enumerate(block.ops):
+            if op.type in SKIPPED_OPS:
+                continue
+            for n in op.input_names():
+                writes = ctx.writers[bi].get(n)
+                if not writes:
+                    continue            # never written: a feed/scope source
+                if min(writes) < oi:
+                    continue            # defined by an earlier op
+                if n in bound:
+                    continue            # bound at sub-block entry
+                if ctx.feed_names is not None and n in ctx.feed_names:
+                    continue
+                vd = ctx.resolve(bi, n)
+                if vd is not None and vd.persistable:
+                    continue            # read from scope, updated later
+                yield Diagnostic(
+                    rule="def-before-use", severity=Severity.ERROR,
+                    message=f"var {n!r} is read here but first written by "
+                            f"op {min(writes)} "
+                            f"({block.ops[min(writes)].type!r}) — "
+                            f"program order defines it too late",
+                    block_idx=bi, op_index=oi, op_type=op.type, var=n,
+                    details={"first_write_index": min(writes)})
+
+
+@register_rule("subblock-unbound-read", Severity.ERROR,
+               "a sub-block op reads a parent-scope var the owning "
+               "control-flow op does not bind (x_vars/carry_vars/...) — "
+               "emit_subblock would KeyError at trace time",
+               category="structural")
+def _subblock_unbound_read(ctx: AnalysisContext):
+    for bi in ctx.sub_block_owner:
+        block = ctx.program.block(bi)
+        owner_bi, owner_oi = ctx.sub_block_owner[bi]
+        owner = ctx.program.block(owner_bi).ops[owner_oi]
+        bound = entry_bound(ctx, bi)
+        produced: Set[str] = set()
+        for oi, op in enumerate(block.ops):
+            if op.type in SKIPPED_OPS:
+                continue
+            for n in op.input_names():
+                if n in bound or n in produced:
+                    continue
+                yield Diagnostic(
+                    rule="subblock-unbound-read", severity=Severity.ERROR,
+                    message=f"var {n!r} is read inside sub-block {bi} but "
+                            f"not bound by the owning {owner.type!r} op "
+                            f"(block {owner_bi}, op {owner_oi}); add it "
+                            f"to x_vars or the carry",
+                    block_idx=bi, op_index=oi, op_type=op.type, var=n,
+                    details={"owner_block": owner_bi,
+                             "owner_op": owner_oi,
+                             "owner_type": owner.type})
+            produced.update(op.output_names())
+
+
+def _is_int_list(v) -> bool:
+    return isinstance(v, (list, tuple)) and \
+        all(isinstance(x, (int, bool)) for x in v)
+
+
+def _is_str_list(v) -> bool:
+    return isinstance(v, (list, tuple)) and all(isinstance(x, str) for x in v)
+
+
+@register_rule("attr-schema", Severity.ERROR,
+               "op attributes violate the emitter's schema: missing "
+               "required control-flow attrs, sub_block indices out of "
+               "range, malformed __vjp__ masks",
+               category="structural")
+def _attr_schema(ctx: AnalysisContext):
+    n_blocks = len(ctx.program.blocks)
+    for bi, block in enumerate(ctx.program.blocks):
+        for oi, op in enumerate(block.ops):
+            where = dict(block_idx=bi, op_index=oi, op_type=op.type)
+
+            def bad(msg, **details):
+                return Diagnostic(rule="attr-schema",
+                                  severity=Severity.ERROR, message=msg,
+                                  details=details, **where)
+
+            if op.type in SUB_BLOCK_ATTRS:
+                required = {"while": ("sub_block", "cond_var",
+                                      "carry_vars"),
+                            "scan": ("sub_block",),
+                            "cond": ("out_vars",),
+                            "conditional_block": ("out_vars",)}[op.type]
+                for a in required:
+                    if a not in op.attrs:
+                        yield bad(f"{op.type!r} op is missing required "
+                                  f"attr {a!r}", attr=a)
+                for a in SUB_BLOCK_ATTRS[op.type]:
+                    sb = op.attrs.get(a, -1)
+                    if not isinstance(sb, int):
+                        yield bad(f"attr {a!r} must be a block index, "
+                                  f"got {type(sb).__name__}", attr=a)
+                    elif sb >= n_blocks or (sb >= 0 and sb == bi):
+                        yield bad(f"attr {a!r} references block {sb}, "
+                                  f"which "
+                                  + ("is the op's own block"
+                                     if sb == bi else "does not exist"),
+                                  attr=a, block_ref=sb)
+                for a in _BINDING_ATTRS.get(op.type, ()) + ("out_vars",):
+                    v = op.attrs.get(a)
+                    if v is not None and not _is_str_list(v):
+                        yield bad(f"attr {a!r} must be a list of var "
+                                  f"names", attr=a)
+                cv = op.attrs.get("cond_var")
+                carry = op.attrs.get("carry_vars")
+                if op.type == "while" and isinstance(cv, str) \
+                        and _is_str_list(carry) and cv not in carry:
+                    yield bad(f"cond_var {cv!r} is not in carry_vars "
+                              f"{list(carry)}", attr="cond_var")
+            elif op.type == "__vjp__":
+                fwd = op.attrs.get("fwd_op")
+                if not isinstance(fwd, dict) or "type" not in fwd:
+                    yield bad("__vjp__ op is missing its fwd_op dict")
+                    continue
+                n_out = sum(len(v)
+                            for v in (fwd.get("outputs") or {}).values())
+                masks = {"in_grad_mask": len(op.input("FwdIn")),
+                         "out_grad_mask": n_out}
+                for a, want in masks.items():
+                    m = op.attrs.get(a)
+                    if not _is_int_list(m):
+                        yield bad(f"__vjp__ attr {a!r} must be a list of "
+                                  f"booleans", attr=a)
+                    elif want and len(m) != want:
+                        yield bad(f"__vjp__ attr {a!r} has {len(m)} "
+                                  f"entries for {want} slots", attr=a,
+                                  expected=want, got=len(m))
+
+
+@register_rule("grad-pairing", Severity.WARNING,
+               "a @GRAD var exists whose forward counterpart is missing "
+               "— backward graph drifted from the forward",
+               category="structural")
+def _grad_pairing(ctx: AnalysisContext):
+    for bi, block in enumerate(ctx.program.blocks):
+        for name in block.vars:
+            if GRAD_SUFFIX not in name:
+                continue
+            base = name.split(GRAD_SUFFIX, 1)[0]
+            if not base or ctx.resolve(bi, base) is not None:
+                continue
+            yield Diagnostic(
+                rule="grad-pairing", severity=Severity.WARNING,
+                message=f"gradient var {name!r} has no forward var "
+                        f"{base!r} in scope",
+                block_idx=bi, var=name, details={"forward_var": base})

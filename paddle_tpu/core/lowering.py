@@ -230,6 +230,19 @@ class CompiledBlock:
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  is_test: bool = False, donate: bool = True, dist=None):
         self._obs_tag = next(CompiledBlock._SEQ)
+        # build-time program verification (FLAGS_verify_program or a
+        # BuildStrategy.verify_program request): reject malformed
+        # programs with rule + op provenance BEFORE tracing, where the
+        # same defect would surface as an opaque JAX error (or not at
+        # all). Errors raise ProgramVerificationError; warnings land in
+        # paddle_analysis_diagnostics_total (docs/static_analysis.md).
+        from paddle_tpu import flags as _flags
+        if _flags.get("verify_program") \
+                or getattr(program, "_verify_requested", False):
+            from paddle_tpu import analysis
+            analysis.verify_program(program, feed_names=feed_names,
+                                    fetch_names=fetch_names,
+                                    is_test=is_test)
         block = program.block(block_idx)
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block

@@ -9,10 +9,22 @@ inference exists at all.
 
 The dynamic batch dimension (-1 in VarDesc.shape) is threaded through
 abstract eval as a sentinel prime and mapped back to -1 in the result.
+
+Failure taxonomy (:class:`InferResult`): inference can be *skipped* for
+benign reasons — unregistered op, an input with no declared shape, or an
+emitter that needs concrete values (a JAX concretization error under
+abstract eval) — or it can hit a *genuine emitter error* (TypeError,
+broadcast mismatch, bad attr, ...). The old code collapsed both into
+``return None``, which hid real bugs until ``lowering.emit_op_seq`` died
+mid-trace; now genuine errors are carried on the result (and logged at
+debug level) so the analyzer (paddle_tpu.analysis) can surface them with
+op provenance.
 """
 
 from __future__ import annotations
 
+import logging
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -23,10 +35,60 @@ from paddle_tpu.core.registry import EmitContext, get_op, has_op
 
 _SENTINEL = 6079  # prime, unlikely to appear as a real static dim
 
+logger = logging.getLogger("paddle_tpu.shape_inference")
 
-def _to_struct(v: ir.VarDesc):
-    shape = tuple(_SENTINEL if d == -1 else d for d in (v.shape or ()))
-    return jax.ShapeDtypeStruct(shape, jnp.dtype(v.dtype))
+# exception classes meaning "this emitter needs concrete values" — the
+# benign can't-abstractly-evaluate case, not an emitter bug.
+# ConcretizationTypeError is the base of the Tracer*ConversionError family.
+_CONCRETIZATION_ERRORS: Tuple[type, ...] = tuple(
+    e for e in (getattr(jax.errors, n, None)
+                for n in ("ConcretizationTypeError",
+                          "TracerArrayConversionError",
+                          "TracerBoolConversionError",
+                          "TracerIntegerConversionError",
+                          "NonConcreteBooleanIndexError"))
+    if e is not None)
+
+
+@dataclass(frozen=True)
+class InferResult:
+    """Outcome of abstractly evaluating one op.
+
+    Exactly one of three states:
+    - inferred:       ``outputs`` is the {name: (shape, dtype)} map;
+    - skipped:        ``outputs`` is None, ``skipped`` names the benign
+                      reason (``unregistered-op``, ``missing-input-shape``,
+                      ``concrete-value-needed``, ``needs-program``,
+                      ``dynamic-dim-ambiguous``);
+    - emitter error:  ``outputs`` is None, ``error``/``error_type`` carry
+                      the genuine failure for the analyzer to surface.
+    """
+
+    outputs: Optional[Dict[str, Tuple[Tuple[int, ...], str]]] = None
+    skipped: Optional[str] = None
+    error: Optional[str] = None
+    error_type: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.outputs is not None
+
+
+def _to_struct(v: ir.VarDesc, batch_dim: int = _SENTINEL):
+    """Declared shape -> abstract struct: -1 becomes `batch_dim`, and
+    sentinel-multiple dims (batch-derived products that a sentinel-space
+    caller kept raw, e.g. B*T) rescale to the same batch base so a
+    concrete-batch retry stays self-consistent."""
+    shape = []
+    for d in (v.shape or ()):
+        if d == -1:
+            shape.append(batch_dim)
+        elif batch_dim != _SENTINEL and d >= _SENTINEL \
+                and d % _SENTINEL == 0:
+            shape.append((d // _SENTINEL) * batch_dim)
+        else:
+            shape.append(d)
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(v.dtype))
 
 
 def _from_abstract(shape) -> Tuple[int, ...]:
@@ -39,14 +101,29 @@ def _from_abstract(shape) -> Tuple[int, ...]:
     return tuple(out)
 
 
-def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc, lookup=None
-                     ) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
-    """Returns {output var name: (shape with -1 batch dims, dtype)} or None
-    if inference is not possible (emitter needs concrete values).
-    `lookup(name) -> VarDesc | None` resolves vars across ancestor blocks
-    (sub-block ops read parent-scope vars, e.g. parameters in block 0)."""
+# ops whose emitters recursively lower sub-blocks and therefore need the
+# enclosing ProgramDesc on the EmitContext (ops/control_flow.py)
+_NEEDS_PROGRAM = frozenset({"while", "scan", "cond", "conditional_block"})
+
+
+def abstract_eval_op(block: ir.BlockDesc, op: ir.OpDesc, lookup=None,
+                     is_test: bool = False,
+                     program: Optional[ir.ProgramDesc] = None,
+                     raw_dims: bool = False) -> InferResult:
+    """Abstractly evaluate one op's emitter over its declared input
+    shapes/dtypes. `lookup(name) -> VarDesc | None` resolves vars across
+    ancestor blocks (sub-block ops read parent-scope vars, e.g.
+    parameters in block 0). `program` enables control-flow ops (their
+    emitters recursively trace sub-blocks); without it they are skipped.
+
+    `raw_dims=True` returns shapes in *sentinel space* (batch-derived
+    dims stay as sentinel multiples instead of collapsing to -1) — the
+    whole-program checker (analysis/shapes.py) fixpoints in that space
+    so B and B*T remain distinguishable across ops."""
     if not has_op(op.type):
-        return None
+        return InferResult(skipped="unregistered-op")
+    if program is None and op.type in _NEEDS_PROGRAM:
+        return InferResult(skipped="needs-program")
     spec = get_op(op.type)
     if lookup is None:
         lookup = lambda n: block.var(n) if block.has_var(n) else None  # noqa: E731
@@ -57,21 +134,45 @@ def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc, lookup=None
         for n in names:
             vd = lookup(n)
             if vd is None or vd.shape is None:
-                return None
+                return InferResult(skipped="missing-input-shape")
             vals.append(_to_struct(vd))
         ins_structs[slot] = vals
 
-    ctx = EmitContext(base_key=None, op_index=0, is_test=False)
-
     def f(ins):
         # base key must be created inside the traced fn
-        ctx2 = EmitContext(base_key=jax.random.key(0), op_index=0, is_test=False)
+        ctx2 = EmitContext(base_key=jax.random.key(0), op_index=0,
+                           is_test=is_test, program=program, op=op)
         return spec.emit(ctx2, ins, op.attrs)
 
     try:
         outs = jax.eval_shape(f, ins_structs)
-    except Exception:
-        return None
+    except _CONCRETIZATION_ERRORS:
+        return InferResult(skipped="concrete-value-needed")
+    except Exception as e:
+        # The -1 sentinel aliases: two dims that are both batch-derived
+        # (B and B*T) map to different sentinel multiples, so shape
+        # arithmetic that is consistent at run time (concrete batch) can
+        # fail under abstract eval — e.g. a __vjp__ cotangent declared
+        # [-1, V] reshaped against a primal [B*T, V]. Discriminate by
+        # retrying with a small CONCRETE batch: success means the
+        # failure was a sentinel artifact (benign skip); a second
+        # failure is a genuine emitter/attr bug worth surfacing.
+        had_dynamic = any(
+            d % _SENTINEL == 0
+            for vals in ins_structs.values() for s in vals
+            for d in s.shape if d >= _SENTINEL)
+        if had_dynamic:
+            concrete_ins = {
+                slot: [_to_struct(lookup(n), batch_dim=4) for n in names]
+                for slot, names in op.inputs.items()}
+            try:
+                jax.eval_shape(f, concrete_ins)
+                return InferResult(skipped="dynamic-dim-ambiguous")
+            except Exception:
+                pass
+        logger.debug("shape inference for op %r failed: %s: %s",
+                     op.type, type(e).__name__, e)
+        return InferResult(error=str(e), error_type=type(e).__name__)
 
     result: Dict[str, Tuple[Tuple[int, ...], str]] = {}
     for slot, names in op.outputs.items():
@@ -79,5 +180,27 @@ def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc, lookup=None
         if vals is None:
             continue
         for n, a in zip(names, vals):
-            result[n] = (_from_abstract(a.shape), str(a.dtype))
-    return result
+            if not hasattr(a, "shape"):
+                # non-array output, e.g. a RowSparseGrad pytree from the
+                # sparse-embedding VJP: report the dense (densify())
+                # shape when derivable, else skip the output
+                values = getattr(a, "values", None)
+                height = getattr(a, "height", None)
+                if values is not None and height is not None:
+                    a_shape = (height,) + tuple(values.shape[1:])
+                    result[n] = (
+                        tuple(int(d) for d in a_shape) if raw_dims
+                        else _from_abstract(a_shape),
+                        str(values.dtype))
+                continue
+            result[n] = (tuple(int(d) for d in a.shape) if raw_dims
+                         else _from_abstract(a.shape), str(a.dtype))
+    return InferResult(outputs=result)
+
+
+def infer_op_outputs(block: ir.BlockDesc, op: ir.OpDesc, lookup=None
+                     ) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
+    """Back-compat wrapper: {output var name: (shape, dtype)} or None when
+    inference is not possible. Prefer :func:`abstract_eval_op`, which
+    distinguishes a benign skip from a genuine emitter failure."""
+    return abstract_eval_op(block, op, lookup=lookup).outputs

@@ -150,6 +150,14 @@ define("metrics_host", str, "127.0.0.1",
        "Interface the scrape endpoint binds. The loopback default is "
        "deliberate (the registry is unauthenticated); set 0.0.0.0 to "
        "expose it to an off-host Prometheus scraper.")
+define("verify_program", bool, False,
+       "Run the build-time program verifier (paddle_tpu.analysis) over "
+       "every program before lowering: ERROR-severity diagnostics "
+       "(dangling vars, shape/dtype drift, unknown ops, WAW hazards) "
+       "raise ProgramVerificationError at CompiledBlock build with op "
+       "provenance; warnings are counted in "
+       "paddle_analysis_diagnostics_total. Standalone linting: "
+       "tools/proglint.py; rule catalog: docs/static_analysis.md.")
 define("peak_flops", float, 0.0,
        "Override the peak-FLOP/s denominator of the MFU gauge "
        "(paddle_mfu_ratio). 0 (default) autodetects from the attached "

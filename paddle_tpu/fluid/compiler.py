@@ -37,6 +37,10 @@ class BuildStrategy:
     fuse_conv_ops: bool = False                  # conv epilogues → conv2d_fusion
     fuse_seq_ops: bool = False                   # seqpool/seqconv/seq_concat_fc/tfc
     fuse_rnn_ops: bool = False                   # fc_lstm/fc_gru/embedding_fc_lstm
+    # run the build-time program verifier (paddle_tpu.analysis) on this
+    # program at CompiledBlock build — the per-program opt-in to what
+    # FLAGS_verify_program enables process-wide (docs/static_analysis.md)
+    verify_program: bool = False
     debug_graphviz_path: str = ""
     # explicit pass pipeline prefix (PassBuilder escape hatch, reference
     # compiler.py BuildStrategy._create_passes_from_strategy)
@@ -144,6 +148,10 @@ class CompiledProgram:
         if bs is None or getattr(self, "_passes_applied", False):
             return
         self._passes_applied = True
+        if bs.verify_program:
+            # flag the desc so CompiledBlock verifies AFTER the pass
+            # pipeline mutates the program (verify what actually lowers)
+            self._program.desc._verify_requested = True
         names = bs.pass_names()
         if not names:
             return

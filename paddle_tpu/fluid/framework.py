@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from paddle_tpu.core import ir
-from paddle_tpu.core.shape_inference import infer_op_outputs
+from paddle_tpu.core.shape_inference import abstract_eval_op
 from paddle_tpu.fluid import unique_name
 
 
@@ -219,10 +219,14 @@ class Block:
         def lookup(name):
             return ir.find_var_recursive(self.program.desc, self.desc, name)
 
-        inferred = infer_op_outputs(self.desc, op_desc, lookup=lookup)
-        if not inferred:
+        # benign skips (control flow, concrete-value emitters) leave the
+        # declared shapes alone; genuine emitter failures are debug-logged
+        # by shape_inference and surface with provenance through
+        # Program.analyze() / FLAGS_verify_program (shape-infer-error)
+        res = abstract_eval_op(self.desc, op_desc, lookup=lookup)
+        if not res.ok or not res.outputs:
             return
-        for name, (shape, dtype) in inferred.items():
+        for name, (shape, dtype) in res.outputs.items():
             if self.desc.has_var(name):
                 vd = self.desc.var(name)
                 if vd.shape is None or tuple(vd.shape) != shape:
@@ -306,6 +310,17 @@ class Program:
 
     def all_parameters(self):
         return self.global_block().all_parameters()
+
+    def analyze(self, feed_names=None, fetch_names=None,
+                suppress=()):
+        """Run the build-time program verifier over this program and
+        return the diagnostics (errors first) — the interactive form of
+        ``FLAGS_verify_program`` / ``tools/proglint.py``
+        (docs/static_analysis.md)."""
+        from paddle_tpu import analysis
+        return analysis.analyze_program(
+            self, feed_names=feed_names, fetch_names=fetch_names,
+            is_test=self._is_test, suppress=suppress)
 
     def to_string(self, throw_on_error=False) -> str:
         import json

@@ -18,8 +18,20 @@ class ParallelExecutor:
                  main_program=None, share_vars_from=None,
                  exec_strategy: Optional[ExecutionStrategy] = None,
                  build_strategy: Optional[BuildStrategy] = None,
-                 num_trainers: int = 1, trainer_id: int = 0, scope=None):
+                 num_trainers: int = 1, trainer_id: int = 0, scope=None,
+                 verify_program: bool = False):
         self._program = main_program or framework.default_main_program()
+        if verify_program:
+            # per-executor opt-in to the build-time verifier
+            # (paddle_tpu.analysis) without flipping FLAGS_verify_program
+            # process-wide; the BuildStrategy carries it to CompiledBlock.
+            # Copy before mutating — a caller-shared strategy object must
+            # not leak verification into unrelated executors.
+            import dataclasses
+            build_strategy = (
+                dataclasses.replace(build_strategy, verify_program=True)
+                if build_strategy is not None
+                else BuildStrategy(verify_program=True))
         self._compiled = CompiledProgram(self._program).with_data_parallel(
             loss_name=loss_name, build_strategy=build_strategy,
             exec_strategy=exec_strategy)

@@ -194,6 +194,13 @@ def _preregister_catalog():
             importlib.import_module(mod)
         except Exception:     # a broken optional module must not kill
             pass              # telemetry for the rest
+    try:
+        # analyzer families (paddle_analysis_*) declare lazily per run;
+        # force them into the catalog so a scrape shows them at zero
+        from paddle_tpu.analysis import rules as _analysis_rules
+        _analysis_rules.declare_metrics()
+    except Exception:
+        pass
 
 
 def ensure_started() -> bool:

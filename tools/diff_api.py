@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.dataset",
     "paddle_tpu.reader",
     "paddle_tpu.contrib",
+    "paddle_tpu.analysis",
     "paddle_tpu.observability",
     "paddle_tpu.observability.metrics",
     "paddle_tpu.observability.tracing",

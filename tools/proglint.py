@@ -1,0 +1,166 @@
+"""proglint: static analysis CLI for paddle_tpu programs.
+
+Runs the build-time program verifier (paddle_tpu.analysis — structural
+IR invariants, whole-program shape/dtype checking, dataflow lint) over a
+program without executing it, and exits non-zero when ERROR-severity
+diagnostics are found (``--strict`` also fails on warnings).
+
+Program sources (pick one):
+
+    python tools/proglint.py path/to/saved_model_dir   # __model__.json
+    python tools/proglint.py path/to/__model__.json
+    python tools/proglint.py --model mnist             # zoo model (main
+                                                       # + startup)
+    python tools/proglint.py --module mypkg.net:build  # fn() builds the
+                                                       # default programs
+
+Useful flags: ``--feed a,b`` / ``--fetch x,y`` enable the
+liveness-dependent rules (dead-op, unfed-input), ``--is-test`` enables
+the RNG-determinism rule, ``--json`` emits machine-readable records,
+``--list-rules`` prints the catalog. Rule docs: docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _split(s):
+    return [x for x in (s or "").split(",") if x]
+
+
+def _load_saved(path):
+    """(name, desc, feed_names, fetch_names) from a save_inference_model
+    dir or its __model__.json."""
+    from paddle_tpu.core import ir
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__.json")
+    with open(path) as f:
+        payload = json.load(f)
+    desc = ir.ProgramDesc.parse_from_string(
+        json.dumps(payload["program"]).encode())
+    return (path, desc, payload.get("feed_names"),
+            payload.get("fetch_names"))
+
+
+def _build_zoo_model(name):
+    """[(label, program, feeds, fetches)] for main+startup of one zoo
+    model, built with its default small config."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    mod = getattr(models, name, None)
+    if mod is None or not hasattr(mod, "build"):
+        sys.exit(f"proglint: no such zoo model {name!r} (see "
+                 f"paddle_tpu/models/)")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        loss, fetches, feed_specs = mod.build()
+    fetch_names = [loss.name] + [getattr(f, "name", str(f))
+                                 for f in (fetches or [])]
+    return [(f"{name}:main", main, sorted(feed_specs), fetch_names),
+            (f"{name}:startup", startup, [], None)]
+
+
+def _build_module(spec):
+    import paddle_tpu.fluid as fluid
+    modname, _, fn_name = spec.partition(":")
+    fn = getattr(importlib.import_module(modname), fn_name or "build")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fn()
+    return [(f"{spec}:main", main, None, None),
+            (f"{spec}:startup", startup, [], None)]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", nargs="*",
+                    help="saved inference model dir(s) / __model__.json")
+    ap.add_argument("--model", action="append", default=[],
+                    help="zoo model name (paddle_tpu/models), repeatable")
+    ap.add_argument("--module", action="append", default=[],
+                    help="'pkg.mod:fn' building programs under "
+                         "program_guard, repeatable")
+    ap.add_argument("--feed", default="", help="comma-separated feed "
+                    "names (overrides the saved model's)")
+    ap.add_argument("--fetch", default="", help="comma-separated fetch "
+                    "names (enables dead-op/unfed-input)")
+    ap.add_argument("--is-test", action="store_true",
+                    help="treat the program as inference "
+                         "(rng-in-inference rule)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated rule ids to drop program-wide")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON record per diagnostic")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import analysis
+
+    if args.list_rules:
+        for rid, spec in sorted(analysis.all_rules().items()):
+            print(f"{rid:24s} {spec.severity!s:8s} [{spec.category}] "
+                  f"{spec.help}")
+        return 0
+
+    targets = []
+    for p in args.path:
+        name, desc, feeds, fetches = _load_saved(p)
+        targets.append((name, desc, feeds, fetches))
+    for m in args.model:
+        targets.extend(_build_zoo_model(m))
+    for m in args.module:
+        targets.extend(_build_module(m))
+    if not targets:
+        ap.error("nothing to lint: give a saved-model path, --model, "
+                 "or --module")
+
+    n_err = n_warn = 0
+    for name, program, feeds, fetches in targets:
+        if args.feed:
+            feeds = _split(args.feed)
+        if args.fetch:
+            fetches = _split(args.fetch)
+        try:
+            diags = analysis.analyze_program(
+                program, feed_names=feeds, fetch_names=fetches,
+                is_test=args.is_test,
+                rules=_split(args.rules) or None,
+                suppress=_split(args.suppress))
+        except ValueError as e:       # unknown --rules id: clean exit,
+            sys.exit(f"proglint: {e}")  # not a traceback
+        errs, warns, infos = analysis.partition(diags)
+        n_err += len(errs)
+        n_warn += len(warns)
+        if args.json:
+            for d in diags:
+                print(json.dumps({"program": name, **d.to_dict()},
+                                 sort_keys=True))
+        else:
+            status = ("FAIL" if errs else
+                      "warn" if warns else "ok")
+            print(f"[{status}] {name}: {len(errs)} error(s), "
+                  f"{len(warns)} warning(s), {len(infos)} info(s)")
+            for d in diags:
+                print("    " + d.format())
+    if n_err or (args.strict and n_warn):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,13 @@ hanging CI.
 
     python tools/test_runner.py --shards 4 --shard 1
     python tools/test_runner.py --only test_book test_models
+
+Shard 0 (and single-shard runs) first runs the static gates: `ruff
+check` over the codebase (skipped with a notice when ruff is not
+installed — the container image does not bake it in) and
+`tools/proglint.py` over the example programs (the model zoo), so a
+program-level regression fails CI before any test executes. `--no-lint`
+skips both gates.
 """
 
 from __future__ import annotations
@@ -16,13 +23,44 @@ from __future__ import annotations
 import argparse
 import glob
 import os
+import shutil
 import subprocess
 import sys
+
+# zoo models proglint verifies as the example-program gate (small/fast
+# builds; the full zoo is covered by tests/test_analysis.py)
+LINT_MODELS = ("mnist", "smallnet")
 
 
 def shard_files(all_files, shards, shard):
     return [f for i, f in enumerate(sorted(all_files))
             if i % shards == shard]
+
+
+def run_lint_gate(root: str, timeout: int) -> int:
+    """ruff over the repo (when installed) + proglint over the example
+    programs. Returns 0 when everything passes or is skipped."""
+    try:
+        if shutil.which("ruff"):
+            print("test_runner: lint gate — ruff check")
+            r = subprocess.run(["ruff", "check", "."], cwd=root,
+                               timeout=timeout)
+            if r.returncode:
+                return r.returncode
+        else:
+            print("test_runner: lint gate — ruff not installed, skipping "
+                  "(config: pyproject.toml [tool.ruff])")
+        print(f"test_runner: lint gate — proglint over example programs "
+              f"{list(LINT_MODELS)}")
+        cmd = [sys.executable, os.path.join(root, "tools", "proglint.py")]
+        for m in LINT_MODELS:
+            cmd += ["--model", m]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        r = subprocess.run(cmd, cwd=root, timeout=timeout, env=env)
+        return r.returncode
+    except subprocess.TimeoutExpired:
+        sys.exit(f"test_runner: lint gate exceeded {timeout}s")
 
 
 def main(argv=None):
@@ -33,12 +71,18 @@ def main(argv=None):
                     help="whole-shard timeout in seconds")
     ap.add_argument("--only", nargs="*", default=None,
                     help="test module names (without .py) to run instead")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the ruff + proglint static gates")
     args = ap.parse_args(argv)
     if not (0 <= args.shard < args.shards):
         ap.error(f"--shard must be in [0, {args.shards}) — got "
                  f"{args.shard} (shards are 0-based)")
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not args.no_lint and args.shard == 0:
+        rc = run_lint_gate(root, args.timeout)
+        if rc:
+            sys.exit(f"test_runner: lint gate failed (rc={rc})")
     tests_dir = os.path.join(root, "tests")
     if args.only:
         files = [os.path.join(tests_dir, f"{m}.py") for m in args.only]
