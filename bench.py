@@ -45,7 +45,7 @@ METRICS_SNAPSHOT_PATH = os.path.join(
 
 def _write_metrics_snapshot(model_name: str, kind: str, nsteps: int,
                             dt: float, examples_per_step, tokens_per_step,
-                            mfu, flops_per_step=None):
+                            mfu, flops_per_step=None, passes=None):
     """Observability satellite: publish the measured window into the
     runtime gauges (steps/s, examples/s, tokens/s, MFU) and merge the
     full registry dump into bench_metrics.json next to this script —
@@ -80,6 +80,10 @@ def _write_metrics_snapshot(model_name: str, kind: str, nsteps: int,
                 merged = json.load(f)
         except (OSError, ValueError):
             merged = {}
+        # which IR passes fired for this row, and whether the autotune
+        # cache served the build deterministically (hit/miss counters;
+        # zero measurements is the CI contract — passes/autotune.py)
+        from paddle_tpu.passes import autotune as _autotune
         merged[f"{model_name}-{kind}"] = {
             "steps_per_s": round(steps_per_s, 4),
             "examples_per_s": round(
@@ -87,6 +91,9 @@ def _write_metrics_snapshot(model_name: str, kind: str, nsteps: int,
             "tokens_per_s": round(
                 (tokens_per_step or 0) * steps_per_s, 2),
             "mfu": mfu,
+            "passes": list(passes or []),
+            "autotune_lookups": _autotune.lookup_counts(),
+            "autotune_measurements": _autotune.measurement_count(),
             "registry": obs_metrics.default_registry().snapshot(),
         }
         tmp = METRICS_SNAPSHOT_PATH + ".tmp"
@@ -185,9 +192,29 @@ def _device_batch(exe, feed_specs, batch_size, seed=0, int_ranges=None,
     return feeds
 
 
+def _apply_tpu_passes(program, model_name, batch_size, passes_spec,
+                      is_test, feed_names, fetch_names, scope=None):
+    """Apply the IR-pass pipeline to a bench program BEFORE the amp/nhwc
+    attr rewrites (so they tag the fused ops). `passes_spec` is None
+    (committed per-model winner from the autotune table, or the
+    defaults), "none" (control arm — zero passes), or a comma list of
+    explicit pass names. Returns the applied names; the rewritten
+    program was re-verified by paddle_tpu.analysis."""
+    if passes_spec == "none":
+        return []
+    from paddle_tpu import passes as tpu_passes
+    names = [p for p in passes_spec.split(",") if p] if passes_spec \
+        else None
+    return tpu_passes.apply_pipeline(
+        program, scope=scope, names=names,
+        model=None if names else model_name,
+        batch_size=batch_size, is_test=is_test,
+        feed_names=feed_names, fetch_names=fetch_names)
+
+
 def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
               amp: bool = False, mesh=None, nhwc: bool = True,
-              batch_merge: int = 0):
+              batch_merge: int = 0, passes_spec: str = None):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
 
@@ -250,6 +277,9 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
     main.random_seed = 1
     with fluid.program_guard(main, startup):
         loss, _, feed_specs = build_fn(is_train=True, **kw)
+        applied_passes = _apply_tpu_passes(
+            main, model_name, batch_size, passes_spec, is_test=False,
+            feed_names=sorted(feed_specs), fetch_names=[loss.name])
         if amp:
             from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
             rewrite_program_amp(main)
@@ -321,7 +351,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
     _write_metrics_snapshot(
         model_name, "train", nsteps, dt, batch_size,
         per_step if unit in ("tokens/sec", "words/sec") else None, mfu,
-        flops_per_step=flops_mod.program_flops(main, batch_size))
+        flops_per_step=flops_mod.program_flops(main, batch_size),
+        passes=applied_passes)
 
     return {
         "metric": f"{model_name} train throughput (bs{batch_size}"
@@ -333,6 +364,7 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
         "gflop_per_step": round(
             flops_mod.program_flops(main, batch_size) / 1e9, 1),
+        "passes": applied_passes,
     }
 
 
@@ -342,7 +374,8 @@ GOOGLENET_XEON_INFER_IMG_S = 600.94  # IntelOptimizedPaddle.md:91-98, bs16
 
 
 def run_infer_bench(model_name: str, batch_size: int, steps: int,
-                    warmup: int = 5, amp: bool = True, nhwc: bool = True):
+                    warmup: int = 5, amp: bool = True, nhwc: bool = True,
+                    passes_spec: str = None):
     """Inference throughput through the deployment path: build is_test
     graph -> save_inference_model -> AnalysisPredictor load (+BN-fold IR
     rewrite) -> timed forward passes (reference capability:
@@ -388,14 +421,17 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
         predictor = create_paddle_predictor(config)
 
     program = predictor._program
+    pexe, scope = predictor._exe, predictor._scope
+    fetch = predictor._fetch_names
+    applied_passes = _apply_tpu_passes(
+        program, model_name, batch_size, passes_spec, is_test=True,
+        feed_names=["data"], fetch_names=list(fetch), scope=scope)
     if amp:
         from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
         rewrite_program_amp(program)
     if nhwc:
         from paddle_tpu.contrib.layout import rewrite_program_nhwc
         rewrite_program_nhwc(program)
-    pexe, scope = predictor._exe, predictor._scope
-    fetch = predictor._fetch_names
 
     # DIFFERENT image batch per scan step, generated on device: a
     # stateless forward over a resident batch is loop-invariant — XLA
@@ -425,7 +461,8 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
     _write_metrics_snapshot(model_name, "infer", nsteps, dt, batch_size,
                             None, mfu,
                             flops_per_step=flops_mod.program_flops(
-                                program, batch_size))
+                                program, batch_size),
+                            passes=applied_passes)
     return {
         "metric": f"{model_name} infer throughput (bs{batch_size}"
                   f"{', amp-bf16' if amp else ''}, 1 chip)",
@@ -433,6 +470,7 @@ def run_infer_bench(model_name: str, batch_size: int, steps: int,
         "unit": "images/sec",
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
+        "passes": applied_passes,
     }
 
 
@@ -561,6 +599,14 @@ def main():
     ap.add_argument("--batch-merge", type=int, default=0,
                     help="k-step gradient accumulation (the reference's "
                          "multi_batch_merge_pass capability)")
+    ap.add_argument("--passes", default=None, metavar="P1,P2|none",
+                    help="IR-pass pipeline for the row: default is the "
+                         "committed autotune winner for the model (or "
+                         "the static pipeline); 'none' disables (the "
+                         "A/B control arm tools/autotune.py uses); a "
+                         "comma list applies exactly those passes")
+    ap.add_argument("--no-passes", dest="passes", action="store_const",
+                    const="none", help="alias for --passes none")
     ap.add_argument("--all", nargs="?", const="", default=None,
                     metavar="M1,M2",
                     help="sweep every model (or a comma list) printing one "
@@ -609,6 +655,8 @@ def main():
             cmd.append("--no-amp")
         if not args.nhwc:
             cmd.append("--no-nhwc")
+        if args.passes:
+            cmd += ["--passes", args.passes]
         if infer:
             cmd.append("--infer")
         if coldstart:
@@ -751,11 +799,12 @@ def main():
                      f"{args.model!r} has no deployment-path benchmark")
         bs = args.batch_size or infer_bs[args.model]
         result = run_infer_bench(args.model, bs, args.steps, amp=args.amp,
-                                 nhwc=args.nhwc)
+                                 nhwc=args.nhwc, passes_spec=args.passes)
     else:
         bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
         result = run_bench(args.model, bs, args.steps, amp=args.amp,
-                           nhwc=args.nhwc, batch_merge=args.batch_merge)
+                           nhwc=args.nhwc, batch_merge=args.batch_merge,
+                           passes_spec=args.passes)
     print(json.dumps(result))
 
 

@@ -25,6 +25,7 @@ from collections import defaultdict
 CONVERT_SLOTS = {
     "conv2d": ("Input", "Output"),
     "depthwise_conv2d": ("Input", "Output"),
+    "conv2d_fusion": ("Input", "Output"),   # paddle_tpu/passes fusion
     "pool2d": ("X", "Out"),
     "batch_norm": ("X", "Y"),
 }
@@ -210,6 +211,15 @@ def rewrite_program_nhwc(program=None):
                 tags[oi] = {"__nhwc__": True,
                             "__nhwc_in_ready__": in_ready,
                             "__nhwc_out_keep__": out_keep}
+            if t == "conv2d_fusion":
+                # the residual operand's own residency is independent of
+                # the op's data slot — record it so the emitter knows
+                # which transpose (if any) the region edge needs
+                resid = (op.inputs.get("ResidualData") or [None])[0]
+                if resid is not None and (nhwc.get(resid)
+                                          or oi in tags):
+                    tags.setdefault(oi, {})["__nhwc_resid_ready__"] = \
+                        bool(nhwc.get(resid))
         elif t in ELEMENTWISE:
             x = (op.inputs.get("X") or [None])[0]
             kind = _op_bcast_kind(op, _var)
@@ -238,11 +248,19 @@ def rewrite_program_nhwc(program=None):
     for n, resident in nhwc.items():
         if resident:
             blk.var(n).attrs["__nhwc__"] = True
-    # mirror into backward snapshots (grad_ops.py __vjp__ re-trace)
+    # mirror into backward snapshots (grad_ops.py __vjp__ re-trace).
+    # Match by the shared snapshot identity (type, sorted outputs) —
+    # NOT by fwd_op_index: a pass pipeline that ran before this rewrite
+    # (paddle_tpu/passes) renumbers ops, so the snapshot index no
+    # longer addresses the forward op it was taken from.
+    from paddle_tpu.fluid.ir_pass import vjp_snapshot_key
+    snap_tags = {vjp_snapshot_key(ops[oi].type, ops[oi].outputs): t_attrs
+                 for oi, t_attrs in tags.items()}
     for op in ops:
         if op.type == "__vjp__":
-            fi = op.attrs.get("fwd_op_index")
-            if fi in tags:
-                op.attrs["fwd_op"].setdefault("attrs", {}).update(tags[fi])
+            snap = op.attrs.get("fwd_op", {})
+            key = vjp_snapshot_key(snap.get("type"), snap.get("outputs"))
+            if key in snap_tags:
+                snap.setdefault("attrs", {}).update(snap_tags[key])
     program.desc.bump_version()
     return n_tagged

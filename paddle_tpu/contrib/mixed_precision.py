@@ -160,9 +160,9 @@ def _ge(a, b):
     return layers.greater_equal(a, b)
 
 
-AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv3d", "mul", "matmul",
-                "conv2d_transpose", "fc", "fused_linear_ce",
-                "fused_attention_block")
+AMP_OP_TYPES = ("conv2d", "depthwise_conv2d", "conv2d_fusion", "conv3d",
+                "mul", "matmul", "conv2d_transpose", "fc",
+                "fused_linear_ce", "fused_attention_block")
 
 
 RECURRENT_OPS = ("dynamic_lstm", "dynamic_gru", "dynamic_lstmp", "while",

@@ -113,9 +113,16 @@ def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
         op = block.ops[i]
         spec = get_op(op.type)
         # salt rng per (block, op) so sub-block ops never collide with
-        # parent-block ops at the same index
+        # parent-block ops at the same index. Ops carry a pinned
+        # `__op_index__` once an IR pass has rewritten the block
+        # (paddle_tpu/passes pin_op_indices): random ops keep their
+        # pre-rewrite salt, so a pass that removes ops does not shift
+        # every later dropout's mask — rewrites preserve the random
+        # stream, which is what makes pass/no-pass parity testable
+        op_salt = op.attrs.get("__op_index__", i)
         ctx = EmitContext(base_key=base_key, step_base_key=step_base,
-                          op_index=block.idx * 100_000 + i, is_test=is_test,
+                          op_index=block.idx * 100_000 + op_salt,
+                          is_test=is_test,
                           program=program, dist=dist, op=op)
         ins = {}
         for slot, names in op.inputs.items():
@@ -247,6 +254,18 @@ class CompiledBlock:
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block
         self.dist = dist
+        # resolve every tunable region's autotune-cache lookup at BUILD
+        # time: deterministic (committed table only — zero timing
+        # measurements on this path, enforced by autotune.measure_ms's
+        # forbid guard) and recorded in the hit/miss counters so CI can
+        # assert the executable's selection never depended on a
+        # measurement (paddle_tpu/passes/autotune.py)
+        try:
+            from paddle_tpu.passes import autotune as _autotune
+            self.autotune_lookups = _autotune.note_block_build(program,
+                                                               block)
+        except Exception:
+            self.autotune_lookups = {"hit": 0, "miss": 0}
         fn = build_block_fn(program, block_idx, self.sig, is_test=is_test,
                             dist=dist)
         jit_kwargs = {}

@@ -37,6 +37,13 @@ class BuildStrategy:
     fuse_conv_ops: bool = False                  # conv epilogues → conv2d_fusion
     fuse_seq_ops: bool = False                   # seqpool/seqconv/seq_concat_fc/tfc
     fuse_rnn_ops: bool = False                   # fc_lstm/fc_gru/embedding_fc_lstm
+    # TPU-semantic pipeline (paddle_tpu/passes): grad-aware conv-region
+    # fusion with vjp merge, reshape/transpose chain canonicalization,
+    # and the inference-only conv+BN statistics fold — the rewritten
+    # program is re-verified by paddle_tpu.analysis post-pass
+    fuse_conv_blocks: bool = False               # grad-aware, vjp merge
+    canonicalize_layouts: bool = False           # grad-aware chain compose
+    fold_conv_bn: bool = False                   # inference-only, needs scope
     # run the build-time program verifier (paddle_tpu.analysis) on this
     # program at CompiledBlock build — the per-program opt-in to what
     # FLAGS_verify_program enables process-wide (docs/static_analysis.md)
@@ -46,10 +53,33 @@ class BuildStrategy:
     # compiler.py BuildStrategy._create_passes_from_strategy)
     ir_passes: List[str] = field(default_factory=list)
 
+    @classmethod
+    def tuned(cls, model: str = None, batch_size: int = None,
+              is_test: bool = False, verify_program: bool = True):
+        """The measured-default strategy: pass selection comes from the
+        committed autotune table (paddle_tpu/passes pipeline_for — the
+        per-model winner when one is committed, the static default
+        otherwise), with post-pass verification on."""
+        from paddle_tpu import passes as tpu_passes
+        tpu_passes.register_all()
+        return cls(ir_passes=tpu_passes.pipeline_for(
+            is_test=is_test, model=model, batch_size=batch_size),
+            verify_program=verify_program)
+
     def pass_names(self) -> List[str]:
         names = list(self.ir_passes)
         if self.fuse_elewise_add_act_ops:
             names.append("fuse_elewise_add_act_pass")
+        # TPU-semantic pipeline (paddle_tpu/passes): region fusion first
+        # (absorbs the conv's separate bias add), then the BN fold
+        # (handles conv2d_fusion heads, absorbs the trailing act), then
+        # layout canonicalization over whatever chains remain
+        if self.fuse_conv_blocks:
+            names.append("conv_block_fuse_pass")
+        if self.fold_conv_bn:
+            names.append("conv_bn_fold_pass")
+        if self.canonicalize_layouts:
+            names.append("layout_assignment_pass")
         # rnn/seq fusions must run BEFORE fc_fuse: their patterns start at
         # the mul+add gate projection that fc_fuse would consume
         # (reference pipeline keeps the same order for the same reason)
@@ -155,10 +185,14 @@ class CompiledProgram:
         names = bs.pass_names()
         if not names:
             return
+        from paddle_tpu import passes as tpu_passes
+        tpu_passes.register_all()
         from paddle_tpu.fluid import ir_pass as irp
         block = self._program.desc.global_block
+        tpu_passes.pin_op_indices(block)   # rewrites keep the rng stream
         has_vjp = any(op.type == "__vjp__" for op in block.ops)
         applied = []
+        tpu_semantic = set(tpu_passes.register_all())
         for name in names:
             p = irp.get_pass(name)
             if has_vjp and not getattr(p, "grad_aware", False):
@@ -169,11 +203,17 @@ class CompiledProgram:
                     f"before minimize(), or to the inference program.",
                     stacklevel=3)
                 continue
-            p.scope = scope
+            if getattr(p, "inference_only", False) and scope is None:
+                continue    # statistics fold without materialized params
             if name == "graph_viz_pass":
                 p.path = bs.debug_graphviz_path or None
-            p(irp.Graph(block))
+            tpu_passes.run_pass(p, name, block, scope=scope)
             applied.append(name)
         if applied:
             self._program.desc.bump_version()
+            if tpu_semantic & set(applied):
+                # every TPU-semantic rewrite is re-verified by the
+                # build-time program verifier before lowering — a pass
+                # bug surfaces as a named diagnostic, not wrong training
+                self._program.desc._verify_requested = True
         return applied

@@ -491,6 +491,33 @@ def _first_out(op):
     return None
 
 
+def vjp_snapshot_key(op_type, outputs):
+    """THE identity rule pairing a forward op with its `__vjp__`
+    backward snapshot: (type, sorted outputs). Output var names are
+    unique in a block, so this survives op reordering/renumbering —
+    unlike `fwd_op_index`, which goes stale the moment a pass mutates
+    the op list. Shared by every grad-aware pass and the contrib.layout
+    backward-snapshot mirror; keep it the single copy."""
+    return (op_type, tuple(sorted((s, tuple(n)) for s, n in
+                                  (outputs or {}).items())))
+
+
+def vjp_index(graph: "Graph"):
+    """{vjp_snapshot_key(fwd): __vjp__ OpDesc} over a graph."""
+    vjps = {}
+    for node in graph.op_nodes:
+        if node.op.type == "__vjp__":
+            snap = node.op.attrs.get("fwd_op", {})
+            vjps[vjp_snapshot_key(snap.get("type"),
+                                  snap.get("outputs"))] = node.op
+    return vjps
+
+
+def vjp_of(vjps, op):
+    """The __vjp__ op paired with forward `op`, or None."""
+    return vjps.get(vjp_snapshot_key(op.type, op.outputs))
+
+
 _CONV_ACTS = ("relu", "sigmoid", "tanh")
 
 

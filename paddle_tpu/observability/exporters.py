@@ -201,6 +201,15 @@ def _preregister_catalog():
         _analysis_rules.declare_metrics()
     except Exception:
         pass
+    try:
+        # pass-pipeline + autotune-cache families (paddle_pass_*,
+        # paddle_autotune_*): applied/rewrites/duration per pass, cache
+        # hit/miss per region kind, and the measurement counter whose
+        # zero-ness IS the CI determinism contract
+        from paddle_tpu import passes as _tpu_passes
+        _tpu_passes.declare_metrics()
+    except Exception:
+        pass
 
 
 def ensure_started() -> bool:

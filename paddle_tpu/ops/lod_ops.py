@@ -289,15 +289,28 @@ def _fusion_tfc(ctx, ins, attrs):
 
 @register_op("conv2d_fusion", ref="operators/fused/conv_fusion_op.cc")
 def _conv2d_fusion(ctx, ins, attrs):
-    """conv2d + bias + activation (+ residual add) — XLA fuses the epilogue
-    into the conv anyway; registered for program parity."""
-    conv = get_op("conv2d").emit(ctx, ins, attrs)["Output"][0]
+    """conv2d + bias + activation (+ residual add) as ONE emitted region.
+    NHWC-aware (contrib.layout tags it like a bare conv2d): the whole
+    epilogue runs channels-last inside the region and transposes only at
+    the region edge; `__nhwc_resid_ready__` records the residual graph
+    var's own physical residency, which is independent of the op's."""
+    nhwc = bool(attrs.get("__nhwc__"))
+    sub = dict(attrs)
+    if nhwc:
+        sub["__nhwc_out_keep__"] = True      # epilogue runs channels-last
+    conv = get_op("conv2d").emit(ctx, ins, sub)["Output"][0]
     bias = first(ins, "Bias")
     if bias is not None:
-        conv = conv + bias.reshape(1, -1, 1, 1)
+        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        conv = conv + bias.reshape(bshape).astype(conv.dtype)
     resid = first(ins, "ResidualData")
     if resid is not None:
-        conv = conv + resid
+        resid_nhwc = bool(attrs.get("__nhwc_resid_ready__"))
+        if nhwc and not resid_nhwc:
+            resid = jnp.transpose(resid, (0, 2, 3, 1))
+        elif not nhwc and resid_nhwc:
+            resid = jnp.transpose(resid, (0, 3, 1, 2))
+        conv = conv + resid.astype(conv.dtype)
     act = attrs.get("activation", "relu")
     if act == "relu":
         conv = jnp.maximum(conv, 0.0)
@@ -307,6 +320,8 @@ def _conv2d_fusion(ctx, ins, attrs):
         conv = jax.nn.sigmoid(conv)
     elif act == "tanh":
         conv = jnp.tanh(conv)
+    if nhwc and not attrs.get("__nhwc_out_keep__"):
+        conv = jnp.transpose(conv, (0, 3, 1, 2))
     return {"Output": [conv]}
 
 

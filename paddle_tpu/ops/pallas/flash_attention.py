@@ -225,40 +225,42 @@ def pick_blocks(tq, tk):
 # Benchmark-derived kernel selection (round-4 VERDICT #4 — the
 # reference's jit-tier discipline: kernel_pool.cc Get() picks whichever
 # implementation won its own benchmark, not a hand threshold).
-# Produced by tools/flash_autotune.py on v5e (2026-08-01): fwd+bwd of
-# the attention REGION at 8192 tokens, (bq, bk) grid vs the XLA
-# fused-dot composition. (T, d_head, causal) -> best (bq, bk), or None
-# where XLA's composition won the region. Model-level verification of
-# the crossover: transformer_big (T=512, d=128, 6 enc + 12 dec regions)
-# moved 73.2k -> 77.1k tok/s (42.8 -> 45.1% MFU) when this table routed
-# it to flash; r04 had measured the OPPOSITE with the then-kernels
-# (133 vs 123 ms/step) — the hash-mask dropout + tuned blocks flipped
-# it, which is exactly why the rule must be a measured table.
-AUTOTUNE = {
-    # region fwd + full dq/dk/dv bwd, flash_ms vs xla_ms. Where a FULL
-    # MODEL row exists, its A/B overrides the region sweep (isolated
-    # regions mispredict block choice under real co-residency: the
-    # region-optimal (256,512) at 512/128/causal measured 76.5k tok/s
-    # on transformer_big vs 77.1k with (512,512); region-optimal
-    # (256,1024) at 2048/128 measured 186.2k on transformer_long vs
-    # 195.0k with the entries below) — entries marked MODEL.
-    (256, 64, False): (256, 256),    # 5.39 vs 6.03
-    (256, 64, True): None,           # 5.36 vs 5.06 — XLA wins
-    (256, 128, False): (256, 128),   # 4.40 vs 5.20
-    (256, 128, True): (256, 256),    # 5.72 vs 6.24
-    (512, 64, False): (256, 512),    # 5.87 vs 7.39
-    (512, 64, True): (256, 512),     # 5.81 vs 6.09
-    (512, 128, False): (512, 512),   # 5.76 vs 6.06
-    (512, 128, True): (512, 512),    # MODEL: transformer_big 77.1k
-    (1024, 64, False): (512, 1024),  # 6.08 vs 7.63
-    (1024, 64, True): (512, 1024),   # 6.05 vs 7.57
-    (1024, 128, False): (512, 1024),  # 6.00 vs 7.52
-    (1024, 128, True): (512, 1024),  # 6.06 vs 7.53
-    (2048, 64, False): (512, 1024),  # 6.98 vs 10.05
-    (2048, 64, True): (512, 1024),   # 6.58 vs 9.88
-    (2048, 128, False): (512, 1024),  # MODEL: transformer_long 195.0k
-    (2048, 128, True): (512, 512),   # MODEL: transformer_long 195.0k
-}
+# Round 6 moved the winner data out of this file into the UNIFIED
+# autotune cache (paddle_tpu/passes/autotune_table.json, v5e sweep of
+# 2026-08-01: fwd+bwd of the attention REGION at 8192 tokens, (bq, bk)
+# grid vs the XLA fused-dot composition) — ONE committed-table
+# discipline for every measured choice, re-tuned with
+# `tools/autotune.py --kind flash_attention --commit`. Where a FULL
+# MODEL row exists, its A/B overrides the region sweep (isolated
+# regions mispredict block choice under real co-residency; entries
+# marked source="model-ab" in the table). Model-level verification of
+# the T=512 crossover: transformer_big moved 73.2k -> 77.1k tok/s
+# (42.8 -> 45.1% MFU) when this table routed it to flash; r04 had
+# measured the OPPOSITE with the then-kernels — which is exactly why
+# the rule must be a measured table, not a hand threshold.
+
+
+def _autotune_table():
+    """{(T, d, causal): (bq, bk) | None} from the committed unified
+    table — the same lookup path every tuned region uses. An absent or
+    unreadable table yields {} and flash_engage falls back to the
+    long-context heuristics (pick_blocks)."""
+    try:
+        from paddle_tpu.passes import autotune as at
+        out = {}
+        for key, entry in at.load_table().get("entries", {}).items():
+            if not key.startswith("flash_attention|"):
+                continue
+            params = dict(kv.split("=", 1) for kv in key.split("|")[1:])
+            k = (int(params["T"]), int(params["d"]),
+                 bool(int(params["causal"])))
+            if entry.get("impl") == "flash":
+                out[k] = (int(entry["bq"]), int(entry["bk"]))
+            else:
+                out[k] = None          # XLA composition won the region
+        return out
+    except Exception:
+        return {}
 
 
 def flash_engage(tq, tk, d, causal):
@@ -293,12 +295,23 @@ def flash_engage(tq, tk, d, causal):
     # the row below the 512 crossover
     if tq < 512:
         return None
-    key = (tq, d, causal)
-    if key in AUTOTUNE:
-        blocks = AUTOTUNE[key]
-        if blocks is None:
-            return None
-        return _valid(blocks) or _valid(pick_blocks(tq, tk))
+    entry = None
+    try:
+        from paddle_tpu.passes import autotune as at
+        entry = at.lookup("flash_attention",
+                          at.flash_params(tq, d, causal))
+        # the committed keys are exact sweep-grid Ts: only honor a
+        # bucketed hit when the bucket IS the shape (blocks tuned at
+        # T=512 do not transfer to T=640 — fall to pick_blocks there)
+        if entry is not None and at.bucket_pow2(tq) != tq:
+            entry = None
+    except Exception:
+        entry = None
+    if entry is not None:
+        if entry.get("impl") != "flash":
+            return None               # XLA composition won the region
+        return _valid((int(entry["bq"]), int(entry["bk"]))) \
+            or _valid(pick_blocks(tq, tk))
     if tq >= 2048:                    # beyond the sweep grid
         return _valid(pick_blocks(tq, tk))
     return None

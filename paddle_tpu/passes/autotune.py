@@ -1,0 +1,315 @@
+"""Persistent autotuning cache over the verified IR.
+
+Generalizes ``tools/flash_autotune.py``'s committed-table discipline
+(the reference's jit-tier benchmark selection, operators/jit/
+kernel_pool.cc) into ONE versioned, in-repo JSON table that every
+measured choice in the framework reads through the same lookup path:
+
+- candidate lowering variants (pass on/off, kernel choice, block sizes,
+  layout) are keyed by an **op-region fingerprint** (kind + normalized
+  params) and a **shape bucket** (power-of-two bucketing for free
+  dims, exact values for tiled dims);
+- winners are measured OFFLINE by ``tools/autotune.py`` on an idle chip
+  and committed to ``paddle_tpu/passes/autotune_table.json``;
+- build paths (``CompiledBlock``, ``flash_engage``, ``bench.py``) only
+  ever LOOK UP — with the committed table present, building a program
+  performs **zero timing measurements**, so CI and production builds
+  are deterministic. The invariant is enforced, not promised:
+  :func:`measure_ms` is the single timing entry point, it counts into
+  ``paddle_autotune_measurements_total``, and under
+  :func:`forbid_measurement` it raises.
+
+Table format (``version`` gates compatibility — a reader refuses a
+table from a different major scheme instead of misreading it)::
+
+    {"version": 1, "device": "v5e", "tuned_at": "2026-08-01",
+     "entries": {
+       "flash_attention|T=512|causal=1|d=128":
+           {"impl": "flash", "bq": 512, "bk": 512,
+            "flash_ms": 5.76, "xla_ms": 6.06, "source": "model-ab"},
+       "pass_pipeline|bs=128|model=resnet50":
+           {"passes": ["layout_assignment_pass",
+                       "conv_block_fuse_pass"]},
+     }}
+
+Re-tuning on new hardware: run ``tools/autotune.py --kind <kind>
+--commit`` on an idle chip; the CLI rewrites only its kind's entries
+and stamps ``device``/``tuned_at`` (docs/performance.md, "Pass
+pipeline & autotune cache").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+TABLE_VERSION = 1
+DEFAULT_TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "autotune_table.json")
+
+_lock = threading.Lock()
+_cache: Dict[str, Dict[str, Any]] = {}     # path -> parsed table
+_warned_paths: set = set()
+
+# measurement discipline: >0 means measure_ms raises (CI determinism)
+_forbid_depth = 0
+
+
+class MeasurementForbiddenError(RuntimeError):
+    """A build path attempted a timing measurement while measurement was
+    forbidden (the committed-table CI invariant)."""
+
+
+def declare_metrics():
+    """Get-or-create the autotune metric families (also called from the
+    exporter catalog preregistration so a scrape shows them at zero)."""
+    from paddle_tpu.observability import metrics as obs_metrics
+    lookups = obs_metrics.counter(
+        "paddle_autotune_lookup_total",
+        "autotune-cache lookups at build/emit time, per region kind and "
+        "hit/miss", ("kind", "result"))
+    measures = obs_metrics.counter(
+        "paddle_autotune_measurements_total",
+        "offline timing measurements taken by tools/autotune.py; MUST "
+        "stay zero in any CI/build path with the committed table present")
+    return lookups, measures
+
+
+def _bump_lookup(kind: str, hit: bool):
+    try:
+        lookups, _ = declare_metrics()
+        lookups.labels(kind=kind, result="hit" if hit else "miss").inc()
+    except Exception:
+        pass                     # telemetry must never fail a build
+
+
+def lookup_counts(kind: Optional[str] = None) -> Dict[str, float]:
+    """{'hit': n, 'miss': n} for one kind (or summed over all kinds) —
+    the test/bench hook behind 'cache hit/miss counters confirm it'."""
+    from paddle_tpu.observability import metrics as obs_metrics
+    out = {"hit": 0.0, "miss": 0.0}
+    snap = obs_metrics.default_registry().snapshot()
+    fam = snap.get("paddle_autotune_lookup_total", {})
+    for sample in fam.get("samples", []):
+        labels = sample.get("labels", {})
+        if kind is not None and labels.get("kind") != kind:
+            continue
+        out[labels.get("result", "miss")] += sample.get("value", 0.0)
+    return out
+
+
+def measurement_count() -> float:
+    from paddle_tpu.observability import metrics as obs_metrics
+    snap = obs_metrics.default_registry().snapshot()
+    fam = snap.get("paddle_autotune_measurements_total", {})
+    return float(sum(s.get("value", 0.0) for s in fam.get("samples", [])))
+
+
+# ---------------------------------------------------------------- keying
+
+def _norm(v) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+def fingerprint(kind: str, params: Dict[str, Any]) -> str:
+    """Canonical region key: ``kind|k=v|...`` with sorted param names and
+    normalized values (bools as 0/1) — the one spelling writers and
+    readers share, so a table round-trip can never miss its own key."""
+    parts = [kind] + [f"{k}={_norm(v)}" for k, v in sorted(params.items())]
+    return "|".join(parts)
+
+
+def bucket_pow2(n: int, lo: int = 1, hi: int = 1 << 30) -> int:
+    """Largest power of two <= n, clamped to [lo, hi] — the shape-bucket
+    primitive: two batch sizes in the same bucket share a winner, so the
+    table stays small and a near-miss shape still hits."""
+    n = max(int(n), 1)
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    return max(lo, min(b, hi))
+
+
+def shape_bucket(shape) -> tuple:
+    """Per-dim pow2 bucket of a concrete shape (dynamic -1 dims pass
+    through as -1: the sentinel is already a bucket of one)."""
+    return tuple(d if d == -1 else bucket_pow2(d) for d in shape)
+
+
+# ----------------------------------------------------------------- table
+
+def load_table(path: Optional[str] = None,
+               refresh: bool = False) -> Dict[str, Any]:
+    """Parsed committed table (cached per path). An unreadable or
+    version-mismatched table returns an EMPTY table (with a one-shot
+    warning) — every consumer has a non-measured fallback, so a corrupt
+    table degrades selection quality, never correctness."""
+    path = path or DEFAULT_TABLE_PATH
+    with _lock:
+        if not refresh and path in _cache:
+            return _cache[path]
+        table = {"version": TABLE_VERSION, "entries": {}}
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+            if int(raw.get("version", -1)) != TABLE_VERSION:
+                raise ValueError(
+                    f"autotune table version {raw.get('version')!r} != "
+                    f"reader version {TABLE_VERSION}")
+            if not isinstance(raw.get("entries"), dict):
+                raise ValueError("autotune table has no 'entries' dict")
+            table = raw
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as e:
+            if path not in _warned_paths:
+                _warned_paths.add(path)
+                import warnings
+                warnings.warn(f"autotune table {path!r} unusable "
+                              f"({e}); falling back to heuristics")
+        _cache[path] = table
+        return table
+
+
+def table_present(path: Optional[str] = None) -> bool:
+    return bool(load_table(path).get("entries"))
+
+
+def lookup(kind: str, params: Dict[str, Any],
+           path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Committed winner for one region, or None. Deterministic and
+    measurement-free by construction; every call lands in
+    ``paddle_autotune_lookup_total{kind,result}``."""
+    entry = load_table(path).get("entries", {}).get(
+        fingerprint(kind, params))
+    _bump_lookup(kind, entry is not None)
+    return entry
+
+
+def record(table: Dict[str, Any], kind: str, params: Dict[str, Any],
+           entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Write one winner into an in-memory table (tools/autotune.py)."""
+    table.setdefault("version", TABLE_VERSION)
+    table.setdefault("entries", {})[fingerprint(kind, params)] = entry
+    return table
+
+
+def save_table(table: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Atomically commit a table (tmp + rename) and refresh the reader
+    cache so the writing process immediately sees its own commit."""
+    path = path or DEFAULT_TABLE_PATH
+    table.setdefault("version", TABLE_VERSION)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    load_table(path, refresh=True)
+    return path
+
+
+# -------------------------------------------------- measurement discipline
+
+@contextmanager
+def forbid_measurement():
+    """Scope in which any :func:`measure_ms` call raises — wrapped around
+    CI builds (tools/test_runner.py smoke, tools/proglint.py --passes)
+    to ENFORCE 'zero measurement with the committed table present'."""
+    global _forbid_depth
+    with _lock:
+        _forbid_depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _forbid_depth -= 1
+
+
+def measurement_forbidden() -> bool:
+    return _forbid_depth > 0
+
+
+def measure_ms(fn, *args, iters: int = 20, warmup: int = 2,
+               fence=None) -> float:
+    """The single timing entry point for autotune sweeps: fenced warmups
+    (compile + layout specialization), `iters` timed calls, one closing
+    fence. Counts into paddle_autotune_measurements_total and raises
+    under :func:`forbid_measurement` — build paths must never reach it."""
+    if measurement_forbidden():
+        raise MeasurementForbiddenError(
+            "autotune measurement attempted in a measurement-forbidden "
+            "scope (a build/CI path must only LOOK UP the committed "
+            "table; run tools/autotune.py offline to re-tune)")
+    try:
+        _, measures = declare_metrics()
+        measures.inc()
+    except Exception:
+        pass
+    import numpy as np
+    if fence is None:
+        def fence(h):
+            return np.asarray(h)
+    for _ in range(max(2, warmup)):
+        fence(fn(*args))
+    t0 = time.time()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    fence(out)
+    return (time.time() - t0) / iters * 1000.0
+
+
+# ------------------------------------------------------- build-time hook
+
+# op types whose emit-time selection reads the cache: CompiledBlock
+# resolves their lookups at BUILD time so the hit/miss counters record
+# the executable's selection determinism before any trace runs
+TUNABLE_OPS = ("fused_attention_block",)
+
+
+def flash_params(t_q: int, d: int, causal) -> Dict[str, Any]:
+    """The flash-attention region key: T exact over the sweep grid's
+    bucket set (tiling makes T a tiled dim, not a free one), head dim
+    exact, causal as 0/1."""
+    return {"T": bucket_pow2(t_q, lo=1, hi=4096), "d": int(d),
+            "causal": int(bool(causal))}
+
+
+def note_block_build(program, block) -> Dict[str, int]:
+    """CompiledBlock build hook: resolve every tunable region's cache
+    lookup now, deterministically (no measurement, no trace). Returns
+    {'hit': n, 'miss': n} for the block; counters carry the same."""
+    hits = misses = 0
+    for op in getattr(block, "ops", []):
+        if op.type not in TUNABLE_OPS:
+            continue
+        try:
+            xq = (op.inputs.get("X") or op.inputs.get("Q") or [None])[0]
+            v = block.var(xq) if xq and block.has_var(xq) else None
+            shape = list(v.shape or []) if v is not None else []
+            t_q = int(shape[1]) if len(shape) >= 2 and shape[1] \
+                and shape[1] > 0 else 0
+            d_model = int(shape[-1]) if shape and shape[-1] \
+                and shape[-1] > 0 else 0
+            n_head = int(op.attrs.get("n_head", 1) or 1)
+            d = d_model // n_head if n_head else 0
+            if t_q <= 0 or d <= 0:
+                continue
+            entry = lookup("flash_attention",
+                           flash_params(t_q, d, op.attrs.get("causal",
+                                                             False)))
+            if entry is None:
+                misses += 1
+            else:
+                hits += 1
+        except Exception:
+            continue             # a malformed region must not fail build
+    return {"hit": hits, "miss": misses}

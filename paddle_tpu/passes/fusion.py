@@ -1,0 +1,281 @@
+"""TPU-semantic fusion passes over the verified ProgramDesc.
+
+Registered into the ``fluid/ir_pass.py`` registry (same ``Pass`` /
+``register_pass`` machinery, same live-block Graph view) and driven
+through the ``BuildStrategy`` hook in ``fluid/compiler.py`` or the
+pipeline driver in :mod:`paddle_tpu.passes`. Two passes:
+
+- :class:`ConvBnFoldPass` (``conv_bn_fold_pass``, **inference-only**):
+  conv2d [+ bias add] + batch_norm → ONE ``conv2d_fusion`` op with the
+  trained BN statistics folded numerically into filter + bias — the
+  semantic rewrite XLA cannot do (it needs the scope's trained values),
+  producing a single XLA-friendly region where the inference
+  transpiler's fold used to leave a scale/shift tail.
+- :class:`ConvBlockFusePass` (``conv_block_fuse_pass``, **grad-aware**):
+  conv2d + elementwise_add(channel bias) [+ residual add] [+ act] and
+  conv2d + act → ``conv2d_fusion``, with the member ops' ``__vjp__``
+  backward ops merged into ONE ``__vjp__`` over the fused op (the
+  re-trace derives the fused backward automatically, the same
+  discipline as ``fuse_elewise_add_act_pass``). The lowering emits the
+  whole epilogue as one region, and AMP/NHWC rewrites tag the fused op
+  exactly like a bare conv2d (contrib.mixed_precision AMP_OP_TYPES,
+  contrib.layout CONVERT_SLOTS).
+
+Every rewritten program is re-verified by ``paddle_tpu.analysis``
+post-pass (the pipeline driver enforces it; docs/performance.md).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core import ir
+from paddle_tpu.fluid.ir_pass import (Graph, Pass, PatternDetector,
+                                      _alive, _bias_like, _first_out,
+                                      register_pass, vjp_index, vjp_of)
+
+_ACTS = ("relu", "sigmoid", "tanh")
+
+
+def _flat_sorted(inputs):
+    """Flat input names in the sorted-slot order grad_ops._slot_layout
+    uses — the order in_grad_mask is spelled in."""
+    return [n for slot in sorted(inputs) for n in inputs[slot]]
+
+
+def _grad_parts(vjp):
+    """{flat fwd input name: its grad var name} for one __vjp__ op."""
+    snap = vjp.attrs.get("fwd_op", {})
+    flat_in = [n for slot in sorted(snap.get("inputs", {}))
+               for n in snap["inputs"][slot]]
+    mask = vjp.attrs.get("in_grad_mask", [])
+    grads = list(vjp.outputs.get("InGrad", []))
+    out, gi = {}, 0
+    for name, m in zip(flat_in, mask):
+        if m:
+            out[name] = grads[gi]
+            gi += 1
+    return out
+
+
+@register_pass("conv_block_fuse_pass")
+class ConvBlockFusePass(Pass):
+    """conv2d + bias/residual adds + activation → one conv2d_fusion
+    region, forward AND backward (vjp merge). See module docstring."""
+
+    grad_aware = True
+
+    def apply(self, graph: Graph) -> Graph:
+        det = PatternDetector(graph)
+        pats = []
+        for act in _ACTS:
+            pats += det.match_chain(
+                ["conv2d", "elementwise_add", "elementwise_add", act],
+                ignore_vjp=True)
+        for act in _ACTS:
+            pats += det.match_chain(["conv2d", "elementwise_add", act],
+                                    ignore_vjp=True)
+        pats += det.match_chain(["conv2d", "elementwise_add"],
+                                ignore_vjp=True)
+        for act in _ACTS:
+            pats += det.match_chain(["conv2d", act], ignore_vjp=True)
+
+        vjps = vjp_index(graph)
+        fused_convs = set()
+        for ops in pats:
+            conv = ops[0]
+            if id(conv) in fused_convs or not _alive(graph, ops):
+                continue
+            if conv.attrs.get("data_format", "NCHW") not in ("NCHW",
+                                                             "AnyLayout"):
+                continue
+            conv_out = conv.outputs["Output"][0]
+            adds = [o for o in ops[1:] if o.type == "elementwise_add"]
+            act = ops[-1] if ops[-1].type in _ACTS else None
+
+            bias = resid = None
+            prev_out = conv_out
+            ok = True
+            for add in adds:
+                xs = add.inputs.get("X", [None])[0]
+                ys = add.inputs.get("Y", [None])[0]
+                other = ys if xs == prev_out else xs
+                if other is None or other == prev_out:
+                    ok = False
+                    break
+                if bias is None and xs == prev_out and _bias_like(
+                        graph.block, other, want_axis=1,
+                        axis=add.attrs.get("axis", -1)):
+                    bias = other
+                elif resid is None and not _bias_like(graph.block, other):
+                    # rank-4 residual: either operand order is legal
+                    rv = (graph.block.var(other)
+                          if graph.block.has_var(other) else None)
+                    if rv is None or len(list(rv.shape or [])) != 4:
+                        ok = False
+                        break
+                    resid = other
+                else:
+                    ok = False
+                    break
+                prev_out = add.outputs["Out"][0]
+            if not ok:
+                continue
+            if bias is None and resid is None and not act:
+                continue
+
+            member_vjps = [vjp_of(vjps, o) for o in ops]
+            has_grad = [v is not None for v in member_vjps]
+            if any(has_grad) and not all(has_grad):
+                continue        # partially differentiated — don't touch
+
+            ins = {"Input": list(conv.inputs["Input"]),
+                   "Filter": list(conv.inputs["Filter"])}
+            if bias:
+                ins["Bias"] = [bias]
+            if resid:
+                ins["ResidualData"] = [resid]
+            out_name = _first_out(ops[-1])
+            fused = ir.OpDesc(
+                type="conv2d_fusion", inputs=ins,
+                outputs={"Output": [out_name]},
+                attrs={**conv.attrs,
+                       "activation": act.type if act else "identity"})
+            # replace at the chain TAIL: a residual produced between the
+            # conv and the act is defined by then
+            idx = graph.block.ops.index(ops[-1])
+            graph.block.ops[idx] = fused
+
+            if all(has_grad):
+                # ONE __vjp__ over the fused op. Flat input order is
+                # sorted slots (Bias, Filter, Input, ResidualData);
+                # masks and grad names come from the member vjps.
+                grads = {}
+                for v in member_vjps:
+                    grads.update(_grad_parts(v))
+                flat_in = _flat_sorted(ins)
+                in_grad_mask = [n in grads for n in flat_in]
+                in_grad_names = [grads[n] for n in flat_in if n in grads]
+                if not any(in_grad_mask):
+                    graph.remove_ops([o for o in ops[:-1]])
+                    fused_convs.add(id(conv))
+                    continue
+                last_vjp = member_vjps[-1]
+                fused_vjp = ir.OpDesc(
+                    type="__vjp__",
+                    inputs={"FwdIn": flat_in,
+                            "OutGrad": list(last_vjp.inputs["OutGrad"])},
+                    outputs={"InGrad": in_grad_names},
+                    attrs={"fwd_op": fused.to_dict(),
+                           "fwd_op_index":
+                               last_vjp.attrs["fwd_op_index"],
+                           "in_grad_mask": in_grad_mask,
+                           "out_grad_mask": [True]})
+                vidx = graph.block.ops.index(last_vjp)
+                graph.block.ops[vidx] = fused_vjp
+                graph.remove_ops([v for v in member_vjps[:-1]])
+            graph.remove_ops([o for o in ops[:-1]])
+            fused_convs.add(id(conv))
+        return graph
+
+
+@register_pass("conv_bn_fold_pass")
+class ConvBnFoldPass(Pass):
+    """conv2d[_fusion] + batch_norm(is_test) → conv2d_fusion with BN
+    statistics folded into filter and bias (numeric fold at pass time —
+    needs `scope` with the trained Scale/Bias/Mean/Variance)."""
+
+    inference_only = True
+    scope = None
+
+    def apply(self, graph: Graph) -> Graph:
+        import numpy as np
+        if self.scope is None:
+            return graph
+        det = PatternDetector(graph)
+        pats = []
+        for head in ("conv2d", "conv2d_fusion"):
+            for act in _ACTS:
+                pats += det.match_chain([head, "batch_norm", act])
+            pats += det.match_chain([head, "batch_norm"])
+        folded = set()
+        for ops in pats:
+            conv, bn = ops[0], ops[1]
+            act = ops[2] if len(ops) == 3 else None
+            if id(conv) in folded or not _alive(graph, ops):
+                continue
+            if conv.attrs.get("data_format", "NCHW") not in ("NCHW",
+                                                             "AnyLayout"):
+                continue
+            out_slot = ("Output" if conv.type in ("conv2d",
+                                                  "conv2d_fusion")
+                        else "Out")
+            if bn.inputs.get("X", [None])[0] != \
+                    conv.outputs[out_slot][0]:
+                continue
+            if conv.type == "conv2d_fusion" and \
+                    conv.attrs.get("activation", "identity") \
+                    not in ("", "identity"):
+                continue        # BN after an activation cannot fold
+            if conv.inputs.get("ResidualData"):
+                # BN(conv + bias + resid) scales the RESIDUAL term too;
+                # a filter/bias fold cannot represent that — keep the
+                # composed form
+                continue
+            w_name = conv.inputs["Filter"][0]
+            if len(graph.consumers(w_name)) != 1:
+                continue        # folding would corrupt a shared filter
+            names = {}
+            for slot in ("Scale", "Bias", "Mean", "Variance"):
+                ns = bn.inputs.get(slot)
+                if not ns:
+                    names = None
+                    break
+                names[slot] = ns[0]
+            if names is None:
+                continue
+            # validate EVERY scope var before the first mutation — an
+            # abort after scaling the filter would leave the program
+            # normalizing twice
+            vals = {s: self.scope.find_var(n) for s, n in names.items()}
+            wv = self.scope.find_var(w_name)
+            old_bias = conv.inputs.get("Bias", [None])[0]
+            bv = (self.scope.find_var(old_bias)
+                  if old_bias is not None else None)
+            if wv is None or any(v is None for v in vals.values()) \
+                    or (old_bias is not None and bv is None):
+                continue
+            eps = float(bn.attrs.get("epsilon", 1e-5))
+            gamma = np.asarray(vals["Scale"], np.float32)
+            beta = np.asarray(vals["Bias"], np.float32)
+            mean = np.asarray(vals["Mean"], np.float32)
+            var = np.asarray(vals["Variance"], np.float32)
+            inv_std = 1.0 / np.sqrt(var + eps)
+            w = np.asarray(wv, np.float32)
+            self.scope.set_var(
+                w_name,
+                (w * (gamma * inv_std).reshape(-1, 1, 1, 1))
+                .astype(np.asarray(wv).dtype))
+            folded_bias = beta - gamma * mean * inv_std
+            if bv is not None:
+                folded_bias = folded_bias + np.asarray(
+                    bv, np.float32).reshape(-1) * gamma * inv_std
+            bias_name = f"{w_name}__bn_folded_bias"
+            graph.block.add_var(ir.VarDesc(
+                name=bias_name, shape=[int(folded_bias.shape[0])],
+                dtype="float32", persistable=True))
+            self.scope.set_var(bias_name,
+                               folded_bias.astype(np.float32))
+            ins = {"Input": list(conv.inputs["Input"]),
+                   "Filter": [w_name], "Bias": [bias_name]}
+            attrs = {k: v for k, v in conv.attrs.items()}
+            attrs["activation"] = act.type if act is not None \
+                else "identity"
+            out_name = (_first_out(act) if act is not None
+                        else bn.outputs["Y"][0])
+            fused = ir.OpDesc(
+                type="conv2d_fusion", inputs=ins,
+                outputs={"Output": [out_name]}, attrs=attrs)
+            idx = graph.block.ops.index(conv)
+            graph.block.ops[idx] = fused
+            graph.remove_ops([bn] + ([act] if act is not None else []))
+            folded.add(id(conv))
+        return graph

@@ -1,0 +1,168 @@
+"""Layout-assignment pass: canonicalize reshape/transpose chains.
+
+The transformer profiles (docs/performance.md, "The copy band")
+attribute ~4.3 ms/step of transformer_big to relayout copies XLA's
+layout assignment inserts around the FFN-hidden tensors, and the
+unfused attention path spells head split/merge as reshape+transpose
+chains whose intermediates each become a layout-assignment decision
+point. This pass shrinks the decision surface at the PROGRAM level:
+
+- ``transpose[2]`` → ``transpose[2]`` chains compose into ONE transpose
+  with the composed permutation (identity compositions become a no-op
+  XLA folds away);
+- ``reshape[2]`` → ``reshape[2]`` chains collapse to the final shape
+  (the tail's ``0``-placeholder dims are resolved against the
+  intermediate's static shape first, so the composed attr is
+  self-contained);
+
+both only when the intermediate var has a single consumer (``__vjp__``
+readers excluded — they are rewritten alongside). GRAD-AWARE: the two
+member ops' ``__vjp__`` backward ops merge into one ``__vjp__`` over
+the composed op, exactly the ``fuse_elewise_add_act_pass`` discipline —
+the re-trace derives the composed backward, no hand-written grad.
+
+Chains of length 1 (identity transposes/reshapes) are deliberately left
+alone: ``jnp.transpose`` with an identity permutation is already free
+under XLA, and removing the op would force fetch-name rewiring for zero
+runtime win.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.core import ir
+from paddle_tpu.fluid.ir_pass import (Graph, Pass, register_pass,
+                                      vjp_index, vjp_of)
+
+_TRANSPOSE = ("transpose", "transpose2")
+_RESHAPE = ("reshape", "reshape2")
+
+
+def _perm(op) -> Optional[List[int]]:
+    p = op.attrs.get("axis")
+    return list(p) if p else None
+
+
+def _resolve_shape(shape_attr, in_shape) -> Optional[List[int]]:
+    """Resolve reshape `0` placeholders (copy the input dim) against the
+    producer's static shape; `-1` passes through. None when a `0` maps
+    to a dynamic dim while a `-1` is also present (ambiguous)."""
+    if shape_attr is None or in_shape is None:
+        return None
+    out = []
+    for i, d in enumerate(shape_attr):
+        if d == 0:
+            if i >= len(in_shape):
+                return None
+            out.append(in_shape[i])
+        else:
+            out.append(int(d))
+    if out.count(-1) > 1:
+        return None
+    return out
+
+
+@register_pass("layout_assignment_pass")
+class LayoutAssignmentPass(Pass):
+    """Compose adjacent transpose/transpose and reshape/reshape pairs
+    (single-use intermediate), forward and backward."""
+
+    grad_aware = True
+
+    def apply(self, graph: Graph) -> Graph:
+        changed = True
+        n_rounds = 0
+        while changed and n_rounds < 8:   # chains of length k collapse
+            changed = False               # in k-1 rounds; 8 bounds it
+            n_rounds += 1
+            vjps = vjp_index(graph)
+            # ops consumed by a compose earlier THIS round (the node
+            # list is a snapshot); id-set so the staleness check stays
+            # O(1) per node instead of a linear op-list scan
+            consumed = set()
+            for node in list(graph.op_nodes):
+                head = node.op
+                kind = ("t" if head.type in _TRANSPOSE
+                        else "r" if head.type in _RESHAPE else None)
+                if kind is None:
+                    continue
+                if id(head) in consumed:
+                    continue
+                out = (head.outputs.get("Out") or [None])[0]
+                if out is None:
+                    continue
+                consumers = [c for c in graph.consumers(out)
+                             if c.op.type != "__vjp__"]
+                if len(consumers) != 1:
+                    continue
+                tail = consumers[0].op
+                same_family = (tail.type in _TRANSPOSE if kind == "t"
+                               else tail.type in _RESHAPE)
+                if not same_family:
+                    continue
+                if (tail.inputs.get("X") or [None])[0] != out:
+                    continue
+                if self._compose(graph, vjps, head, tail, kind):
+                    changed = True
+                    consumed.update((id(head), id(tail)))
+        return graph
+
+    # ------------------------------------------------------------------
+
+    def _compose(self, graph: Graph, vjps, head, tail, kind) -> bool:
+        blk = graph.block
+        if kind == "t":
+            p1, p2 = _perm(head), _perm(tail)
+            if p1 is None or p2 is None or len(p1) != len(p2):
+                return False
+            composed = [p1[a] for a in p2]
+            attrs = {"axis": composed}
+        else:
+            mid = (head.outputs.get("Out") or [None])[0]
+            mv = blk.var(mid) if mid and blk.has_var(mid) else None
+            mid_shape = list(mv.shape) if mv is not None and \
+                mv.shape is not None else None
+            target = _resolve_shape(tail.attrs.get("shape"), mid_shape)
+            if target is None:
+                return False
+            attrs = {"shape": target}
+
+        hv, tv = vjp_of(vjps, head), vjp_of(vjps, tail)
+        if (hv is None) != (tv is None):
+            return False          # partially differentiated — skip
+        if "__op_index__" in head.attrs:
+            # inherit the head's pinned rng salt (pin_op_indices) so the
+            # composed op can never collide with a later pinned op
+            attrs["__op_index__"] = head.attrs["__op_index__"]
+        outs = {"Out": list(tail.outputs["Out"])}
+        if tail.outputs.get("XShape"):
+            outs["XShape"] = list(tail.outputs["XShape"])
+        composed_op = ir.OpDesc(
+            type=tail.type, inputs={"X": list(head.inputs["X"])},
+            outputs=outs, attrs=attrs)
+        idx = blk.ops.index(tail)
+        blk.ops[idx] = composed_op
+        graph.remove_ops([head])
+
+        if hv is not None:
+            # one __vjp__ over the composed op: the head's input grads
+            # come straight from the tail's OutGrad through one re-trace
+            n_out = 1 + (1 if outs.get("XShape") else 0)
+            fused_vjp = ir.OpDesc(
+                type="__vjp__",
+                inputs={"FwdIn": list(head.inputs["X"]),
+                        "OutGrad": list(tv.inputs["OutGrad"])},
+                outputs={"InGrad": list(hv.outputs["InGrad"])},
+                attrs={"fwd_op": composed_op.to_dict(),
+                       "fwd_op_index": tv.attrs["fwd_op_index"],
+                       "in_grad_mask":
+                           list(hv.attrs["in_grad_mask"]),
+                       "out_grad_mask":
+                           list(tv.attrs["out_grad_mask"])[:n_out]})
+            vidx = blk.ops.index(tv)
+            blk.ops[vidx] = fused_vjp
+            graph.remove_ops([hv])
+        else:
+            graph.rebuild()
+        return True

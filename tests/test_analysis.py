@@ -540,6 +540,40 @@ def test_model_zoo_green_heavy(name):
     _assert_model_green(name)
 
 
+def _assert_model_green_post_pass(name):
+    """The pass-pipeline extension of the zoo sweep: apply the TPU
+    rewrite passes, then the full rule catalog over the REWRITTEN
+    program must stay error-free (proglint green on every post-pass
+    program — the 'every rewritten program re-verified' contract)."""
+    from paddle_tpu import passes as tpu_passes
+    kw = _MODEL_CFGS[name]
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    with fluid.program_guard(main, startup):
+        out = getattr(models, name).build(**kw)
+    loss, fetches, specs = out[0], out[1] or [], out[2]
+    fetch_names = [loss.name] + [getattr(f, "name", str(f))
+                                 for f in fetches]
+    tpu_passes.apply_pipeline(main, feed_names=sorted(specs),
+                              fetch_names=fetch_names, verify=False)
+    diags = analysis.analyze_program(main, feed_names=sorted(specs),
+                                     fetch_names=fetch_names)
+    errs = [d for d in diags if d.severity == Severity.ERROR]
+    assert not errs, (name, [d.format() for d in errs])
+
+
+@pytest.mark.parametrize("name", sorted(n for n in _MODEL_CFGS
+                                        if n not in _HEAVY))
+def test_model_zoo_green_post_pass(name):
+    _assert_model_green_post_pass(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(_HEAVY))
+def test_model_zoo_green_post_pass_heavy(name):
+    _assert_model_green_post_pass(name)
+
+
 def test_book_program_green_word2vec():
     VOCAB, EMB = 20, 8
     main, startup = fluid.Program(), fluid.Program()

@@ -45,6 +45,8 @@ MODULES = [
     "paddle_tpu.observability.tracing",
     "paddle_tpu.observability.runtime",
     "paddle_tpu.observability.exporters",
+    "paddle_tpu.passes",
+    "paddle_tpu.passes.autotune",
 ]
 
 

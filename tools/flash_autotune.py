@@ -1,121 +1,54 @@
-"""Flash-attention autotune sweep (round-4 VERDICT #4).
+"""Flash-attention autotune sweep — now a thin front end over the
+unified autotuner (round-6: ONE committed-table discipline).
 
-Times the attention REGION (fwd+bwd, the training cost) at each
-(T, d_head) across the Pallas flash kernel's (bq, bk) grid and against
-the XLA fused-dot composition the model otherwise uses, on the real
-chip. The winner table is committed into
-`paddle_tpu/ops/pallas/flash_attention.py AUTOTUNE` and the op's engage
-rule reads it — benchmark-derived selection, the reference's jit-tier
-discipline (operators/jit/kernel_pool.cc picks the kernel that won its
-self-benchmark) instead of a hand threshold.
+This tool proved the committed-table discipline in round 5 (its winner
+table drove the transformer_big 73.2k -> 77.1k tok/s flip). Round 6
+generalized the table to `paddle_tpu/passes/autotune_table.json`
+(versioned, multi-kind, read through `paddle_tpu.passes.autotune`), and
+the sweep itself moved to `tools/autotune.py --kind flash_attention`.
+This wrapper keeps the old invocation working:
 
-Run (idle TPU):  python tools/flash_autotune.py [--tokens 8192]
-Prints one JSON line per measurement and a final TABLE line suitable
-for pasting into AUTOTUNE.
+    python tools/flash_autotune.py [--tokens 8192] [--commit]
+
+is exactly
+
+    python tools/autotune.py --kind flash_attention [--tokens 8192]
+                             [--commit]
+
+The flash dispatch (`ops/pallas/flash_attention.py flash_engage`) reads
+the committed winners through the same `autotune.lookup` path every
+other tuned region uses — no second table, no second format.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
+import os
 import sys
-import time
 
-import numpy as np
-
-sys.path.insert(0, __import__("os").path.dirname(
-    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
-
-import jax                                              # noqa: E402
-import jax.numpy as jnp                                 # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
-def _xla_attention(q, k, v, causal, scale):
-    """The composition the fused block's internal dots lower to."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        tq, tk = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((tq, tk), bool))
-        s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
-
-
-def _time_grad(fn, args, iters=20):
-    # grads wrt ALL of q, k, v — a training step pays dk/dv too, and
-    # their relative cost differs between flash (recompute bwd) and the
-    # composition (reuses the materialized scores)
-    f = jax.jit(lambda *a: sum(
-        jnp.sum(g) for g in jax.grad(
-            lambda q, k, v: jnp.sum(fn(q, k, v)),
-            argnums=(0, 1, 2))(*a)))
-    # two fenced warmups (compile + layout specialization)
-    _ = float(np.asarray(f(*args)))
-    _ = float(np.asarray(f(*args)))
-    t0 = time.time()
-    for _i in range(iters):
-        out = f(*args)
-    _ = float(np.asarray(out))
-    return (time.time() - t0) / iters * 1000
-
-
-def sweep(tokens=8192, dtype=jnp.bfloat16):
-    from paddle_tpu.ops import pallas as pk
-    rng = np.random.RandomState(0)
-    results = []
-    table = {}
-    for T in (256, 512, 1024, 2048):
-        for d in (64, 128):
-            h = 8
-            b = max(1, tokens // T)
-            q, k, v = (jnp.asarray(rng.randn(b, h, T, d), np.float32)
-                       .astype(dtype) * 0.3 for _ in range(3))
-            scale = float(d) ** -0.5
-            for causal in (False, True):
-                xla_ms = _time_grad(
-                    lambda q, k, v, c=causal: _xla_attention(
-                        q, k, v, c, scale), (q, k, v))
-                best = None
-                for bq in (128, 256, 512):
-                    if T % bq:
-                        continue
-                    for bk in (128, 256, 512, 1024):
-                        if T % bk:
-                            continue
-                        try:
-                            ms = _time_grad(
-                                lambda q, k, v, c=causal, bq=bq, bk=bk:
-                                pk.flash_attention(q, k, v, c, scale,
-                                                   bq, bk), (q, k, v))
-                        except Exception as e:      # over-VMEM config etc.
-                            print(json.dumps(
-                                {"T": T, "d": d, "causal": causal,
-                                 "bq": bq, "bk": bk,
-                                 "error": str(e)[:80]}), flush=True)
-                            continue
-                        results.append({"T": T, "d": d, "causal": causal,
-                                        "bq": bq, "bk": bk,
-                                        "flash_ms": round(ms, 3),
-                                        "xla_ms": round(xla_ms, 3)})
-                        print(json.dumps(results[-1]), flush=True)
-                        if best is None or ms < best[0]:
-                            best = (ms, bq, bk)
-                if best:
-                    table[(T, d, causal)] = {
-                        "wins": bool(best[0] < xla_ms),
-                        "bq": best[1], "bk": best[2],
-                        "flash_ms": round(best[0], 3),
-                        "xla_ms": round(xla_ms, 3)}
-    print("TABLE " + json.dumps({f"{t},{d},{int(c)}": v
-                                 for (t, d, c), v in table.items()}))
-    return table
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tokens", type=int, default=8192,
+                    help="B*T per measurement (B = tokens/T)")
+    ap.add_argument("--commit", action="store_true",
+                    help="commit winners into the unified table")
+    args = ap.parse_args(argv)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "paddle_autotune_cli",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "autotune.py"))
+    unified = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(unified)
+    fwd = ["--kind", "flash_attention", "--tokens", str(args.tokens)]
+    if args.commit:
+        fwd.append("--commit")
+    return unified.main(fwd)
 
 
 if __name__ == "__main__":
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tokens", type=int, default=8192,
-                    help="B*T per measurement (B = tokens/T)")
-    args = ap.parse_args()
-    sweep(args.tokens)
+    sys.exit(main())

@@ -1,8 +1,28 @@
-"""Perf probe: compare per-step dispatch vs device-side multi-step loop,
-and report XLA's own cost analysis for one training step.
+"""Perf probe: per-step cost analysis, dispatch-vs-device-loop timing,
+and per-op-region copy/relayout attribution.
 
-Usage: python tools/perf_probe.py [model] [batch_size] [inner_steps]
+The relayout report automates the manual analysis behind the
+transformer_big "r4 copy band" (docs/performance.md): it walks the
+OPTIMIZED HLO of the compiled step, collects every ``copy`` /
+``transpose`` / ``bitcast-convert`` instruction, groups them by operand
+shape (the op-region proxy — a relayout band is N copies of one logical
+tensor), labels each band with the program vars whose sentinel shape
+matches, and reports count + MB/step + the time bound at HBM peak.
+Layout-pass wins are re-measurable with ONE command:
+
+    python tools/perf_probe.py transformer_big --copy-band [--no-passes]
+
+compares directly against the same invocation with the pass pipeline
+disabled. Plain timing mode (the original probe) remains:
+
+    python tools/perf_probe.py [model] [batch_size] [inner_steps]
 """
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
 import sys
 import time
 
@@ -12,34 +32,146 @@ import jax.numpy as jnp
 
 sys.path.insert(0, ".")
 
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8}
 
-def main():
-    model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    bs = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    inner = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+# `%copy.12 = bf16[16,512,4096]{2,1,0} copy(...)` — opcode + typed shape
+_HLO_RE = re.compile(
+    r"=\s+(?P<dtype>[a-z]+\d*)\[(?P<dims>[\d,]*)\][^ ]*\s+"
+    r"(?P<opcode>copy|transpose|bitcast-convert)\(")
 
+RELAYOUT_OPCODES = ("copy", "transpose", "bitcast-convert")
+
+
+def collect_relayouts(hlo_text: str):
+    """[(opcode, dtype, dims tuple, bytes)] for every relayout-family
+    instruction in an optimized-HLO dump."""
+    out = []
+    for m in _HLO_RE.finditer(hlo_text):
+        dims = tuple(int(d) for d in m.group("dims").split(",") if d)
+        nbytes = _DTYPE_BYTES.get(m.group("dtype"), 4)
+        for d in dims:
+            nbytes *= d
+        out.append((m.group("opcode"), m.group("dtype"), dims, nbytes))
+    return out
+
+
+def copy_band_report(hlo_text: str, block=None, batch_size=None,
+                     hbm_gbps: float = 819.0, top: int = 12):
+    """Group relayout instructions into per-region bands. Each band is
+    one (dtype, shape) class — e.g. the transformer_big FFN hidden
+    [16,512,4096] — with count, MB/step, the ms bound at HBM peak, and
+    the program vars whose shape matches (region labels)."""
+    bands = {}
+    for opcode, dtype, dims, nbytes in collect_relayouts(hlo_text):
+        key = (dtype, dims)
+        b = bands.setdefault(key, {"count": 0, "bytes": 0,
+                                   "opcodes": {}})
+        b["count"] += 1
+        b["bytes"] += nbytes
+        b["opcodes"][opcode] = b["opcodes"].get(opcode, 0) + 1
+
+    def region_labels(dims):
+        if block is None:
+            return []
+        labels = []
+        for name, v in getattr(block, "vars", {}).items():
+            shape = list(v.shape or [])
+            if not shape or len(shape) != len(dims):
+                continue
+            concrete = [batch_size if (d == -1 and batch_size) else d
+                        for d in shape]
+            if tuple(concrete) == dims:
+                labels.append(name)
+        return labels[:4]
+
+    rows = []
+    for (dtype, dims), b in bands.items():
+        mb = b["bytes"] / 1e6
+        rows.append({
+            "region": f"{dtype}[{','.join(map(str, dims))}]",
+            "count": b["count"],
+            "opcodes": b["opcodes"],
+            "mb_per_step": round(mb, 2),
+            "ms_at_hbm_peak": round(b["bytes"] / (hbm_gbps * 1e9) * 1e3,
+                                    3),
+            "vars": region_labels(dims),
+        })
+    rows.sort(key=lambda r: -r["mb_per_step"])
+    total_ms = round(sum(r["ms_at_hbm_peak"] for r in rows), 3)
+    return {"relayout_bands": rows[:top],
+            "relayout_total_ms_at_hbm_peak": total_ms,
+            "relayout_total_count": sum(r["count"] for r in rows)}
+
+
+def build_model(model, amp=True, nhwc=True, passes_spec=None,
+                batch_size=None):
     import paddle_tpu.fluid as fluid
     from paddle_tpu import models
-    from bench import DEFAULT_BATCH_SIZES, run_bench, _device_batch
-    from paddle_tpu.core.lowering import CompiledBlock
+    from bench import _apply_tpu_passes
 
     builders = {
         "resnet50": (models.resnet.build, {}),
         "alexnet": (models.alexnet.build, {}),
         "vgg": (models.vgg.build, {}),
+        "se_resnext": (models.se_resnext.build, {}),
+        "googlenet": (models.googlenet.build, {}),
         "transformer": (models.transformer.build,
-                        {"max_len": 64, "src_vocab": 32000,
-                         "tgt_vocab": 32000}),
+                        {"max_len": 256, "src_vocab": 32000,
+                         "tgt_vocab": 32000, "fused_attention": True}),
+        "transformer_big": (models.transformer.build,
+                            {"max_len": 512, "src_vocab": 32000,
+                             "tgt_vocab": 32000, "d_model": 1024,
+                             "d_inner": 4096, "n_head": 8, "n_layer": 6,
+                             "fused_attention": True,
+                             "fused_head": True}),
     }
     build_fn, kw = builders[model]
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = 1
     with fluid.program_guard(main_p, startup):
         loss, _, feed_specs = build_fn(is_train=True, **kw)
-        from paddle_tpu.contrib.mixed_precision import rewrite_program_amp
-        rewrite_program_amp(main_p)
-        from paddle_tpu.contrib.layout import rewrite_program_nhwc
-        rewrite_program_nhwc(main_p)
+        applied = _apply_tpu_passes(
+            main_p, model, batch_size, passes_spec, is_test=False,
+            feed_names=sorted(feed_specs), fetch_names=[loss.name])
+        if amp:
+            from paddle_tpu.contrib.mixed_precision import \
+                rewrite_program_amp
+            rewrite_program_amp(main_p)
+        if nhwc:
+            from paddle_tpu.contrib.layout import rewrite_program_nhwc
+            rewrite_program_nhwc(main_p)
+    return main_p, startup, loss, feed_specs, applied
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("model", nargs="?", default="resnet50")
+    ap.add_argument("batch_size", nargs="?", type=int, default=None)
+    ap.add_argument("inner", nargs="?", type=int, default=10)
+    ap.add_argument("--copy-band", action="store_true",
+                    help="emit the per-region copy/relayout attribution "
+                         "(JSON) from the optimized HLO and exit")
+    ap.add_argument("--no-passes", dest="passes", action="store_const",
+                    const="none", default=None,
+                    help="disable the IR-pass pipeline (A/B arm)")
+    ap.add_argument("--passes", dest="passes", default=None,
+                    metavar="P1,P2", help="explicit pass list")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output for all sections")
+    args = ap.parse_args()
+    model, inner = args.model, args.inner
+
+    import paddle_tpu.fluid as fluid
+    from bench import DEFAULT_BATCH_SIZES, _device_batch
+    from paddle_tpu.core.lowering import CompiledBlock
+
+    bs = args.batch_size or DEFAULT_BATCH_SIZES.get(model, 128)
+    main_p, startup, loss, feed_specs, applied = build_model(
+        model, passes_spec=args.passes, batch_size=bs)
+    if applied or args.passes:
+        print(json.dumps({"passes": applied}), flush=True)
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
@@ -52,22 +184,8 @@ def main():
     state = {n: scope.find_var(n) for n in cb.sig.state_names}
     consts = {n: scope.find_var(n) for n in cb.sig.const_names}
 
-    # ---- single-step timing (per-dispatch) ----
-    fetches, state = cb.fn(state, consts, feeds, np.uint32(1))
-    lv = float(np.asarray(fetches[0]).reshape(()))
-    print("single-step loss:", lv)
-
-    t0 = time.time()
-    N = 30
-    for i in range(N):
-        fetches, state = cb.fn(state, consts, feeds, np.uint32(2 + i))
-    _ = float(np.asarray(fetches[0]).reshape(()))
-    dt_disp = (time.time() - t0) / N
-    print(f"per-dispatch step: {dt_disp*1e3:.2f} ms -> {bs/dt_disp:.0f} img/s")
-
-    # ---- cost analysis ----
-    lowered = jax.jit(cb.fn.__wrapped__ if hasattr(cb.fn, "__wrapped__")
-                      else cb.fn, donate_argnums=(0,)).lower(
+    # ---- compile once; cost analysis + optimized HLO ----
+    lowered = jax.jit(cb._step_fn, donate_argnums=(0,)).lower(
         state, consts, feeds, np.uint32(0))
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
@@ -75,12 +193,39 @@ def main():
         ca = ca[0]
     flops = ca.get("flops", 0.0)
     bytes_acc = ca.get("bytes accessed", 0.0)
+
+    if args.copy_band:
+        report = copy_band_report(compiled.as_text(),
+                                  block=desc.global_block,
+                                  batch_size=bs)
+        report["model"] = model
+        report["batch_size"] = bs
+        report["passes"] = applied
+        print(json.dumps(report, indent=None if args.json else 1))
+        return
+
     print(f"XLA cost analysis: {flops/1e9:.1f} GFLOP/step, "
           f"{bytes_acc/1e9:.2f} GB accessed/step")
     print(f"  -> at 197 TFLOP/s peak: {flops/197e12*1e3:.2f} ms ideal")
-    print(f"  -> at 800 GB/s HBM: {bytes_acc/800e9*1e3:.2f} ms ideal")
+    print(f"  -> at 819 GB/s HBM: {bytes_acc/819e9*1e3:.2f} ms ideal")
+
+    # ---- single-step timing (per-dispatch) ----
+    fetches, state = cb.fn(state, consts, feeds, np.uint32(1))
+    print("single-step loss:",
+          float(np.asarray(fetches[0]).reshape(())))
+    t0 = time.time()
+    N = 30
+    for i in range(N):
+        fetches, state = cb.fn(state, consts, feeds, np.uint32(2 + i))
+    _ = float(np.asarray(fetches[0]).reshape(()))
+    dt_disp = (time.time() - t0) / N
+    print(f"per-dispatch step: {dt_disp*1e3:.2f} ms -> "
+          f"{bs/dt_disp:.0f} examples/s")
 
     # ---- multi-step fori_loop ----
+    from paddle_tpu.core.lowering import build_block_fn
+    cb_fn = build_block_fn(desc, 0, cb.sig, is_test=False)
+
     def multi(state, consts, feeds, seed0):
         def body(i, carry):
             state, _ = carry
@@ -89,9 +234,6 @@ def main():
         return jax.lax.fori_loop(0, inner, body,
                                  (state, jnp.zeros((), jnp.float32)))
 
-    # rebuild the raw (unjitted) fn
-    from paddle_tpu.core.lowering import build_block_fn
-    cb_fn = build_block_fn(desc, 0, cb.sig, is_test=False)
     multi_j = jax.jit(multi, donate_argnums=(0,))
     state2, lv2 = multi_j(state, consts, feeds, np.uint32(100))
     print("multi-step loss:", float(np.asarray(lv2).reshape(())))
@@ -101,7 +243,8 @@ def main():
         state2, lv2 = multi_j(state2, consts, feeds, np.uint32(200 + r))
     _ = float(np.asarray(lv2).reshape(()))
     dt_multi = (time.time() - t0) / (R * inner)
-    print(f"fori_loop step:   {dt_multi*1e3:.2f} ms -> {bs/dt_multi:.0f} img/s")
+    print(f"fori_loop step:   {dt_multi*1e3:.2f} ms -> "
+          f"{bs/dt_multi:.0f} examples/s")
     mfu = flops / dt_multi / 197e12
     print(f"MFU (XLA flops / 197 TFLOP/s): {mfu*100:.1f}%")
 

@@ -98,6 +98,15 @@ def main(argv=None):
     ap.add_argument("--is-test", action="store_true",
                     help="treat the program as inference "
                          "(rng-in-inference rule)")
+    ap.add_argument("--passes", nargs="?", const="", default=None,
+                    metavar="P1,P2",
+                    help="apply the IR-pass pipeline (default selection "
+                         "with no value, or the named passes) to each "
+                         "main program and lint the POST-PASS program. "
+                         "Runs under the autotune measurement-forbidden "
+                         "guard: with the committed table present, the "
+                         "whole apply+lint is deterministic (zero "
+                         "timing measurements) — the CI smoke contract")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule ids to run (default all)")
     ap.add_argument("--suppress", default="",
@@ -135,6 +144,24 @@ def main(argv=None):
             feeds = _split(args.feed)
         if args.fetch:
             fetches = _split(args.fetch)
+        if args.passes is not None and not name.endswith(":startup"):
+            # apply-then-lint, with measurement forbidden: a pass or a
+            # cache path that tried to time anything fails loudly here
+            # instead of silently making CI nondeterministic
+            from paddle_tpu import passes as tpu_passes
+            from paddle_tpu.passes import autotune
+            prog = program
+            if not hasattr(prog, "desc"):      # bare ProgramDesc from
+                class _P:                      # a saved __model__.json
+                    pass
+                prog = _P()
+                prog.desc = program
+            with autotune.forbid_measurement():
+                applied = tpu_passes.apply_pipeline(
+                    prog, names=_split(args.passes) or None,
+                    is_test=args.is_test, verify=False,
+                    feed_names=feeds, fetch_names=fetches)
+            print(f"[passes] {name}: applied {applied}")
         try:
             diags = analysis.analyze_program(
                 program, feed_names=feeds, fetch_names=fetches,

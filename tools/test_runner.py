@@ -58,6 +58,18 @@ def run_lint_gate(root: str, timeout: int) -> int:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         r = subprocess.run(cmd, cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # pass-pipeline smoke: apply ALL passes to the example programs
+        # and lint the post-pass programs, under the autotune
+        # measurement-forbidden guard — proves (a) the rewritten zoo
+        # programs stay verifier-green and (b) with the committed table
+        # present the whole build path performs ZERO timing
+        # measurements (paddle_tpu/passes/autotune.py CI contract)
+        print("test_runner: lint gate — pass-pipeline smoke "
+              "(proglint --passes, measurement-forbidden)")
+        r = subprocess.run(cmd + ["--passes"], cwd=root,
+                           timeout=timeout, env=env)
         return r.returncode
     except subprocess.TimeoutExpired:
         sys.exit(f"test_runner: lint gate exceeded {timeout}s")
