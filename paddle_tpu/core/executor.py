@@ -245,6 +245,54 @@ class Executor:
             return stacked is True or (isinstance(stacked, list)
                                        and name in stacked)
 
+        # pad-and-slice for the data axis: a batch whose (per-step) batch
+        # dim is not divisible by the mesh data axis used to be silently
+        # replicated to every device (the old feed_sharding fallback);
+        # now the batch pads to the next multiple by repeating the last
+        # row (always-valid inputs), shards normally, and the padded rows
+        # are sliced back off row-shaped fetches below. Batch-REDUCED
+        # fetches (a mean loss) see the padded rows — exactness there
+        # needs a divisible batch (utils/padding.py).
+        pad_plan = None
+        if dist_mode:
+            axis = cb.dist.data_axis
+            axis_size = (cb.dist.mesh.shape[axis]
+                         if axis in cb.dist.mesh.axis_names else 1)
+            if axis_size > 1:
+                from paddle_tpu.utils import padding as _padding
+                plan = _padding.PadPlan()
+                padded_feed = None
+                for name in feed_names:
+                    sh = cb.feed_sharding(name)
+                    spec = getattr(sh, "spec", None) or ()
+                    if not len(spec) or spec[0] != axis:
+                        continue
+                    bdim = 1 if is_stacked(name) else 0
+                    shape = np.shape(feed[name])
+                    if len(shape) <= bdim or shape[bdim] % axis_size == 0:
+                        continue
+                    arr = np.asarray(feed[name])
+                    n = arr.shape[bdim]
+                    target = _padding.next_multiple(n, axis_size)
+                    pads = [(0, 0)] * arr.ndim
+                    pads[bdim] = (0, target - n)
+                    if padded_feed is None:
+                        padded_feed = dict(feed)
+                    padded_feed[name] = np.pad(arr, pads, mode="edge")
+                    plan.note(n, target)
+                if padded_feed is not None:
+                    feed = padded_feed
+                    pad_plan = plan
+                    import warnings
+                    warnings.warn(
+                        f"batch dim not divisible by data axis "
+                        f"{axis!r} (size {axis_size}); padding "
+                        f"{dict(plan.pairs)} by repeating the last row "
+                        f"— row-shaped fetches are sliced back, but "
+                        f"batch-REDUCED fetches (a mean loss) and state "
+                        f"updates see the padded rows; feed a divisible "
+                        f"batch for exactness")
+
         for name in feed_names:
             val = feed[name]
             want = cb.feed_dtype(name)
@@ -376,6 +424,26 @@ class Executor:
                 v = scope.find_var(name)
                 if v is not None:
                     _assert_finite(name, v)
+        if pad_plan is not None:
+            # slice the padded rows back off batch-shaped fetches (batch
+            # dim is axis 1 for stacked multi-step fetches). Only fetches
+            # whose DECLARED leading dim is dynamic (-1) are sliced — a
+            # fetch whose fixed extent coincidentally equals the padded
+            # batch (a [8, D] weight under a padded-to-8 batch) must
+            # come back untouched
+            bdim = 1 if iterations > 1 else 0
+            sliced = []
+            for name, o in zip(fetch_names, outs):
+                shape = np.shape(o)
+                v = cb.block.var(name) if cb.block.has_var(name) else None
+                batch_shaped = (v is not None and v.shape
+                                and len(v.shape) >= 1 and v.shape[0] == -1)
+                orig = (pad_plan.pairs.get(shape[bdim])
+                        if batch_shaped and len(shape) > bdim else None)
+                if orig is not None:
+                    o = o[(slice(None),) * bdim + (slice(0, orig),)]
+                sliced.append(o)
+            outs = sliced
         if return_numpy:
             outs = [np.asarray(o) for o in outs]   # D2H sync point
         else:

@@ -513,17 +513,16 @@ class CompiledBlock:
             v = self.block.var(name) if self.block.has_var(name) else None
             if v is not None and v.shape and len(v.shape) >= 1:
                 d0 = v.shape[0]
-                axis_size = mesh.shape[axis]
-                # shard the batch dim whether declared dynamic (-1) or as a
-                # concrete size divisible by the data axis
-                if d0 == -1 or (d0 > 0 and d0 % axis_size == 0):
+                if d0 == -1 or d0 > 0:
+                    # the batch dim shards whether declared dynamic (-1)
+                    # or concrete. A non-divisible batch is no longer
+                    # silently replicated (every device computing the
+                    # full batch): the executor feed path pads the batch
+                    # to the next data-axis multiple and slices the
+                    # padded rows back off row-shaped fetches
+                    # (utils/padding.py pad_feeds_to_multiple).
                     ndim = len(v.shape)
                     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
-                import warnings
-                warnings.warn(
-                    f"feed {name!r} batch dim {d0} not divisible by data "
-                    f"axis {axis!r} (size {axis_size}); replicating — every "
-                    f"device computes the full batch")
             return repl
 
         state_sh = {n: param_sharding(n) for n in self.sig.state_names}

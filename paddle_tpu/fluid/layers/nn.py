@@ -635,6 +635,74 @@ def fused_multi_head_attention(q_in, kv_in, d_model, n_head, causal=False,
     return out
 
 
+def _attention_projection_params(helper, d_model, param_attr):
+    """The four [M, M] projection weights, named exactly like
+    fused_multi_head_attention's (``<base>.wq`` ... ``.wo``) so the same
+    checkpoint/scope serves the training graph, the full-forward
+    inference graph, AND the prefill/decode serving pair."""
+    if isinstance(param_attr, (list, tuple)):
+        attrs4 = list(param_attr)
+    elif param_attr is None:
+        attrs4 = [None] * 4
+    else:
+        import copy
+        attrs4 = []
+        for tag in ("wq", "wk", "wv", "wo"):
+            a = copy.deepcopy(param_attr)
+            if a.name is not None:
+                a.name = f"{a.name}.{tag}"
+            attrs4.append(a)
+    return [helper.create_parameter(a, shape=[d_model, d_model],
+                                    dtype="float32") for a in attrs4]
+
+
+def kv_attention_prefill(x, d_model, n_head, cache_k, cache_v,
+                         param_attr=None, name=None):
+    """Causal self-attention over the whole (padded) prompt that ALSO
+    populates the serving KV cache: ``cache_k``/``cache_v`` are
+    persistable [B, S, H, D] vars this op writes (S from the var shape;
+    CompiledBlock carries them into the serving scope, where the decode
+    program reads them). x [B, T, M] -> [B, T, M]. Numerics identical to
+    fused_multi_head_attention(causal=True) without dropout — a
+    prefill+decode transcript matches the full-forward graph
+    (ops/kv_attention.py; docs/serving.md)."""
+    helper = LayerHelper("kv_attention_prefill", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kv_attention_prefill",
+                     inputs={"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+                             "Wv": [ws[2]], "Wo": [ws[3]]},
+                     outputs={"Out": [out], "CacheK": [cache_k],
+                              "CacheV": [cache_v]},
+                     attrs={"n_head": int(n_head),
+                            "cache_len": int(cache_k.shape[1])})
+    return out
+
+
+def kv_attention_decode(x, step, seq_len, d_model, n_head, cache_k,
+                        cache_v, prompt_len, param_attr=None, name=None):
+    """One-token decode step over the static-shape KV cache: writes this
+    token's k/v at ``prompt_len + step`` (in-place — the caches are read
+    and written under the same names, so they are donated state) and
+    attends over the per-row mask {j < seq_len} ∪ {prompt_len..pos}.
+    x [B, 1, M], step [1] int, seq_len [B, 1] int -> [B, 1, M]. The same
+    executable serves every decode position — zero steady-state
+    compiles (ops/kv_attention.py; docs/serving.md)."""
+    helper = LayerHelper("kv_attention_decode", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kv_attention_decode",
+                     inputs={"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+                             "Wv": [ws[2]], "Wo": [ws[3]],
+                             "CacheK": [cache_k], "CacheV": [cache_v],
+                             "Step": [step], "SeqLen": [seq_len]},
+                     outputs={"Out": [out], "CacheKOut": [cache_k],
+                              "CacheVOut": [cache_v]},
+                     attrs={"n_head": int(n_head),
+                            "prompt_len": int(prompt_len)})
+    return out
+
+
 def fused_linear_cross_entropy(input, label, num_classes, label_smoothing=0.0,
                                ignore_index=-100, param_attr=None,
                                name=None):

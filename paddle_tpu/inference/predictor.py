@@ -21,6 +21,10 @@ class AnalysisConfig:
     model_dir: str = ""
     prog_file: str = ""
     params_file: str = ""
+    # telemetry tag for this model's serving metrics (the `model` label
+    # on paddle_serving_aot_fallback_total etc.); defaults to the model
+    # dir's basename
+    model_tag: str = ""
     # reference: switch_ir_optim — run the inference transpiler's IR
     # rewrites (BN fold) before compiling
     ir_optim: bool = True
@@ -115,14 +119,41 @@ class PaddlePredictor:
         """inputs: dict {feed name: array} or list in feed order."""
         if not isinstance(inputs, dict):
             inputs = dict(zip(self._feed_names, inputs))
-        if self._aot is not None:
-            outs = self._run_aot(inputs)
-            if outs is not None:
-                return outs
+        if self._aot:
+            if self.has_aot_for(inputs):
+                # a backend failure inside counts cause=backend_error
+                outs = self._run_aot(inputs)
+                if outs is not None:
+                    return outs
+            else:
+                self._count_fallback("shape_miss")
+        elif self._aot_load_attempted:
+            # load_compiled was called but nothing (usable) loaded —
+            # this predictor intended to serve AOT and is now silently
+            # compiling at request time; make that visible
+            self._count_fallback("no_artifact")
         outs = self._exe.run(self._program, feed=inputs,
                              fetch_list=self._fetch_names,
                              scope=self._scope)
         return [np.asarray(o) for o in outs]
+
+    def _count_fallback(self, cause: str):
+        """paddle_serving_aot_fallback_total{model,cause} — the
+        AOT-miss-to-JIT counter (ISSUE 8 satellite; declared in
+        serving/metrics.py, preregistered in the exporter catalog)."""
+        try:
+            from paddle_tpu.serving import metrics as smetrics
+            smetrics.AOT_FALLBACK.labels(
+                model=self._model_tag(), cause=cause).inc()
+        except Exception:
+            pass      # telemetry must never fail an inference
+
+    def _model_tag(self) -> str:
+        import os
+        return (self._config.model_tag
+                or os.path.basename(
+                    os.path.normpath(self._config.model_dir or ""))
+                or "default")
 
     # reference spelling
     __call__ = run
@@ -131,13 +162,18 @@ class PaddlePredictor:
     # The reference's model-load path deserializes a ready program and
     # starts serving (analysis_predictor.cc LoadProgramDesc + optimized
     # executor); XLA re-introduces a compile at first inference. These
-    # two methods close that cold-start gap: the COMPILED XLA executable
-    # is serialized next to the StableHLO export, and a fresh process
-    # deserializes and serves without invoking the compiler.
+    # methods close that cold-start gap: the COMPILED XLA executable is
+    # serialized next to the StableHLO export — ONE FILE PER FEED-SHAPE
+    # SIGNATURE (`__compiled__.<digest>.pax`), so a shape-bucketed
+    # server (paddle_tpu/serving) boots its whole bucket ladder from
+    # disk without invoking the compiler. The legacy single-file name
+    # (`__compiled__.pax`) still loads.
 
-    _aot = None
-    _aot_meta = None
-    AOT_FILENAME = "__compiled__.pax"
+    _aot: dict = None                  # {shape digest: (executable, sig)}
+    _aot_load_attempted = False
+    AOT_FILENAME = "__compiled__.pax"  # legacy (pre-multi-signature)
+    AOT_PREFIX = "__compiled__."
+    AOT_SUFFIX = ".pax"
 
     def _program_fingerprint(self) -> str:
         import hashlib
@@ -145,6 +181,37 @@ class PaddlePredictor:
         blob = _json.dumps(self._program.desc.to_dict(), sort_keys=True,
                            default=str)
         return hashlib.sha256(blob.encode()).hexdigest()
+
+    @staticmethod
+    def _shape_digest(feed_shapes) -> str:
+        """Stable 16-hex digest of a {name: (shape, dtype)} signature —
+        the per-executable filename key."""
+        import hashlib
+        blob = repr(sorted((n, tuple(s), str(d))
+                           for n, (s, d) in feed_shapes.items()))
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def _input_shapes(self, inputs) -> dict:
+        return {n: (tuple(np.shape(v)), str(np.asarray(v).dtype))
+                for n, v in inputs.items()}
+
+    def _digest_of_inputs(self, inputs) -> str:
+        return self._shape_digest(self._input_shapes(
+            {n: inputs[n] for n in self._feed_names if n in inputs}))
+
+    def has_aot_for(self, inputs) -> bool:
+        """Whether a loaded AOT executable matches these input shapes."""
+        if not self._aot:
+            return False
+        if not isinstance(inputs, dict):
+            inputs = dict(zip(self._feed_names, inputs))
+        return self._digest_of_inputs(inputs) in self._aot
+
+    def aot_signatures(self) -> List[dict]:
+        """The feed-shape signatures currently loaded (one per
+        executable)."""
+        return [dict(sig["feed_shapes"])
+                for _, sig in (self._aot or {}).values()]
 
     def _aot_args(self, cb_sig, inputs):
         state = {n: self._scope.find_var(n) for n in cb_sig["state_names"]}
@@ -154,8 +221,11 @@ class PaddlePredictor:
 
     def save_compiled(self, dirname: str, example_inputs) -> str:
         """AOT-compile for the example input shapes and persist the
-        serialized executable (one file per feed-shape signature would
-        mirror the executor cache; serving typically has one)."""
+        serialized executable — one file PER feed-shape signature
+        (`__compiled__.<digest>.pax`), so calling this once per batch
+        bucket gives the serving warmup a full ladder to load from
+        disk instead of recompiling (ISSUE 8 satellite; the gap the old
+        single-file layout admitted)."""
         import os
         import pickle
         from jax.experimental import serialize_executable as se
@@ -178,7 +248,9 @@ class PaddlePredictor:
         payload = se.serialize(lowered.compile())
         sig["feed_shapes"] = {n: (tuple(a.shape), str(a.dtype))
                               for n, a in feeds.items()}
-        path = os.path.join(dirname, self.AOT_FILENAME)
+        digest = self._shape_digest(sig["feed_shapes"])
+        path = os.path.join(dirname,
+                            self.AOT_PREFIX + digest + self.AOT_SUFFIX)
         with open(path, "wb") as f:
             pickle.dump({"sig": sig, "payload": payload}, f)
         # integrity tag checked BEFORE unpickling at load (guards a
@@ -196,23 +268,42 @@ class PaddlePredictor:
         return path
 
     def load_compiled(self, dirname: str) -> bool:
-        """Load a serialized executable if present; returns whether
-        serving will skip compilation. Shape-mismatched inputs fall back
-        to the normal compile path at run().
+        """Load every serialized executable in ``dirname`` that matches
+        this program (one per feed-shape signature, plus the legacy
+        single-file name); returns whether any loaded. Inputs matching
+        no loaded signature fall back to the compile path at run() —
+        counted in paddle_serving_aot_fallback_total.
 
-        SECURITY: the artifact is a pickle (like any serialized XLA
-        executable it embeds callables) — ``dirname`` must be a TRUSTED
+        SECURITY: the artifacts are pickles (like any serialized XLA
+        executable they embed callables) — ``dirname`` must be a TRUSTED
         model directory, same trust level as the model program itself.
         The sha256 sidecar written by save_compiled is verified before
         unpickling, which catches corruption/truncation; it is not a
         defense against an attacker who can write the directory."""
+        import glob
+        import os
+        self._aot_load_attempted = True
+        paths = sorted(glob.glob(os.path.join(
+            dirname, self.AOT_PREFIX + "*" + self.AOT_SUFFIX)))
+        legacy = os.path.join(dirname, self.AOT_FILENAME)
+        if os.path.exists(legacy) and legacy not in paths:
+            paths.append(legacy)
+        loaded = dict(self._aot or {})
+        fingerprint = self._program_fingerprint()
+        for path in paths:
+            entry = self._load_one_aot(path, fingerprint)
+            if entry is not None:
+                exe, sig = entry
+                loaded[self._shape_digest(sig["feed_shapes"])] = (exe, sig)
+        self._aot = loaded
+        return bool(loaded)
+
+    def _load_one_aot(self, path: str, fingerprint: str):
         import hashlib
         import os
         import pickle
+        import warnings
         from jax.experimental import serialize_executable as se
-        path = os.path.join(dirname, self.AOT_FILENAME)
-        if not os.path.exists(path):
-            return False
         with open(path, "rb") as f:
             raw = f.read()
         digest_path = path + ".sha256"
@@ -220,42 +311,47 @@ class PaddlePredictor:
             with open(digest_path) as f:
                 want = f.read().strip()
             if hashlib.sha256(raw).hexdigest() != want:
-                import warnings
                 warnings.warn(
-                    "AOT executable failed its sha256 integrity check "
-                    "(corrupted or partially copied) — ignoring it; "
-                    "re-run save_compiled", stacklevel=2)
-                return False
-        blob = pickle.loads(raw)
-        sig = blob["sig"]
+                    f"AOT executable {os.path.basename(path)} failed its "
+                    f"sha256 integrity check (corrupted or partially "
+                    f"copied) — ignoring it; re-run save_compiled",
+                    stacklevel=3)
+                return None
+        try:
+            blob = pickle.loads(raw)
+            sig = blob["sig"]
+        except Exception:
+            warnings.warn(f"AOT executable {os.path.basename(path)} is "
+                          f"unreadable — ignoring it", stacklevel=3)
+            return None
         # the executable bakes in the traced program INCLUDING amp/nhwc
         # rewrites — a stale artifact or a predictor configured
         # differently must not serve silently different numerics
-        if sig.get("program_fingerprint") != self._program_fingerprint() \
+        if sig.get("program_fingerprint") != fingerprint \
                 or sig.get("fetch_names") != list(self._fetch_names):
-            import warnings
             warnings.warn(
-                "AOT executable was compiled for a different program "
-                "(graph changed or amp/nhwc rewrites differ) — ignoring "
-                "it; re-run save_compiled", stacklevel=2)
-            return False
-        self._aot = se.deserialize_and_load(*blob["payload"])
-        self._aot_meta = sig
-        return True
+                f"AOT executable {os.path.basename(path)} was compiled "
+                f"for a different program (graph changed or amp/nhwc "
+                f"rewrites differ) — ignoring it; re-run save_compiled",
+                stacklevel=3)
+            return None
+        try:
+            return se.deserialize_and_load(*blob["payload"]), sig
+        except Exception as e:
+            warnings.warn(f"AOT executable {os.path.basename(path)} "
+                          f"failed to deserialize ({type(e).__name__}) — "
+                          f"ignoring it", stacklevel=3)
+            return None
 
     def _run_aot(self, inputs) -> Optional[List[np.ndarray]]:
-        sig = self._aot_meta
-        feeds = {}
-        for n, (shape, dtype) in sig["feed_shapes"].items():
-            if n not in inputs:
-                return None
-            a = np.asarray(inputs[n])
-            if tuple(a.shape) != shape or str(a.dtype) != dtype:
-                return None               # signature miss: compile path
-            feeds[n] = a
+        entry = self._aot.get(self._digest_of_inputs(inputs))
+        if entry is None:
+            return None                   # signature miss: compile path
+        exe, sig = entry
+        feeds = {n: np.asarray(inputs[n]) for n in sig["feed_shapes"]}
         state, consts, feeds = self._aot_args(sig, feeds)
         try:
-            fetches, _ = self._aot(state, consts, feeds, np.uint32(0))
+            fetches, _ = exe(state, consts, feeds, np.uint32(0))
         except Exception as e:
             # some backends round-trip serialization but mis-map devices
             # on load (XLA:CPU under forced virtual device counts does) —
@@ -264,7 +360,8 @@ class PaddlePredictor:
             warnings.warn(f"AOT executable failed on this backend "
                           f"({type(e).__name__}); falling back to the "
                           f"compile path", stacklevel=3)
-            self._aot = None
+            self._aot = {}
+            self._count_fallback("backend_error")
             return None
         return [np.asarray(o) for o in fetches]
 

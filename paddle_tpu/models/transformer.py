@@ -177,6 +177,158 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
                      bias_attr=False)
 
 
+# ---------------------------------------------------------------------------
+# Decoder-only LM serving family (paddle_tpu/serving): one set of weights,
+# three program views that share every parameter NAME so a single scope
+# serves them all —
+#   "full"    — logits over the whole sequence via causal fused attention:
+#               the full-forward-per-token baseline (and the parity oracle).
+#   "prefill" — same causal forward over the prompt bucket, PLUS the
+#               layers.kv_attention_prefill cache side effect: per-layer
+#               persistable [B, S, H, D] K/V caches land in the scope.
+#   "decode"  — ONE token per call: embedding + per-row positional
+#               encoding at (seq_len + step), then kv_attention_decode
+#               over the cached keys — O(1) per token instead of a fresh
+#               full forward (ISSUE 8 / docs/serving.md).
+# Every parameter is explicitly named (LayerHelper's auto names are
+# globally unique, so cross-program sharing REQUIRES explicit names).
+# ---------------------------------------------------------------------------
+
+def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
+               vocab: int = 64, d_model: int = 32, d_inner: int = 64,
+               n_head: int = 2, n_layer: int = 2, name: str = "lm"):
+    """Emit the `mode` view ("full" | "prefill" | "decode") of the
+    decoder-only LM into the current default programs. Returns
+    (logits_var, feed_specs)."""
+    if mode not in ("full", "prefill", "decode"):
+        raise ValueError(f"decoder_lm mode {mode!r} not in "
+                         f"('full', 'prefill', 'decode')")
+    cache_len = prompt_len + max_new
+    d_k = d_model // n_head
+    main = fluid.default_main_program()
+    pe = _const_var(name + "_pos_enc",
+                    position_encoding(cache_len, d_model))
+
+    def attn_pa(i):
+        return fluid.ParamAttr(name=f"{name}_l{i}_attn")
+
+    def pa(pname):
+        return fluid.ParamAttr(name=f"{name}_{pname}")
+
+    if mode == "decode":
+        tok = layers.data(name="tok", shape=[1, 1], dtype="int64")
+        step = layers.data(name="step", shape=[1], dtype="int64",
+                           append_batch_size=False)
+        seq_len = layers.data(name="seq_len", shape=[1], dtype="int64")
+        feed_specs = {"tok": ([-1, 1, 1], "int64"),
+                      "step": ([1], "int64"),
+                      "seq_len": ([-1, 1], "int64")}
+        x_ids, t = tok, 1
+    else:
+        t = prompt_len if mode == "prefill" else cache_len
+        ids = layers.data(name="ids", shape=[t, 1], dtype="int64")
+        feed_specs = {"ids": ([-1, t, 1], "int64")}
+        x_ids = ids
+
+    emb = layers.embedding(x_ids, size=[vocab, d_model],
+                           param_attr=pa("emb"))
+    x = layers.scale(emb, scale=d_model ** 0.5)
+    if mode == "decode":
+        # semantic position of this token for row b is seq_len[b] + step
+        # (prompts are right-padded to the bucket; the cache SLOT is
+        # prompt_len + step — storage only, the mask orders attention)
+        pos_ids = layers.elementwise_add(seq_len, step)
+        pe_t = layers.gather(pe, pos_ids)                  # [B, M]
+        pe_t = layers.reshape(pe_t, shape=[-1, 1, d_model])
+        x = layers.elementwise_add(x, pe_t)
+    elif mode == "prefill" and t != cache_len:
+        pe_t = layers.slice(pe, axes=[0], starts=[0], ends=[t])
+        x = layers.elementwise_add(x, pe_t, axis=1)
+    else:
+        x = layers.elementwise_add(x, pe, axis=1)
+
+    for i in range(n_layer):
+        attn_in = layers.layer_norm(x, begin_norm_axis=2,
+                                    param_attr=pa(f"l{i}_ln1_scale"),
+                                    bias_attr=pa(f"l{i}_ln1_bias"))
+        if mode == "full":
+            attn = layers.fused_multi_head_attention(
+                attn_in, attn_in, d_model, n_head, causal=True,
+                param_attr=attn_pa(i))
+        else:
+            ck = main.global_block().create_var(
+                name=f"{name}_cache_k_{i}",
+                shape=[-1, cache_len, n_head, d_k], dtype="float32",
+                persistable=True, stop_gradient=True)
+            cv = main.global_block().create_var(
+                name=f"{name}_cache_v_{i}",
+                shape=[-1, cache_len, n_head, d_k], dtype="float32",
+                persistable=True, stop_gradient=True)
+            if mode == "prefill":
+                attn = layers.kv_attention_prefill(
+                    attn_in, d_model, n_head, ck, cv,
+                    param_attr=attn_pa(i))
+            else:
+                attn = layers.kv_attention_decode(
+                    attn_in, step, seq_len, d_model, n_head, ck, cv,
+                    prompt_len=prompt_len, param_attr=attn_pa(i))
+        x = layers.elementwise_add(x, attn)
+        ffn_in = layers.layer_norm(x, begin_norm_axis=2,
+                                   param_attr=pa(f"l{i}_ln2_scale"),
+                                   bias_attr=pa(f"l{i}_ln2_bias"))
+        h = layers.fc(ffn_in, size=d_inner, num_flatten_dims=2,
+                      act="relu", param_attr=pa(f"l{i}_ffn1_w"),
+                      bias_attr=pa(f"l{i}_ffn1_b"))
+        h = layers.fc(h, size=d_model, num_flatten_dims=2,
+                      param_attr=pa(f"l{i}_ffn2_w"),
+                      bias_attr=pa(f"l{i}_ffn2_b"))
+        x = layers.elementwise_add(x, h)
+
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=pa("lnf_scale"),
+                          bias_attr=pa("lnf_bias"))
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2,
+                       param_attr=pa("head_w"), bias_attr=False)
+    return logits, feed_specs
+
+
+def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
+                              vocab: int = 64, d_model: int = 32,
+                              d_inner: int = 64, n_head: int = 2,
+                              n_layer: int = 2, name: str = "lm",
+                              seed: int = 7, modes=("prefill", "decode",
+                                                    "full")):
+    """The serving program triple: {mode: (main, startup, feed_specs,
+    fetch_name)}. All three mains share every parameter name — run ONE
+    startup (any of them; they are identical) into a scope and it serves
+    prefill, decode, and the full-forward baseline alike."""
+    cfg = dict(prompt_len=prompt_len, max_new=max_new, vocab=vocab,
+               d_model=d_model, d_inner=d_inner, n_head=n_head,
+               n_layer=n_layer, name=name)
+    out = {}
+    for mode in modes:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            logits, feed_specs = decoder_lm(mode, **cfg)
+        main._is_test = True
+        out[mode] = (main, startup, feed_specs, logits.name)
+    return out
+
+
+def serve_lint_prefill():
+    """proglint --module entry (tools/test_runner.py pre-test gate):
+    builds the prefill serving program into the default programs."""
+    decoder_lm("prefill")
+
+
+def serve_lint_decode():
+    """proglint --module entry: the single-token KV-cache decode
+    program."""
+    decoder_lm("decode")
+
+
 def build(is_train: bool = True, src_vocab: int = 32000,
           tgt_vocab: int = 32000, max_len: int = 128, d_model: int = 512,
           d_inner: int = 2048, n_head: int = 8, n_layer: int = 6,

@@ -189,7 +189,12 @@ def _preregister_catalog():
                 "paddle_tpu.data.master_service",
                 "paddle_tpu.data.pipeline",
                 "paddle_tpu.fluid.sharded_io",
-                "paddle_tpu.fluid.io"):
+                "paddle_tpu.fluid.io",
+                # the model-server families (paddle_serving_*): request
+                # latency/outcomes, queue depth, batch occupancy, the
+                # zero-steady-state compile counter, and the predictor's
+                # AOT-fallback counter — import-light (docs/serving.md)
+                "paddle_tpu.serving.metrics"):
         try:
             importlib.import_module(mod)
         except Exception:     # a broken optional module must not kill

@@ -21,4 +21,5 @@ from paddle_tpu.ops import lod_ops  # noqa: F401
 from paddle_tpu.ops import ctc_ops  # noqa: F401
 from paddle_tpu.ops import quant_ops  # noqa: F401
 from paddle_tpu.ops import infra_ops  # noqa: F401
+from paddle_tpu.ops import kv_attention  # noqa: F401
 from paddle_tpu.ops import parallel_ops  # noqa: F401

@@ -1,0 +1,143 @@
+"""Decode-mode attention: the KV-cache op pair that turns autoregressive
+serving from O(T) full forwards into prefill + O(1)-per-token decode.
+
+Two inference-only ops (no VJP — serving programs are is_test), both
+spelled with the same numerics as ``ops/attention_block.py`` (fp32 MXU
+accumulation via preferred_element_type, softmax in fp32, probabilities
+applied in the storage dtype) so a prefill+decode transcript matches the
+full-forward graph token for token:
+
+- ``kv_attention_prefill`` — causal self-attention over the whole
+  (padded) prompt in one shot, PLUS the cache side effect: the K/V
+  projections land in ``[B, S, H, D]`` cache tensors (``S = cache_len =
+  prompt bucket + max new tokens``), zero beyond the prompt. The caches
+  are program outputs bound to PERSISTABLE vars, so ``CompiledBlock``
+  carries them into the serving scope (created_persistable) where the
+  decode program finds them.
+
+- ``kv_attention_decode`` — ONE new token per call: project q/k/v for
+  ``X [B, 1, M]``, write k/v into the cache at ``pos = prompt_len +
+  step`` (``jax.lax.dynamic_update_slice`` — pos is a traced scalar, so
+  every decode step runs the SAME executable; zero steady-state
+  compiles), then attend over the masked cache. The caches are read AND
+  written under the same var names, so they are donated state: the
+  update is in-place in HBM.
+
+Cache layout & masking (docs/serving.md):
+  cache[b, j] is valid for row b iff  j < seq_len[b]          (prompt)
+                                  or  prompt_len <= j <= pos  (generated)
+  Prompts are RIGHT-padded to the prompt bucket; generated tokens land
+  contiguously from ``prompt_len``. Each row's semantic position (for
+  the model's additive positional encoding, applied upstream at the
+  embedding) is ``seq_len[b] + step`` — slot index is storage only,
+  attention order comes entirely from the mask.
+
+The decode step's cost is O(S) in the STATIC cache length and
+independent of how many tokens were already emitted — ``analyzed_flops``
+of the decode executable is position-free by construction, the
+acceptance criterion tools/serve_bench.py measures.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import first, register_op
+
+from paddle_tpu.ops import attention_block as _ab
+
+
+def _scores_to_probs(s, mask, dt):
+    """fp32 scaled+masked scores -> storage-dtype probabilities, the
+    shared softmax spelling (mirrors attention_block._fwd_impl)."""
+    s = jnp.where(mask, s, _ab._NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return p.astype(dt)
+
+
+@register_op("kv_attention_prefill", no_grad=True,
+             ref="TPU-native serving op: causal attention + KV-cache "
+                 "population (decode counterpart of "
+                 "fused_attention_block; numerics per "
+                 "ops/attention_block.py)")
+def _kv_attention_prefill(ctx, ins, attrs):
+    """X [B,T,M], Wq/Wk/Wv/Wo [M,M] -> Out [B,T,M] (causal self-attn),
+    CacheK/CacheV [B,S,H,Dk] with [:, :T] = the K/V projections.
+    attrs: n_head, cache_len (S >= T)."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    h = int(attrs["n_head"])
+    cache_len = int(attrs["cache_len"])
+    b, t, m = x.shape
+    d = m // h
+    dt = x.dtype
+
+    q = _ab._proj(x, wq, h)                     # [B,T,H,D]
+    k = _ab._proj(x, wk, h)
+    v = _ab._proj(x, wv, h)
+
+    s = jax.lax.dot_general(q, k, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,T,T]
+    causal = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
+    p = _scores_to_probs(s, causal[None, None], dt)
+    c = jax.lax.dot_general(p, v, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+
+    pad = [(0, 0), (0, cache_len - t), (0, 0), (0, 0)]
+    cache_k = jnp.pad(k.astype(dt), pad)
+    cache_v = jnp.pad(v.astype(dt), pad)
+    return {"Out": [out], "CacheK": [cache_k], "CacheV": [cache_v]}
+
+
+@register_op("kv_attention_decode", no_grad=True,
+             ref="TPU-native serving op: one-token decode step over a "
+                 "static-shape KV cache (in-place dynamic_update_slice "
+                 "write; O(cache_len) cost, position-free executable)")
+def _kv_attention_decode(ctx, ins, attrs):
+    """X [B,1,M], Wq..Wo [M,M], CacheK/CacheV [B,S,H,Dk],
+    Step [1] int (tokens already generated), SeqLen [B,1] int (true
+    prompt lengths). attrs: n_head, prompt_len (the prompt BUCKET the
+    cache was prefilled at). Writes k/v at pos = prompt_len + step and
+    attends over {j < seq_len} ∪ {prompt_len <= j <= pos}."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    cache_k, cache_v = first(ins, "CacheK"), first(ins, "CacheV")
+    step = first(ins, "Step")
+    seq_len = first(ins, "SeqLen")
+    h = int(attrs["n_head"])
+    prompt_len = int(attrs["prompt_len"])
+    b, _, m = x.shape
+    s_len = cache_k.shape[1]
+    d = m // h
+    dt = x.dtype
+
+    q = _ab._proj(x, wq, h)                     # [B,1,H,D]
+    k_t = _ab._proj(x, wk, h).astype(cache_k.dtype)
+    v_t = _ab._proj(x, wv, h).astype(cache_v.dtype)
+
+    pos = jnp.asarray(step).reshape(-1)[0].astype(jnp.int32) + prompt_len
+    zero = jnp.zeros((), jnp.int32)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k_t,
+                                           (zero, pos, zero, zero))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v_t,
+                                           (zero, pos, zero, zero))
+
+    s = jax.lax.dot_general(q, cache_k, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,1,S]
+    j = jnp.arange(s_len, dtype=jnp.int32)
+    lens = jnp.asarray(seq_len).reshape(-1).astype(jnp.int32)   # [B]
+    valid = (j[None, :] < lens[:, None]) | \
+            ((j[None, :] >= prompt_len) & (j[None, :] <= pos))  # [B,S]
+    p = _scores_to_probs(s, valid[:, None, None, :], dt)
+    c = jax.lax.dot_general(p, cache_v, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+    return {"Out": [out], "CacheKOut": [cache_k], "CacheVOut": [cache_v]}

@@ -1,0 +1,127 @@
+"""Shape-bucket policy: which executables a served model warms, and how
+a runtime batch lands on one.
+
+Fixed-shape XLA (PAPERS: arXiv:1810.09868) makes every distinct feed
+shape a compile; a server that compiled per request shape would spend
+its life in the compiler. The policy here is the standard counter: a
+LADDER of batch buckets (powers of two up to ``max_batch`` by default —
+log2 many executables cover every batch size), each AOT-compiled at
+warmup; a request batch of n rows pads up to the nearest bucket
+(repeating the last row — always-valid inputs) and the bucket's rows
+are sliced back to n on the way out (``utils/padding.py`` is the shared
+arithmetic — the same helper that fixed the data-parallel feed path's
+silent full-batch replication).
+
+Occupancy (n / bucket) is exported per dispatched batch
+(``paddle_serving_batch_occupancy_ratio``); the continuous batcher's
+whole job is to keep it near 1 by coalescing queued requests before
+picking the bucket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.utils import padding as _padding
+
+
+@dataclass(frozen=True)
+class BucketPolicy:
+    """Batch-bucket ladder for one model. ``batch_buckets`` is sorted
+    ascending; ``max_batch`` == the largest bucket (an oversized batch
+    is chunked by it)."""
+
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+
+    def __post_init__(self):
+        if not self.batch_buckets:
+            raise ValueError("BucketPolicy needs at least one bucket")
+        object.__setattr__(self, "batch_buckets",
+                           tuple(sorted(set(int(b)
+                                            for b in self.batch_buckets))))
+        if self.batch_buckets[0] < 1:
+            raise ValueError("bucket sizes must be >= 1")
+
+    @classmethod
+    def pow2(cls, max_batch: int, min_batch: int = 1) -> "BucketPolicy":
+        return cls(tuple(_padding.pow2_buckets(max_batch, min_batch)))
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (callers chunk by max_batch first)."""
+        b = _padding.nearest_bucket(n, self.batch_buckets)
+        if b is None:
+            raise ValueError(
+                f"batch of {n} exceeds the largest bucket "
+                f"{self.max_batch}; chunk the request first")
+        return b
+
+    def chunks(self, n: int) -> List[int]:
+        """Split n rows into chunk sizes, each <= max_batch (all but the
+        last are exactly max_batch)."""
+        out = []
+        while n > self.max_batch:
+            out.append(self.max_batch)
+            n -= self.max_batch
+        if n:
+            out.append(n)
+        return out
+
+
+def pad_to_bucket(feeds: Dict[str, np.ndarray], bucket: int,
+                  batch_names: Optional[Sequence[str]] = None
+                  ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Pad every batch-carrying feed's leading dim up to ``bucket``
+    (last-row repeat). Returns (padded feeds, original n). Feeds whose
+    leading dim differs from the batch (a scalar step counter, a
+    resident table) are left alone — pass ``batch_names`` to be
+    explicit; by default the most common leading dim across feeds is
+    the batch (same vote the executor telemetry takes)."""
+    if batch_names is None:
+        votes: Dict[int, int] = {}
+        for v in feeds.values():
+            s = np.shape(v)
+            if len(s) >= 1:
+                votes[s[0]] = votes.get(s[0], 0) + 1
+        if not votes:
+            return dict(feeds), bucket
+        n = max(sorted(votes), key=lambda k: votes[k])
+        batch_names = [k for k, v in feeds.items()
+                       if len(np.shape(v)) >= 1 and np.shape(v)[0] == n]
+    else:
+        n = int(np.shape(feeds[batch_names[0]])[0])
+    out = dict(feeds)
+    for name in batch_names:
+        out[name] = _padding.pad_rows(np.asarray(feeds[name]), bucket)
+    return out, n
+
+
+def slice_outputs(outs: List[np.ndarray], n: int) -> List[np.ndarray]:
+    """Slice the padded rows back off every row-shaped output."""
+    return [_padding.slice_rows(o, n) for o in outs]
+
+
+@dataclass
+class FeedSignature:
+    """Per-example feed signature: the (name, per-row shape, dtype) set
+    requests must share to coalesce into one batch."""
+
+    items: Tuple[Tuple[str, Tuple[int, ...], str], ...] = field(
+        default_factory=tuple)
+
+    @classmethod
+    def of(cls, feeds: Dict[str, np.ndarray]) -> "FeedSignature":
+        items = []
+        for name in sorted(feeds):
+            a = np.asarray(feeds[name])
+            items.append((name, tuple(a.shape[1:]), str(a.dtype)))
+        return cls(tuple(items))
+
+    def __hash__(self):
+        return hash(self.items)

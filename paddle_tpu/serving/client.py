@@ -1,0 +1,190 @@
+"""Serving client: the resilience kit wrapped around the wire protocol.
+
+Every RPC runs under a :class:`~paddle_tpu.distributed.resilience.
+RetryPolicy` (full-jitter exponential backoff, bounded by attempts AND
+deadline) with each attempt gated by a :class:`CircuitBreaker` — a dead
+server fast-fails callers after the threshold instead of absorbing
+every client's full retry budget (the same kit the master and pserver
+clients ship; this is its "millions of users" edge).
+
+At-most-once for non-idempotent submits: the client mints ONE
+``request_id`` per logical call and resends it verbatim on every retry;
+the server's idempotency cache (serving/server.py) answers a retry of
+an already-executed request from the cache, so a reply lost to a
+dropped connection or a mid-request kill never re-executes the work
+(chaos witness: ``paddle_serving_requests_applied_total``).
+
+Typed rejections cross the wire as ``ok=false, kind=...`` and surface
+as the matching exception — a shed (:class:`RequestShedError`) is NOT
+retried: admission control only works if clients back off.
+
+Fault sites ``serving.rpc.send`` / ``serving.rpc.recv`` mirror the
+master client's, so one ``utils/faults`` plan drives the whole chaos
+story (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import uuid
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.distributed.resilience import (CircuitBreaker, RetryError,
+                                               RetryPolicy)
+from paddle_tpu.serving.server import (SERVING_ENV, ModelNotFoundError,
+                                       RequestShedError, decode_array,
+                                       encode_array)
+from paddle_tpu.utils import faults
+
+
+class ServingUnavailableError(ConnectionError):
+    """The serving endpoint could not be reached within the retry
+    budget; carries endpoint + attempts like MasterUnavailableError."""
+
+    def __init__(self, endpoint: str, attempts: int, elapsed_s: float,
+                 last: BaseException):
+        super().__init__(
+            f"serving endpoint {endpoint} unavailable after {attempts} "
+            f"attempt(s) over {elapsed_s:.2f}s (last error: {last!r})")
+        self.endpoint = endpoint
+        self.attempts = attempts
+
+
+class ServingRequestError(RuntimeError):
+    """The server executed (or rejected) the request and reported a
+    non-retryable application error."""
+
+    def __init__(self, kind: str, message: str):
+        super().__init__(message)
+        self.kind = kind
+
+
+_TYPED = {
+    "shed": RequestShedError,
+    "not_found": ModelNotFoundError,
+}
+
+
+class ServingClient:
+    """One persistent connection; reconnect-with-backoff under the retry
+    policy; breaker-gated attempts. Same wire idiom as MasterClient."""
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 timeout_s: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
+        endpoint = endpoint or os.environ.get(SERVING_ENV)
+        if not endpoint:
+            raise ValueError(
+                f"no serving endpoint: pass one or set {SERVING_ENV}")
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout_s
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=8, base_delay_s=0.02, max_delay_s=0.5,
+            deadline_s=30.0,
+            retryable=(ConnectionError, OSError, json.JSONDecodeError))
+        self._breaker = breaker or CircuitBreaker(
+            failure_threshold=5, reset_timeout_s=5.0, name="serving")
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._lock = threading.Lock()
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self):
+        self._close_sock()
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    def _close_sock(self):
+        for obj in (self._rfile, self._sock):
+            if obj is not None:
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+        self._sock = self._rfile = None
+
+    def _call(self, req: dict) -> dict:
+        def raw_attempt():
+            try:
+                if self._sock is None:
+                    self._connect()
+                faults.inject("serving.rpc.send")
+                self._sock.sendall((json.dumps(req) + "\n").encode())
+                faults.inject("serving.rpc.recv")
+                line = self._rfile.readline()
+                if not line:
+                    raise ConnectionError("server closed connection")
+                return json.loads(line)
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                self._close_sock()    # next attempt re-dials
+                raise
+
+        def attempt():
+            # breaker gates every attempt: once open, callers fast-fail
+            # (CircuitOpenError is a ConnectionError — the retry policy
+            # backs off through the cooldown instead of hammering)
+            resp = self._breaker.call(raw_attempt)
+            if not resp.get("ok"):
+                kind = resp.get("kind", "error")
+                exc = _TYPED.get(kind, ServingRequestError)
+                if exc is ServingRequestError:
+                    raise ServingRequestError(kind, resp.get("error", ""))
+                raise exc(resp.get("error", ""))
+            return resp
+
+        with self._lock:
+            try:
+                return self._retry.call(
+                    attempt, what=f"serving.{req.get('method')}")
+            except RetryError as e:
+                raise ServingUnavailableError(
+                    f"{self._addr[0]}:{self._addr[1]}", e.attempts,
+                    e.elapsed_s, e.__cause__) from e.__cause__
+
+    # -- API -------------------------------------------------------------
+    def ping(self) -> bool:
+        try:
+            return bool(self._call({"method": "ping"}).get("pong"))
+        except Exception:
+            return False
+
+    def models(self) -> list:
+        return self._call({"method": "models"})["models"]
+
+    def stats(self) -> dict:
+        return self._call({"method": "stats"})["stats"]
+
+    def infer(self, model: str, feeds: Dict[str, np.ndarray],
+              request_id: Optional[str] = None) -> list:
+        """One inference batch. The request_id is minted ONCE and reused
+        across retries — at-most-once application server-side."""
+        req_id = request_id or uuid.uuid4().hex
+        resp = self._call({
+            "method": "infer", "model": model, "req_id": req_id,
+            "feeds": {n: encode_array(np.asarray(v))
+                      for n, v in feeds.items()}})
+        return [decode_array(d) for d in resp["outputs"]]
+
+    def generate(self, model: str, prompts: Sequence,
+                 max_new: int,
+                 request_id: Optional[str] = None) -> list:
+        req_id = request_id or uuid.uuid4().hex
+        resp = self._call({
+            "method": "generate", "model": model, "req_id": req_id,
+            "prompts": [np.asarray(p, np.int64).reshape(-1).tolist()
+                        for p in prompts],
+            "max_new": int(max_new)})
+        return [np.asarray(t, np.int64) for t in resp["tokens"]]
+
+    def close(self):
+        with self._lock:
+            self._close_sock()

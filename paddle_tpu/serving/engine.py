@@ -1,0 +1,442 @@
+"""Serving engines: the per-model execution layer under the server.
+
+Two engine kinds, one discipline — every runtime dispatch lands on a
+shape signature that was WARMED (compiled or AOT-loaded) at startup, so
+steady-state serving performs zero XLA compilations
+(``serving.metrics.forbid_compiles`` turns the contract into an error;
+``paddle_serving_compilations_total`` is the witness):
+
+- :class:`ServedModel` — one-shot inference over a ``save_inference_model``
+  directory: a :class:`~paddle_tpu.inference.predictor.PaddlePredictor`
+  with one AOT executable per batch-bucket feed signature
+  (``save_compiled``/``load_compiled`` per bucket — the multi-signature
+  persistence satellite), requests padded to the nearest bucket and
+  sliced back (serving/bucketing.py).
+
+- :class:`GenerativeModel` — the transformer-family KV-cache decode
+  path: a prefill program (causal forward over the prompt bucket that
+  populates per-layer [B, S, H, D] caches in the model scope) plus a
+  single-token decode program whose static shapes make every decode
+  step the SAME executable (ops/kv_attention.py). Autoregressive
+  serving becomes prefill + O(1)-per-token decode instead of a fresh
+  full forward per token; ``analyzed_flops`` of the decode executable
+  is independent of the decode position by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving import bucketing
+from paddle_tpu.serving import metrics as smetrics
+from paddle_tpu.utils import padding as _padding
+
+
+class PromptTooLongError(ValueError):
+    """Typed admission rejection: the prompt exceeds the model's prompt
+    bucket (carried over the wire as kind='bad_request')."""
+
+
+# -- AOT executable persistence (shared by GenerativeModel; the
+# predictor has the same discipline inline) -------------------------------
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def save_executable(path: str, lowered) -> bool:
+    """Serialize a lowered+compiled executable with a sha256 sidecar.
+    Returns False (and writes nothing) when the backend does not
+    round-trip executable serialization."""
+    try:
+        from jax.experimental import serialize_executable as se
+        payload = se.serialize(lowered.compile())
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+        with open(path + ".sha256", "w") as f:
+            f.write(_sha256_file(path))
+        return True
+    except Exception:
+        return False
+
+
+def load_executable(path: str):
+    """Deserialize an executable saved by :func:`save_executable`; None
+    on any mismatch/corruption (caller falls back to the compile path).
+    SECURITY: pickle — the directory must be a trusted model dir, same
+    trust level as the model program itself (see predictor.py)."""
+    if not os.path.exists(path):
+        return None
+    digest_path = path + ".sha256"
+    if os.path.exists(digest_path):
+        with open(digest_path) as f:
+            want = f.read().strip()
+        if _sha256_file(path) != want:
+            import warnings
+            warnings.warn(f"AOT executable {path} failed its integrity "
+                          f"check — ignoring it", stacklevel=2)
+            return None
+    try:
+        from jax.experimental import serialize_executable as se
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        return se.deserialize_and_load(*payload)
+    except Exception:
+        return None
+
+
+class ServedModel:
+    """A saved inference model behind the bucket discipline.
+
+    ``warmup()`` loads (or compiles and persists) one AOT executable per
+    batch bucket; ``infer()`` pads a request batch to the nearest bucket,
+    dispatches, and slices the padded rows back off every output."""
+
+    def __init__(self, name: str, model_dir: str,
+                 policy: Optional[bucketing.BucketPolicy] = None,
+                 config=None):
+        from paddle_tpu.inference import AnalysisConfig, PaddlePredictor
+        self.name = name
+        self.model_dir = model_dir
+        self.policy = policy or bucketing.BucketPolicy()
+        if config is None:
+            config = AnalysisConfig(model_dir=model_dir)
+        config.model_tag = name
+        self.predictor = PaddlePredictor(config)
+        self._warmed: set = set()      # padded feed-shape signatures
+        block = self.predictor._program.desc.global_block
+        self.row_specs: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        for fname in self.predictor.get_input_names():
+            v = block.var(fname)
+            self.row_specs[fname] = (tuple(int(d) for d in v.shape[1:]),
+                                     v.dtype or "float32")
+
+    # -- warmup ----------------------------------------------------------
+    def _example_feeds(self, batch: int) -> Dict[str, np.ndarray]:
+        return {n: np.zeros((batch,) + shape, dtype=np.dtype(dtype))
+                for n, (shape, dtype) in self.row_specs.items()}
+
+    def _shape_sig(self, feeds) -> Tuple:
+        return tuple(sorted((n, tuple(np.shape(v)), str(
+            np.asarray(v).dtype)) for n, v in feeds.items()))
+
+    def warmup(self, aot_dir: Optional[str] = None,
+               persist: bool = True) -> Dict[str, int]:
+        """Warm every bucket: load its AOT executable from disk when
+        present, else compile (counted in
+        paddle_serving_compilations_total) and, with ``persist``,
+        serialize it next to the model so the NEXT process boots every
+        bucket without a compiler invocation. Returns
+        {"loaded": k, "compiled": m}."""
+        aot_dir = aot_dir or self.model_dir
+        self.predictor.load_compiled(aot_dir)
+        loaded = compiled = 0
+        for bucket in self.policy.batch_buckets:
+            feeds = self._example_feeds(bucket)
+            sig = self._shape_sig(feeds)
+            if self.predictor.has_aot_for(feeds):
+                loaded += 1
+            else:
+                smetrics.count_compile(self.name, "bucket")
+                compiled += 1
+                persisted = False
+                if persist:
+                    try:
+                        self.predictor.save_compiled(aot_dir, feeds)
+                        self.predictor.load_compiled(aot_dir)
+                        # check THIS bucket's executable specifically —
+                        # load_compiled returning True only says some
+                        # signature loaded
+                        persisted = self.predictor.has_aot_for(feeds)
+                    except Exception:
+                        persisted = False
+                if not persisted:
+                    # backend without executable serialization: warm the
+                    # JIT executable cache instead (still zero compiles
+                    # at steady state — the signature is now resident)
+                    self.predictor.run(feeds)
+            self._warmed.add(sig)
+        return {"loaded": loaded, "compiled": compiled}
+
+    # -- dispatch --------------------------------------------------------
+    def infer(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Pad-and-slice inference: n rows in, n rows out, executed on
+        bucket-shaped executables only. Oversized batches are chunked by
+        the largest bucket."""
+        n_total = int(np.shape(feeds[next(iter(feeds))])[0])
+        chunks = self.policy.chunks(n_total)
+        outs_per_chunk: List[List[np.ndarray]] = []
+        row0 = 0
+        for chunk_rows in chunks:
+            chunk = {n: np.asarray(v)[row0:row0 + chunk_rows]
+                     for n, v in feeds.items()}
+            row0 += chunk_rows
+            bucket = self.policy.bucket_for(chunk_rows)
+            padded, n = bucketing.pad_to_bucket(
+                chunk, bucket, batch_names=list(chunk))
+            sig = self._shape_sig(padded)
+            if sig not in self._warmed:
+                # an unwarmed signature compiles here — counted, and a
+                # hard error under forbid_compiles (steady state)
+                smetrics.count_compile(self.name, "steady_jit")
+                self._warmed.add(sig)
+            outs = self.predictor.run(padded)
+            outs_per_chunk.append(bucketing.slice_outputs(outs, n))
+        if len(outs_per_chunk) == 1:
+            return outs_per_chunk[0]
+        return [np.concatenate([c[i] for c in outs_per_chunk], axis=0)
+                for i in range(len(outs_per_chunk[0]))]
+
+
+class GenerativeModel:
+    """Prefill + KV-cache decode serving for the decoder-LM family.
+
+    Built from the program triple of
+    ``models.transformer.build_decoder_lm_programs`` (any model whose
+    programs share the same feed contract works): ``prefill`` consumes
+    ``ids [B, P, 1]`` and creates the per-layer caches in the model
+    scope; ``decode`` consumes ``tok [B, 1, 1] / step [1] /
+    seq_len [B, 1]`` and reads+writes the caches (donated state — the
+    cache update is in-place in HBM). Greedy decoding; one scope per
+    model, waves serialized by the server's batcher."""
+
+    def __init__(self, name: str, programs: Dict,
+                 policy: Optional[bucketing.BucketPolicy] = None,
+                 scope=None, init: bool = True):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.core.lowering import CompiledBlock
+        self.name = name
+        self.policy = policy or bucketing.BucketPolicy()
+        self.scope = scope or fluid.Scope()
+        pre_main, pre_start, pre_feeds, pre_fetch = programs["prefill"]
+        dec_main, dec_start, dec_feeds, dec_fetch = programs["decode"]
+        self.prompt_len = int(pre_feeds["ids"][0][1])
+        if init:
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(pre_start, scope=self.scope)
+        self._cb_prefill = CompiledBlock(
+            pre_main.desc, 0, sorted(pre_feeds), [pre_fetch],
+            is_test=True, donate=False)
+        self._cb_decode = CompiledBlock(
+            dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
+            is_test=True, donate=True)
+        # max_new from the cache length the decode block declares
+        cache_vars = [v for n, v in dec_main.desc.global_block.vars.items()
+                      if n.endswith("_cache_k_0")]
+        self.max_new = (int(cache_vars[0].shape[1]) - self.prompt_len
+                        if cache_vars else 0)
+        self._full = None
+        if "full" in programs:
+            full_main, _, full_feeds, full_fetch = programs["full"]
+            self._full = CompiledBlock(
+                full_main.desc, 0, sorted(full_feeds), [full_fetch],
+                is_test=True, donate=False)
+        self._warmed: set = set()          # (kind, batch_bucket)
+        self._aot: Dict[Tuple[str, int], object] = {}
+        self._fingerprint = hashlib.sha256(json.dumps(
+            [pre_main.desc.to_dict(), dec_main.desc.to_dict()],
+            sort_keys=True, default=str).encode()).hexdigest()
+
+    # -- plumbing --------------------------------------------------------
+    def _args(self, cb, feeds):
+        state = {n: self.scope.find_var(n) for n in cb.sig.state_names}
+        consts = {n: self.scope.find_var(n) for n in cb.sig.const_names}
+        return state, consts, feeds, np.uint32(0)
+
+    def _dispatch(self, kind: str, bucket: int, feeds) -> np.ndarray:
+        cb = self._cb_prefill if kind == "prefill" else self._cb_decode
+        args = self._args(cb, feeds)
+        aot = self._aot.get((kind, bucket))
+        if aot is not None:
+            try:
+                fetches, new_state = aot(*args)
+            except Exception:
+                # backend mis-mapped the deserialized executable: degrade
+                # to the (warmed) compile path for the rest of the run
+                self._aot.pop((kind, bucket), None)
+                fetches, new_state = cb.fn(*args)
+        else:
+            fetches, new_state = cb.fn(*args)
+        for n, v in new_state.items():
+            self.scope.set_var(n, v)
+        return np.asarray(fetches[0])
+
+    def _prefill_feeds(self, bucket: int):
+        return {"ids": np.zeros((bucket, self.prompt_len, 1), np.int64)}
+
+    def _decode_feeds(self, bucket: int, step: int = 0):
+        return {"tok": np.zeros((bucket, 1, 1), np.int64),
+                "step": np.asarray([step], np.int64),
+                "seq_len": np.full((bucket, 1), self.prompt_len,
+                                   np.int64)}
+
+    # -- warmup / AOT ----------------------------------------------------
+    def warmup(self, aot_dir: Optional[str] = None,
+               persist: bool = True) -> Dict[str, int]:
+        """Compile-or-load (prefill, decode) for every batch bucket. With
+        ``aot_dir``, serialized executables are loaded when present and
+        written after a compile, so a restarted server skips the
+        compiler entirely."""
+        loaded = compiled = 0
+        if aot_dir:
+            loaded += self.load_compiled(aot_dir)
+        for bucket in self.policy.batch_buckets:
+            for kind in ("prefill", "decode"):
+                if (kind, bucket) in self._warmed:
+                    continue
+                smetrics.count_compile(self.name, kind)
+                compiled += 1
+                if kind == "prefill":
+                    self._dispatch(kind, bucket,
+                                   self._prefill_feeds(bucket))
+                else:
+                    # the decode dispatch reads the cache state vars —
+                    # run a prefill at this bucket first so they exist
+                    # in the scope at the right shape even when the
+                    # prefill executable was AOT-loaded (no dispatch)
+                    self._dispatch("prefill", bucket,
+                                   self._prefill_feeds(bucket))
+                    self._dispatch(kind, bucket,
+                                   self._decode_feeds(bucket))
+                self._warmed.add((kind, bucket))
+                if aot_dir and persist:
+                    self._persist_one(aot_dir, kind, bucket)
+        return {"loaded": loaded, "compiled": compiled}
+
+    def _aot_path(self, dirname: str, kind: str, bucket: int) -> str:
+        return os.path.join(
+            dirname, f"__kv_{kind}_b{bucket}.{self._fingerprint[:12]}.pax")
+
+    def _persist_one(self, dirname: str, kind: str, bucket: int):
+        cb = self._cb_prefill if kind == "prefill" else self._cb_decode
+        feeds = (self._prefill_feeds(bucket) if kind == "prefill"
+                 else self._decode_feeds(bucket))
+        try:
+            lowered = cb.fn.lower(*self._args(cb, feeds))
+            save_executable(self._aot_path(dirname, kind, bucket), lowered)
+        except Exception:
+            pass
+
+    def load_compiled(self, dirname: str) -> int:
+        """Load every persisted (kind, bucket) executable matching this
+        program fingerprint; returns how many now serve without a
+        compile. The fingerprint hashes the program descs VERBATIM —
+        including generated intermediate var names, which restart
+        identically in a fresh process (the server-restart scenario
+        this serves) but shift if the programs are REbuilt inside one
+        process; a mismatch is safe, it just recompiles."""
+        n = 0
+        for bucket in self.policy.batch_buckets:
+            for kind in ("prefill", "decode"):
+                exe = load_executable(self._aot_path(dirname, kind,
+                                                     bucket))
+                if exe is not None:
+                    self._aot[(kind, bucket)] = exe
+                    self._warmed.add((kind, bucket))
+                    n += 1
+        return n
+
+    # -- generation ------------------------------------------------------
+    def generate(self, prompts: Sequence[np.ndarray],
+                 max_new: Optional[int] = None) -> List[np.ndarray]:
+        """Greedy-decode ``max_new`` tokens for each prompt (1-D int
+        arrays of length <= prompt bucket). One prefill + max_new decode
+        steps per wave, all on warmed static-shape executables."""
+        max_new = self.max_new if max_new is None else int(max_new)
+        if max_new > self.max_new:
+            raise ValueError(f"max_new {max_new} exceeds the cache "
+                             f"budget {self.max_new}")
+        n = len(prompts)
+        lens = np.array([len(p) for p in prompts], np.int64)
+        too_long = lens > self.prompt_len
+        if too_long.any():
+            raise PromptTooLongError(
+                f"{int(too_long.sum())} prompt(s) exceed the prompt "
+                f"bucket {self.prompt_len}")
+        bucket = self.policy.bucket_for(n)
+        for kind in ("prefill", "decode"):
+            if (kind, bucket) not in self._warmed:
+                smetrics.count_compile(self.name, f"steady_{kind}")
+                self._warmed.add((kind, bucket))
+        ids = np.zeros((bucket, self.prompt_len), np.int64)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = np.asarray(p, np.int64)
+        blens = _padding.pad_rows(lens[:, None], bucket)
+
+        logits = self._dispatch("prefill", bucket,
+                                {"ids": ids[:, :, None]})
+        smetrics.PREFILLS.labels(model=self.name).inc()
+        tok = logits[np.arange(bucket), blens[:, 0] - 1].argmax(-1)
+        out = [tok.astype(np.int64)]
+        for s in range(max_new - 1):
+            lg = self._dispatch(
+                "decode", bucket,
+                {"tok": out[-1][:, None, None],
+                 "step": np.asarray([s], np.int64), "seq_len": blens})
+            smetrics.DECODE_STEPS.labels(model=self.name).inc()
+            out.append(lg[:, 0].argmax(-1).astype(np.int64))
+        smetrics.TOKENS_GENERATED.labels(model=self.name).inc(
+            int(n * max_new))
+        toks = np.stack(out, axis=1)       # [bucket, max_new]
+        return [toks[i] for i in range(n)]
+
+    # -- baseline (bench/parity) ----------------------------------------
+    def full_forward_generate(self, prompts: Sequence[np.ndarray],
+                              max_new: Optional[int] = None
+                              ) -> List[np.ndarray]:
+        """The O(T)-per-token baseline: a fresh full causal forward for
+        every emitted token (requires the "full" program). Exists so
+        tools/serve_bench.py can measure the KV-cache speedup against
+        the exact same weights."""
+        if self._full is None:
+            raise RuntimeError("no 'full' program was provided")
+        max_new = self.max_new if max_new is None else int(max_new)
+        n = len(prompts)
+        lens = np.array([len(p) for p in prompts], np.int64)
+        bucket = self.policy.bucket_for(n)
+        t_total = self.prompt_len + self.max_new
+        seq = np.zeros((bucket, t_total), np.int64)
+        for i, p in enumerate(prompts):
+            seq[i, :len(p)] = np.asarray(p, np.int64)
+        blens = _padding.pad_rows(lens[:, None], bucket)[:, 0]
+        out = []
+        for s in range(max_new):
+            f, _ = self._full.fn(*self._args(
+                self._full, {"ids": seq[:, :, None]}))
+            logits = np.asarray(f[0])
+            tok = logits[np.arange(bucket), blens - 1 + s].argmax(-1)
+            out.append(tok.astype(np.int64))
+            # append each row's token right after its current end
+            # (blens + s <= prompt_len + max_new - 1 = t_total - 1)
+            seq[np.arange(bucket), blens + s] = out[-1]
+        toks = np.stack(out, axis=1)
+        return [toks[i] for i in range(n)]
+
+    def decode_flops(self, bucket: Optional[int] = None,
+                     step: int = 0):
+        """``analyzed_flops`` of the decode executable — independent of
+        the decode position by construction (static shapes; the
+        acceptance criterion's witness). Runs one prefill first so the
+        scope's cache state matches the probed bucket."""
+        bucket = bucket or self.policy.batch_buckets[0]
+        self._dispatch("prefill", bucket, self._prefill_feeds(bucket))
+        return self._cb_decode.analyzed_flops(
+            self.scope, self._decode_feeds(bucket, step))
+
+    def full_forward_flops(self, bucket: Optional[int] = None):
+        if self._full is None:
+            return None
+        bucket = bucket or self.policy.batch_buckets[0]
+        t_total = self.prompt_len + self.max_new
+        return self._full.analyzed_flops(
+            self.scope, {"ids": np.zeros((bucket, t_total, 1), np.int64)})

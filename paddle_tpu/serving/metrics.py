@@ -1,0 +1,132 @@
+"""Serving telemetry: every metric family the model server exports,
+declared in one place and preregistered in the exporter catalog
+(observability/exporters.py imports this module, so a scrape shows the
+full serving surface at zero before the first request).
+
+Label conventions follow docs/observability.md: ``model`` carries the
+operator-chosen model tag (bounded — the hosted-model set), ``cause`` /
+``outcome`` are enum-like strings, never ids or paths.
+
+The ``paddle_serving_compilations_total`` counter is serving's analogue
+of the autotune cache's measurement counter: warmup compiles count, and
+AFTER warmup the counter must stay flat across any mixed-shape load —
+batches land on compiled buckets via pad-and-slice, autoregressive
+decoding reuses one static-shape executable per bucket. The
+:func:`forbid_compiles` guard turns that contract from observed into
+ENFORCED (tests/test_serving.py), exactly like
+``passes.autotune.forbid_measurement`` does for timing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from paddle_tpu.observability import metrics as _metrics
+
+REQUEST_LATENCY = _metrics.histogram(
+    "paddle_serving_request_latency_seconds",
+    "End-to-end request latency (enqueue to reply ready); p50/p99 come "
+    "from the bucket counts", labelnames=("model",))
+REQUESTS = _metrics.counter(
+    "paddle_serving_requests_total",
+    "Requests by terminal outcome: ok | shed | error",
+    labelnames=("model", "outcome"))
+REQUESTS_APPLIED = _metrics.counter(
+    "paddle_serving_requests_applied_total",
+    "Requests actually EXECUTED (dedup-visible: a client retry answered "
+    "from the idempotency cache does not count — the at-most-once "
+    "witness the chaos suite asserts)", labelnames=("model",))
+QUEUE_DEPTH = _metrics.gauge(
+    "paddle_serving_queue_depth",
+    "Requests waiting in the model's admission queue",
+    labelnames=("model",))
+BATCH_OCCUPANCY = _metrics.gauge(
+    "paddle_serving_batch_occupancy_ratio",
+    "Real rows / bucket rows of the last dispatched batch (padding "
+    "waste is 1 - occupancy)", labelnames=("model",))
+BATCHES = _metrics.counter(
+    "paddle_serving_batches_total",
+    "Coalesced batches dispatched to an executable",
+    labelnames=("model",))
+COMPILATIONS = _metrics.counter(
+    "paddle_serving_compilations_total",
+    "Executable builds (bucket warmup, AOT-miss JIT). Must stay FLAT "
+    "after warmup — the zero-steady-state-compile contract "
+    "(forbid_compiles turns it into an error)",
+    labelnames=("model", "kind"))
+AOT_FALLBACK = _metrics.counter(
+    "paddle_serving_aot_fallback_total",
+    "PaddlePredictor.run dispatches that missed the AOT executable set "
+    "and fell back to JIT, by cause: no_artifact | shape_miss | "
+    "backend_error", labelnames=("model", "cause"))
+TOKENS_GENERATED = _metrics.counter(
+    "paddle_serving_tokens_generated_total",
+    "Tokens emitted by the KV-cache decode path", labelnames=("model",))
+DECODE_STEPS = _metrics.counter(
+    "paddle_serving_decode_steps_total",
+    "Single-token decode executable dispatches", labelnames=("model",))
+PREFILLS = _metrics.counter(
+    "paddle_serving_prefills_total",
+    "Prefill executable dispatches (one per generation wave)",
+    labelnames=("model",))
+
+
+class CompileForbiddenError(RuntimeError):
+    """An executable build was attempted under :func:`forbid_compiles` —
+    steady-state serving hit an unwarmed (model, bucket) signature."""
+
+
+# PROCESS-global (depth counter + lock), NOT thread-local: the server's
+# per-model batcher threads do the actual dispatching, so a guard taken
+# on the caller's thread must bind them too — same shape as
+# passes.autotune.forbid_measurement
+_forbid_lock = threading.Lock()
+_forbid_depth = 0
+
+
+def compiles_forbidden() -> bool:
+    return _forbid_depth > 0
+
+
+@contextlib.contextmanager
+def forbid_compiles():
+    """Turn any serving-layer executable build inside the with-block into
+    a :class:`CompileForbiddenError` — the enforcement arm of the
+    zero-steady-state-compilation contract (count_compile call sites).
+    Process-wide: builds attempted by the server's batcher threads while
+    the guard is held are rejected too."""
+    global _forbid_depth
+    with _forbid_lock:
+        _forbid_depth += 1
+    try:
+        yield
+    finally:
+        with _forbid_lock:
+            _forbid_depth -= 1
+
+
+def count_compile(model: str, kind: str):
+    """Record (and, under :func:`forbid_compiles`, reject) an executable
+    build. Call BEFORE the build so the forbidden case never compiles."""
+    if compiles_forbidden():
+        raise CompileForbiddenError(
+            f"serving executable build ({kind}) for model {model!r} "
+            f"attempted after warmup — steady-state serving must land "
+            f"every dispatch on a warmed bucket (docs/serving.md)")
+    COMPILATIONS.labels(model=model, kind=kind).inc()
+
+
+def latency_percentile(model: str, q: float) -> float:
+    """Percentile estimate (upper bucket bound) from the request-latency
+    histogram — how the load test asserts p50/p99 without a client-side
+    timer array. Returns 0.0 with no observations."""
+    hist = REQUEST_LATENCY.labels(model=model)
+    buckets, _, count = hist.snapshot()
+    if count <= 0:
+        return 0.0
+    target = q * count
+    for ub, cum in buckets:
+        if cum >= target:
+            return ub
+    return buckets[-1][0]

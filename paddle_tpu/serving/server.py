@@ -1,0 +1,491 @@
+"""The model server: N models, a bounded admission queue each, a
+continuous batcher per model, and a JSON/TCP front end.
+
+Request lifecycle (docs/serving.md):
+
+    client -> [admission: queue-depth bound -> typed shed]
+           -> per-model queue
+           -> batcher thread: coalesce compatible requests up to the
+              largest batch bucket (continuous batching: the batch is
+              formed from whatever is QUEUED when the executable frees
+              up, not from a fixed time window)
+           -> engine dispatch on a warmed bucket (pad-and-slice)
+           -> per-request latency observed, futures fulfilled
+
+Admission control: ``max_queue_depth`` bounds each model's queue;
+beyond it ``submit`` raises :class:`RequestShedError` (over the wire:
+``ok=false, kind="shed"`` — a TYPED rejection the client surfaces
+without retry, load-shedding instead of queue-collapsing).
+
+At-most-once: every request carries a ``request_id``; the server keeps
+a bounded idempotency cache of settled responses plus the in-flight
+future map, so a client retry (after a lost reply — the chaos suite's
+mid-request kill) either joins the in-flight request or is answered
+from the cache. ``paddle_serving_requests_applied_total`` counts only
+real executions: the chaos suite's witness that non-idempotent submits
+are applied at most once.
+
+The wire protocol mirrors data/master_service.py: one JSON object per
+line, arrays as base64(tobytes) + dtype + shape. Fault sites
+(``serving.handle``, ``serving.reply``) let utils/faults schedules
+inject delays, errors, and lost replies deterministically.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socketserver
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.serving import bucketing
+from paddle_tpu.serving import metrics as smetrics
+from paddle_tpu.serving.engine import (GenerativeModel, PromptTooLongError,
+                                       ServedModel)
+from paddle_tpu.utils import faults
+
+SERVING_ENV = "PADDLE_SERVING"
+
+
+class RequestShedError(RuntimeError):
+    """Typed admission rejection: the model's queue is at its depth
+    bound. NOT a connectivity error — clients must not blind-retry it
+    (back off / spill instead)."""
+
+
+class ModelNotFoundError(KeyError):
+    pass
+
+
+def encode_array(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def decode_array(d: dict) -> np.ndarray:
+    a = np.frombuffer(base64.b64decode(d["b64"]),
+                      dtype=np.dtype(d["dtype"]))
+    return a.reshape(d["shape"]).copy()
+
+
+class _Future:
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, result):
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, exc: BaseException):
+        self._exc = exc
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Request:
+    __slots__ = ("kind", "request_id", "feeds", "prompts", "max_new",
+                 "rows", "signature", "future", "t_enqueue")
+
+    def __init__(self, kind: str, request_id: str, rows: int,
+                 feeds=None, prompts=None, max_new=None, signature=None):
+        self.kind = kind                    # "infer" | "generate"
+        self.request_id = request_id
+        self.feeds = feeds
+        self.prompts = prompts
+        self.max_new = max_new
+        self.rows = rows
+        self.signature = signature
+        self.future = _Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class _HostedModel:
+    """One model's queue + batcher thread + idempotency cache."""
+
+    def __init__(self, name: str, engine, max_queue_depth: int,
+                 linger_s: float, dedup_capacity: int = 1024):
+        self.name = name
+        self.engine = engine
+        self.max_queue_depth = int(max_queue_depth)
+        self.linger_s = float(linger_s)
+        self.queue: deque = deque()
+        self.cond = threading.Condition()
+        self.running = True
+        self.inflight: Dict[str, _Request] = {}
+        self.settled: "OrderedDict[str, tuple]" = OrderedDict()
+        self.dedup_capacity = dedup_capacity
+        self.thread = threading.Thread(
+            target=self._batch_loop, daemon=True,
+            name=f"paddle-serving-{name}")
+        self.thread.start()
+
+    @property
+    def max_rows(self) -> int:
+        return self.engine.policy.max_batch
+
+    # -- admission -------------------------------------------------------
+    def submit(self, req: _Request) -> _Future:
+        with self.cond:
+            # at-most-once: a retry of a settled request answers from
+            # the cache; a retry of an in-flight one joins its future
+            hit = self.settled.get(req.request_id)
+            if hit is not None:
+                fut = _Future()
+                kind, payload = hit
+                if kind == "exc":
+                    fut.set_exception(payload)
+                else:
+                    fut.set_result(payload)
+                return fut
+            live = self.inflight.get(req.request_id)
+            if live is not None:
+                return live.future
+            if len(self.queue) >= self.max_queue_depth:
+                smetrics.REQUESTS.labels(model=self.name,
+                                         outcome="shed").inc()
+                raise RequestShedError(
+                    f"model {self.name!r} queue at depth bound "
+                    f"{self.max_queue_depth}; request shed")
+            self.queue.append(req)
+            self.inflight[req.request_id] = req
+            smetrics.QUEUE_DEPTH.labels(model=self.name).set(
+                len(self.queue))
+            self.cond.notify()
+        return req.future
+
+    # -- batching --------------------------------------------------------
+    def _take_wave(self) -> List[_Request]:
+        """Block for the first request, linger briefly for company, then
+        drain every queued request compatible with the first (same kind
+        and feed signature) up to the largest bucket's rows — the
+        continuous-batching coalesce step."""
+        with self.cond:
+            while self.running and not self.queue:
+                self.cond.wait(timeout=0.1)
+            if not self.running:
+                return []
+        if self.linger_s > 0:
+            time.sleep(self.linger_s)
+        wave: List[_Request] = []
+        rows = 0
+        with self.cond:
+            head = self.queue[0]
+            while self.queue:
+                req = self.queue[0]
+                if req.kind != head.kind \
+                        or req.signature != head.signature \
+                        or (wave and rows + req.rows > self.max_rows):
+                    break
+                self.queue.popleft()
+                wave.append(req)
+                rows += req.rows
+            smetrics.QUEUE_DEPTH.labels(model=self.name).set(
+                len(self.queue))
+        return wave
+
+    def _batch_loop(self):
+        while self.running:
+            try:
+                wave = self._take_wave()
+            except Exception:
+                continue
+            if not wave:
+                continue
+            try:
+                if wave[0].kind == "infer":
+                    self._run_infer_wave(wave)
+                else:
+                    self._run_generate_wave(wave)
+            except BaseException as e:   # engine error: fail the wave
+                self._settle_all(wave, exc=e)
+
+    def _run_infer_wave(self, wave: List[_Request]):
+        names = list(wave[0].feeds)
+        merged = {n: np.concatenate(
+            [np.asarray(r.feeds[n]) for r in wave], axis=0)
+            for n in names}
+        rows = sum(r.rows for r in wave)
+        bucket = (self.engine.policy.bucket_for(rows)
+                  if rows <= self.max_rows else self.max_rows)
+        smetrics.BATCH_OCCUPANCY.labels(model=self.name).set(
+            min(1.0, rows / bucket))
+        smetrics.BATCHES.labels(model=self.name).inc()
+        smetrics.REQUESTS_APPLIED.labels(model=self.name).inc(len(wave))
+        outs = self.engine.infer(merged)
+        row0 = 0
+        for r in wave:
+            part = [o[row0:row0 + r.rows] if np.ndim(o) >= 1 else o
+                    for o in outs]
+            row0 += r.rows
+            self._settle(r, result=part)
+
+    def _run_generate_wave(self, wave: List[_Request]):
+        prompts: List[np.ndarray] = []
+        for r in wave:
+            prompts.extend(r.prompts)
+        rows = len(prompts)
+        bucket = self.engine.policy.bucket_for(rows)
+        smetrics.BATCH_OCCUPANCY.labels(model=self.name).set(
+            min(1.0, rows / bucket))
+        smetrics.BATCHES.labels(model=self.name).inc()
+        smetrics.REQUESTS_APPLIED.labels(model=self.name).inc(len(wave))
+        max_new = max(r.max_new for r in wave)
+        toks = self.engine.generate(prompts, max_new=max_new)
+        i = 0
+        for r in wave:
+            part = [t[:r.max_new] for t in toks[i:i + len(r.prompts)]]
+            i += len(r.prompts)
+            self._settle(r, result=part)
+
+    # -- settlement ------------------------------------------------------
+    def _settle(self, req: _Request, result=None,
+                exc: Optional[BaseException] = None):
+        smetrics.REQUEST_LATENCY.labels(model=self.name).observe(
+            time.perf_counter() - req.t_enqueue)
+        smetrics.REQUESTS.labels(
+            model=self.name, outcome="error" if exc is not None
+            else "ok").inc()
+        with self.cond:
+            self.inflight.pop(req.request_id, None)
+            self.settled[req.request_id] = (
+                ("exc", exc) if exc is not None else ("ok", result))
+            while len(self.settled) > self.dedup_capacity:
+                self.settled.popitem(last=False)
+        if exc is not None:
+            req.future.set_exception(exc)
+        else:
+            req.future.set_result(result)
+
+    def _settle_all(self, wave: List[_Request], exc: BaseException):
+        for r in wave:
+            self._settle(r, exc=exc)
+
+    def stop(self):
+        self.running = False
+        with self.cond:
+            self.cond.notify_all()
+        self.thread.join(timeout=5)
+
+
+class ModelServer:
+    """Host N engines behind queues + batchers; optionally behind the
+    JSON/TCP front end (``serve()``). The observability scrape endpoint
+    (FLAGS_metrics_port, observability/exporters.py) exports every
+    serving family — start it with
+    ``observability.exporters.ensure_started()``."""
+
+    def __init__(self, linger_s: float = 0.002,
+                 max_queue_depth: int = 64):
+        self._models: Dict[str, _HostedModel] = {}
+        self._default_linger = linger_s
+        self._default_depth = max_queue_depth
+        self._rpc: Optional["_RpcServer"] = None
+        self._rpc_thread = None
+
+    # -- hosting ---------------------------------------------------------
+    def add_model(self, engine, max_queue_depth: Optional[int] = None,
+                  linger_s: Optional[float] = None,
+                  warmup: bool = True, aot_dir: Optional[str] = None):
+        """Host a :class:`ServedModel` or :class:`GenerativeModel`.
+        Warmup runs HERE (cold start pays the compiles or AOT loads;
+        steady state pays none)."""
+        name = engine.name
+        if name in self._models:
+            raise ValueError(f"model {name!r} already hosted")
+        if warmup:
+            if aot_dir is not None:
+                engine.warmup(aot_dir=aot_dir)
+            else:
+                engine.warmup()
+        self._models[name] = _HostedModel(
+            name, engine,
+            self._default_depth if max_queue_depth is None
+            else max_queue_depth,
+            self._default_linger if linger_s is None else linger_s)
+        return self._models[name]
+
+    def model(self, name: str) -> _HostedModel:
+        m = self._models.get(name)
+        if m is None:
+            raise ModelNotFoundError(
+                f"no model {name!r}; hosted: {sorted(self._models)}")
+        return m
+
+    def models(self) -> List[str]:
+        return sorted(self._models)
+
+    # -- in-process API (also the RPC handler's substrate) ---------------
+    def submit_infer(self, model: str, feeds: Dict[str, np.ndarray],
+                     request_id: Optional[str] = None) -> _Future:
+        m = self.model(model)
+        rows = int(np.shape(feeds[next(iter(feeds))])[0])
+        if rows > m.max_rows:
+            raise RequestShedError(
+                f"request batch {rows} exceeds the largest bucket "
+                f"{m.max_rows}; split the request")
+        req = _Request("infer", request_id or uuid.uuid4().hex, rows,
+                       feeds={n: np.asarray(v) for n, v in feeds.items()},
+                       signature=bucketing.FeedSignature.of(feeds))
+        return m.submit(req)
+
+    def submit_generate(self, model: str, prompts: Sequence,
+                        max_new: int,
+                        request_id: Optional[str] = None) -> _Future:
+        m = self.model(model)
+        prompts = [np.asarray(p, np.int64).reshape(-1) for p in prompts]
+        if len(prompts) > m.max_rows:
+            raise RequestShedError(
+                f"{len(prompts)} prompts exceed the largest bucket "
+                f"{m.max_rows}; split the request")
+        max_allowed = getattr(m.engine, "max_new", None)
+        if max_allowed is not None and max_new > max_allowed:
+            raise ValueError(f"max_new {max_new} exceeds the model's "
+                             f"cache budget {max_allowed}")
+        req = _Request("generate", request_id or uuid.uuid4().hex,
+                       len(prompts), prompts=prompts,
+                       max_new=int(max_new), signature="generate")
+        return m.submit(req)
+
+    def infer(self, model: str, feeds, request_id=None,
+              timeout: Optional[float] = 60.0):
+        return self.submit_infer(model, feeds, request_id).result(timeout)
+
+    def generate(self, model: str, prompts, max_new: int,
+                 request_id=None, timeout: Optional[float] = 120.0):
+        return self.submit_generate(model, prompts, max_new,
+                                    request_id).result(timeout)
+
+    def stats(self) -> dict:
+        out = {}
+        for name, m in self._models.items():
+            with m.cond:
+                depth = len(m.queue)
+                inflight = len(m.inflight)
+            out[name] = {
+                "queue_depth": depth, "inflight": inflight,
+                "max_queue_depth": m.max_queue_depth,
+                "buckets": list(m.engine.policy.batch_buckets),
+                "kind": type(m.engine).__name__}
+        return out
+
+    # -- RPC front end ---------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Bind the JSON/TCP front end (ephemeral port by default);
+        returns the endpoint string."""
+        self._rpc = _RpcServer((host, port), _RpcHandler)
+        self._rpc.model_server = self          # type: ignore[attr-defined]
+        self._rpc_thread = threading.Thread(
+            target=self._rpc.serve_forever,
+            kwargs={"poll_interval": 0.05}, daemon=True,
+            name="paddle-serving-rpc")
+        self._rpc_thread.start()
+        host, port = self._rpc.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def endpoint(self) -> Optional[str]:
+        if self._rpc is None:
+            return None
+        host, port = self._rpc.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self):
+        if self._rpc is not None:
+            self._rpc.shutdown()
+            self._rpc.server_close()
+            if self._rpc_thread is not None:
+                self._rpc_thread.join(timeout=5)
+            self._rpc = None
+        for m in self._models.values():
+            m.stop()
+
+
+class _RpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+# error kinds a client maps back to typed exceptions
+_ERROR_KINDS = {
+    RequestShedError: "shed",
+    ModelNotFoundError: "not_found",
+    PromptTooLongError: "bad_request",
+    ValueError: "bad_request",
+    TimeoutError: "timeout",
+}
+
+
+class _RpcHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: ModelServer = self.server.model_server  # type: ignore
+        while True:
+            try:
+                line = self.rfile.readline()
+            except (ConnectionError, OSError):
+                return
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                faults.inject("serving.handle")
+                resp = self._dispatch(server, req)
+            except Exception as e:
+                kind = "error"
+                for klass, k in _ERROR_KINDS.items():
+                    if isinstance(e, klass):
+                        kind = k
+                        break
+                resp = {"ok": False, "kind": kind,
+                        "error": f"{type(e).__name__}: {e}"}
+            try:
+                # a fault here models the mid-request kill: the request
+                # EXECUTED but the reply is lost — the client's retry
+                # with the same request_id must dedup server-side
+                faults.inject("serving.reply")
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except (ConnectionError, OSError, BrokenPipeError):
+                return
+
+    @staticmethod
+    def _dispatch(server: ModelServer, req: dict) -> dict:
+        method = req.get("method")
+        if method == "ping":
+            return {"ok": True, "pong": True}
+        if method == "models":
+            return {"ok": True, "models": server.models()}
+        if method == "stats":
+            return {"ok": True, "stats": server.stats()}
+        if method == "infer":
+            feeds = {n: decode_array(d)
+                     for n, d in (req.get("feeds") or {}).items()}
+            outs = server.infer(req["model"], feeds,
+                                request_id=req.get("req_id"))
+            return {"ok": True,
+                    "outputs": [encode_array(np.asarray(o))
+                                for o in outs]}
+        if method == "generate":
+            toks = server.generate(
+                req["model"],
+                [np.asarray(p, np.int64) for p in req["prompts"]],
+                max_new=int(req.get("max_new", 1)),
+                request_id=req.get("req_id"))
+            return {"ok": True,
+                    "tokens": [np.asarray(t).tolist() for t in toks]}
+        return {"ok": False, "kind": "bad_request",
+                "error": f"unknown method {method!r}"}

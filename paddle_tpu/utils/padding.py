@@ -1,0 +1,149 @@
+"""Batch pad-and-slice helpers: the one definition of "make this batch
+fit a compiled shape" the runtime shares.
+
+Two consumers need the same arithmetic:
+
+- the serving bucket policy (paddle_tpu/serving/bucketing.py): requests
+  coalesce to the nearest compiled batch bucket by padding rows up and
+  slicing fetch rows back — the fixed-shape XLA discipline's answer to
+  dynamic traffic (every distinct shape is a compile; buckets bound the
+  executable count);
+- the data-parallel feed path (core/executor.py): a batch whose leading
+  dim is not divisible by the mesh data axis used to be silently
+  REPLICATED to every device (core/lowering.py feed_sharding's old
+  warn-and-replicate branch — N devices each computing the full batch).
+  Now the executor pads the batch to the next multiple, shards it, and
+  slices the padded rows back off row-shaped fetches.
+
+Padding repeats the LAST ROW (``mode="edge"``) by default: repeated real
+rows are valid inputs for any op (in-vocab ids, finite floats), whereas
+zeros can be semantically loaded (id 0 is a real vocab entry; a zero
+image is an out-of-distribution input for a BN stat). The padded rows'
+outputs are sliced off; batch-REDUCED fetches (a mean loss) do see the
+padded rows — exactness there needs a divisible batch, and callers who
+care (the trainer's metric path) get a warning hook via
+``pad_plan.exact``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def next_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n`` (m <= 0 returns n)."""
+    if m <= 0:
+        return n
+    return ((int(n) + m - 1) // m) * m
+
+
+def pad_rows(arr: np.ndarray, target: int, mode: str = "edge") -> np.ndarray:
+    """Pad ``arr``'s leading dim up to ``target`` rows. ``mode``:
+    ``"edge"`` repeats the last row (always-valid inputs), ``"zero"``
+    appends zeros. A no-op when already at/over target."""
+    arr = np.asarray(arr)
+    n = arr.shape[0] if arr.ndim else 0
+    if arr.ndim == 0 or n >= target:
+        return arr
+    if n == 0:
+        raise ValueError("cannot pad an empty batch (no row to repeat)")
+    extra = target - n
+    if mode == "edge":
+        pad = np.repeat(arr[-1:], extra, axis=0)
+    elif mode == "zero":
+        pad = np.zeros((extra,) + arr.shape[1:], dtype=arr.dtype)
+    else:
+        raise ValueError(f"unknown pad mode {mode!r} (edge|zero)")
+    return np.concatenate([arr, pad], axis=0)
+
+
+def slice_rows(arr, n: int):
+    """Undo :func:`pad_rows` on a fetch: keep the first ``n`` rows when
+    the array actually carries a row axis (scalars pass through)."""
+    a = np.asarray(arr)
+    if a.ndim == 0 or a.shape[0] <= n:
+        return a
+    return a[:n]
+
+
+class PadPlan:
+    """Record of what a dispatch padded, so its fetches can be sliced.
+
+    ``pairs`` maps padded-batch-size -> original-batch-size for every
+    feed that was padded; a fetch whose leading dim matches a padded
+    size is sliced back to the original. ``exact`` is False when any
+    padding happened — batch-reduced fetches (means/sums over rows)
+    then include the padded rows.
+    """
+
+    def __init__(self):
+        self.pairs: Dict[int, int] = {}
+
+    @property
+    def exact(self) -> bool:
+        return not self.pairs
+
+    def note(self, original: int, padded: int):
+        if padded != original:
+            # first writer wins: two feeds padded a->b and c->b would be
+            # ambiguous; keep the smaller original (slice conservatively
+            # never drops real rows because callers pad per-batch feeds
+            # from the same request batch)
+            self.pairs.setdefault(padded, original)
+
+    def slice_fetch(self, arr):
+        a = np.asarray(arr)
+        if a.ndim == 0:
+            return a
+        orig = self.pairs.get(a.shape[0])
+        if orig is None:
+            return a
+        return a[:orig]
+
+
+def pad_feeds_to_multiple(feeds: Dict[str, np.ndarray], multiple: int,
+                          names: Optional[Iterable[str]] = None,
+                          mode: str = "edge"
+                          ) -> Tuple[Dict[str, np.ndarray], PadPlan]:
+    """Pad the leading dim of each feed in ``names`` (default: all) up to
+    the next multiple of ``multiple``. Returns the (possibly shared-
+    structure) new feed dict and the :class:`PadPlan` for fetch slicing."""
+    plan = PadPlan()
+    if multiple <= 1:
+        return feeds, plan
+    out = dict(feeds)
+    for name in (names if names is not None else list(feeds)):
+        arr = np.asarray(feeds[name])
+        if arr.ndim == 0:
+            continue
+        n = arr.shape[0]
+        target = next_multiple(n, multiple)
+        if target != n:
+            out[name] = pad_rows(arr, target, mode=mode)
+            plan.note(n, target)
+    return out, plan
+
+
+def nearest_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n exceeds every bucket (the
+    caller chunks by the largest bucket)."""
+    best = None
+    for b in sorted(buckets):
+        if b >= n:
+            best = b
+            break
+    return best
+
+
+def pow2_buckets(max_size: int, min_size: int = 1) -> List[int]:
+    """[min, ..., max] powers of two — the default bucket ladder (log2
+    many executables cover every batch size up to max)."""
+    out = []
+    b = max(1, int(min_size))
+    while b < max_size:
+        out.append(b)
+        b *= 2
+    out.append(int(max_size))
+    return sorted(set(out))

@@ -47,6 +47,12 @@ MODULES = [
     "paddle_tpu.observability.exporters",
     "paddle_tpu.passes",
     "paddle_tpu.passes.autotune",
+    "paddle_tpu.serving",
+    "paddle_tpu.serving.bucketing",
+    "paddle_tpu.serving.engine",
+    "paddle_tpu.serving.server",
+    "paddle_tpu.serving.client",
+    "paddle_tpu.serving.metrics",
 ]
 
 

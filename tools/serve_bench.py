@@ -1,0 +1,218 @@
+"""serve_bench: load generator + decode-path benchmark for the model
+server (paddle_tpu/serving; docs/serving.md).
+
+Two phases, one JSON row (default ``SERVE_r01.json``):
+
+1. **Decode benchmark** (the ISSUE 8 perf headline): greedy-generate
+   ``max_new`` tokens per prompt through (a) the prefill + KV-cache
+   decode path and (b) the full-forward-per-token baseline over the
+   SAME weights, and record tokens/s for both plus the speedup. Also
+   records ``analyzed_flops`` of the decode executable vs one full
+   forward — the flops-level witness that decode cost is flat in the
+   generated position.
+
+2. **Load test**: a ModelServer hosting a classifier ServedModel +
+   the generative model, hammered by concurrent client threads with
+   mixed batch sizes over the RPC front end; records requests/s,
+   tokens/s, batch occupancy, queue sheds, p50/p99 request latency
+   (from the exported histogram), and asserts the compile counter
+   stayed FLAT across the load (zero steady-state compiles).
+
+    python tools/serve_bench.py                  # defaults (T=64)
+    python tools/serve_bench.py --prompt-len 64 --max-new 64 --out SERVE_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_clf_model_dir(tmpdir: str):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[32], dtype="float32")
+        h = layers.fc(x, size=64, act="relu")
+        prob = layers.softmax(layers.fc(h, size=10))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    d = os.path.join(tmpdir, "clf")
+    os.makedirs(d, exist_ok=True)
+    fluid.io.save_inference_model(d, ["x"], [prob], exe,
+                                  main_program=main)
+    return d
+
+
+def bench_decode(args) -> dict:
+    """Tokens/s: KV-cache decode path vs full-forward-per-token."""
+    from paddle_tpu import serving
+    from paddle_tpu.models import transformer as T
+
+    progs = T.build_decoder_lm_programs(
+        prompt_len=args.prompt_len, max_new=args.max_new,
+        vocab=args.vocab, d_model=args.d_model, d_inner=4 * args.d_model,
+        n_head=args.n_head, n_layer=args.n_layer)
+    policy = serving.BucketPolicy((args.batch,))
+    gm = serving.GenerativeModel("lm", progs, policy)
+    t_warm0 = time.perf_counter()
+    gm.warmup()
+    warmup_s = time.perf_counter() - t_warm0
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, args.vocab, (args.prompt_len,))
+               for _ in range(args.batch)]
+
+    # full-forward baseline warm + measure
+    gm.full_forward_generate(prompts, max_new=2)        # warm the jit
+    t0 = time.perf_counter()
+    base_toks = gm.full_forward_generate(prompts, max_new=args.max_new)
+    base_s = time.perf_counter() - t0
+
+    with serving.forbid_compiles():                     # enforced, not observed
+        t0 = time.perf_counter()
+        kv_toks = gm.generate(prompts, max_new=args.max_new)
+        kv_s = time.perf_counter() - t0
+
+    n_tokens = args.batch * args.max_new
+    parity = all((a == b).all() for a, b in zip(base_toks, kv_toks))
+    dec_flops = gm.decode_flops()
+    full_flops = gm.full_forward_flops()
+    row = {
+        "config": {k: getattr(args, k) for k in
+                   ("prompt_len", "max_new", "batch", "vocab", "d_model",
+                    "n_head", "n_layer")},
+        "warmup_s": round(warmup_s, 3),
+        "decode_tokens_per_s": round(n_tokens / kv_s, 2),
+        "full_forward_tokens_per_s": round(n_tokens / base_s, 2),
+        "speedup": round(base_s / kv_s, 2),
+        "token_parity_with_baseline": parity,
+        "decode_step_flops": dec_flops,
+        "full_forward_flops": full_flops,
+        "decode_vs_full_flops_ratio": (
+            round(full_flops / dec_flops, 2)
+            if dec_flops and full_flops else None),
+    }
+    return row
+
+
+def bench_load(args) -> dict:
+    """Concurrent mixed-shape load over the RPC front end."""
+    import tempfile
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics as smetrics
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    clf_dir = build_clf_model_dir(tmp)
+    policy = serving.BucketPolicy.pow2(args.load_max_batch)
+    sm = serving.ServedModel("clf", clf_dir, policy)
+    server = serving.ModelServer(linger_s=0.001, max_queue_depth=256)
+    server.add_model(sm)
+    endpoint = server.serve()
+
+    compiles0 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    rng = np.random.RandomState(1)
+    errors: list = []
+    done = [0]
+    lock = threading.Lock()
+
+    def client_loop(n_requests: int, seed: int):
+        cl = serving.ServingClient(endpoint)
+        r = np.random.RandomState(seed)
+        try:
+            for _ in range(n_requests):
+                bs = int(r.choice([1, 2, 3, args.load_max_batch]))
+                cl.infer("clf",
+                         {"x": r.rand(bs, 32).astype(np.float32)})
+                with lock:
+                    done[0] += 1
+        except Exception as e:          # pragma: no cover - bench only
+            errors.append(repr(e))
+        finally:
+            cl.close()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client_loop,
+                                args=(args.load_requests, 100 + i))
+               for i in range(args.load_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    compiles1 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    server.stop()
+
+    reg = obs_metrics.default_registry()
+    snap = reg.snapshot()
+    shed = sum(s["value"] for s in
+               snap["paddle_serving_requests_total"]["samples"]
+               if s["labels"].get("outcome") == "shed")
+    row = {
+        "clients": args.load_clients,
+        "requests": done[0],
+        "requests_per_s": round(done[0] / elapsed, 2),
+        "p50_latency_s": smetrics.latency_percentile("clf", 0.5),
+        "p99_latency_s": smetrics.latency_percentile("clf", 0.99),
+        "batch_occupancy": round(
+            smetrics.BATCH_OCCUPANCY.labels(model="clf").value, 3),
+        "shed": shed,
+        "errors": errors[:5],
+        "steady_state_compiles": compiles1 - compiles0,
+    }
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--load-clients", type=int, default=4)
+    ap.add_argument("--load-requests", type=int, default=50,
+                    help="requests per client thread")
+    ap.add_argument("--load-max-batch", type=int, default=8)
+    ap.add_argument("--skip-load", action="store_true")
+    ap.add_argument("--out", default="SERVE_r01.json")
+    args = ap.parse_args(argv)
+
+    row = {"bench": "serving",
+           "device": os.environ.get("JAX_PLATFORMS", "auto"),
+           "decode": bench_decode(args)}
+    if not args.skip_load:
+        row["load"] = bench_load(args)
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), args.out) \
+        if not os.path.isabs(args.out) else args.out
+    with open(out, "w") as f:
+        json.dump(row, f, indent=2)
+        f.write("\n")
+    print(json.dumps(row, indent=2))
+    speedup = row["decode"]["speedup"]
+    print(f"serve_bench: decode speedup {speedup}x vs full-forward "
+          f"baseline at T={args.prompt_len} "
+          f"({'>=5x OK' if speedup >= 5 else 'BELOW the 5x target'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

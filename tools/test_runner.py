@@ -31,6 +31,14 @@ import sys
 # builds; the full zoo is covered by tests/test_analysis.py)
 LINT_MODELS = ("mnist", "smallnet")
 
+# the serving program pair (prefill + KV-cache decode) linted in is-test
+# mode — the exported executables the model server warms must stay
+# verifier-green (ISSUE 8 satellite; docs/serving.md)
+LINT_SERVING_MODULES = (
+    "paddle_tpu.models.transformer:serve_lint_prefill",
+    "paddle_tpu.models.transformer:serve_lint_decode",
+)
+
 
 def shard_files(all_files, shards, shard):
     return [f for i, f in enumerate(sorted(all_files))
@@ -58,6 +66,16 @@ def run_lint_gate(root: str, timeout: int) -> int:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         r = subprocess.run(cmd, cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # serving prefill/decode programs, linted as inference programs
+        print(f"test_runner: lint gate — proglint over serving programs "
+              f"{list(LINT_SERVING_MODULES)} (is-test)")
+        scmd = [sys.executable, os.path.join(root, "tools", "proglint.py"),
+                "--is-test"]
+        for m in LINT_SERVING_MODULES:
+            scmd += ["--module", m]
+        r = subprocess.run(scmd, cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
         # pass-pipeline smoke: apply ALL passes to the example programs
