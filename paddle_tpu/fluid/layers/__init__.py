@@ -12,7 +12,8 @@ from paddle_tpu.fluid.layers.nn import (  # noqa: F401
     clip, conv2d, conv2d_transpose,
     cos_sim, crf_decoding, cross_entropy, dropout, embedding, expand, fc,
     fused_linear_cross_entropy, fused_multi_head_attention,
-    kv_attention_prefill, kv_attention_decode,
+    kv_attention_prefill, kv_attention_prefill_slot, kv_attention_decode,
+    token_sample,
     gather, hsigmoid, huber_loss, l2_normalize, label_smooth, layer_norm,
     linear_chain_crf, log, matmul, mean, mul, nce, one_hot, pool2d,
     reduce_max, reduce_mean, reduce_min, reduce_prod, reduce_sum, reshape,

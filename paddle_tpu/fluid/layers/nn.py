@@ -679,15 +679,43 @@ def kv_attention_prefill(x, d_model, n_head, cache_k, cache_v,
     return out
 
 
-def kv_attention_decode(x, step, seq_len, d_model, n_head, cache_k,
-                        cache_v, prompt_len, param_attr=None, name=None):
-    """One-token decode step over the static-shape KV cache: writes this
-    token's k/v at ``prompt_len + step`` (in-place — the caches are read
-    and written under the same names, so they are donated state) and
-    attends over the per-row mask {j < seq_len} ∪ {prompt_len..pos}.
-    x [B, 1, M], step [1] int, seq_len [B, 1] int -> [B, 1, M]. The same
-    executable serves every decode position — zero steady-state
-    compiles (ops/kv_attention.py; docs/serving.md)."""
+def kv_attention_prefill_slot(x, slot, d_model, n_head, pool_k, pool_v,
+                              param_attr=None, name=None):
+    """In-flight-batching prefill: causal self-attention over the prompt
+    whose K/V rows are scattered into a LIVE pool cache
+    (``pool_k``/``pool_v``, persistable [n_slots, S, H, D] vars, read
+    and written under the same names — donated state) at the per-row
+    ``slot`` indices, so a new request joins a running decode without
+    disturbing the slots mid-flight. The whole [S, H, D] row is written
+    (zeros beyond the prompt), so a reused slot never leaks its previous
+    occupant. x [B, T, M], slot [B, 1] int -> [B, T, M]
+    (ops/kv_attention.py; docs/serving.md)."""
+    helper = LayerHelper("kv_attention_prefill_slot", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kv_attention_prefill_slot",
+                     inputs={"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+                             "Wv": [ws[2]], "Wo": [ws[3]],
+                             "PoolK": [pool_k], "PoolV": [pool_v],
+                             "Slot": [slot]},
+                     outputs={"Out": [out], "PoolKOut": [pool_k],
+                              "PoolVOut": [pool_v]},
+                     attrs={"n_head": int(n_head)})
+    return out
+
+
+def kv_attention_decode(x, pos, seq_len, gen_start, active, d_model,
+                        n_head, cache_k, cache_v, param_attr=None,
+                        name=None):
+    """One-token decode step over the static-shape KV cache with fully
+    per-row geometry: writes each active row's k/v at its own ``pos``
+    (in-place — the caches are read and written under the same names, so
+    they are donated state) and attends over the per-row mask
+    {j < seq_len} ∪ {gen_start <= j <= pos}; rows with ``active`` == 0
+    (free decode slots) leave their cache row untouched. x [B, 1, M],
+    pos/seq_len/gen_start/active [B, 1] int -> [B, 1, M]. The same
+    executable serves every decode position and every join/leave mix —
+    zero steady-state compiles (ops/kv_attention.py; docs/serving.md)."""
     helper = LayerHelper("kv_attention_decode", name=name)
     ws = _attention_projection_params(helper, d_model, param_attr)
     out = helper.create_variable_for_type_inference(x.dtype)
@@ -695,11 +723,32 @@ def kv_attention_decode(x, step, seq_len, d_model, n_head, cache_k,
                      inputs={"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
                              "Wv": [ws[2]], "Wo": [ws[3]],
                              "CacheK": [cache_k], "CacheV": [cache_v],
-                             "Step": [step], "SeqLen": [seq_len]},
+                             "Pos": [pos], "SeqLen": [seq_len],
+                             "GenStart": [gen_start],
+                             "Active": [active]},
                      outputs={"Out": [out], "CacheKOut": [cache_k],
                               "CacheVOut": [cache_v]},
-                     attrs={"n_head": int(n_head),
-                            "prompt_len": int(prompt_len)})
+                     attrs={"n_head": int(n_head)})
+    return out
+
+
+def token_sample(logits, temperature, top_k, seed, step_idx, name=None):
+    """On-device next-token selection (ops/kv_attention.py): greedy
+    argmax when ``temperature <= 0`` or ``top_k == 1`` (bit-identical to
+    a host argmax over the same logits — the parity oracle), otherwise
+    temperature-scaled top-k Gumbel sampling keyed ONLY by the
+    per-request ``seed`` and the ``step_idx`` token index, so a sampled
+    stream replays identically across processes and server restarts.
+    logits [B, V]; temperature [B, 1] float; top_k [B, 1] int (<=0: no
+    filter); seed/step_idx [B, 1] int -> [B, 1] int64."""
+    helper = LayerHelper("token_sample", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("token_sample",
+                     inputs={"Logits": [logits],
+                             "Temperature": [temperature],
+                             "TopK": [top_k], "Seed": [seed],
+                             "StepIdx": [step_idx]},
+                     outputs={"Out": [out]})
     return out
 
 

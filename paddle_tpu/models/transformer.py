@@ -179,33 +179,58 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
 
 # ---------------------------------------------------------------------------
 # Decoder-only LM serving family (paddle_tpu/serving): one set of weights,
-# three program views that share every parameter NAME so a single scope
+# several program views that share every parameter NAME so a single scope
 # serves them all —
-#   "full"    — logits over the whole sequence via causal fused attention:
-#               the full-forward-per-token baseline (and the parity oracle).
-#   "prefill" — same causal forward over the prompt bucket, PLUS the
-#               layers.kv_attention_prefill cache side effect: per-layer
-#               persistable [B, S, H, D] K/V caches land in the scope.
-#   "decode"  — ONE token per call: embedding + per-row positional
-#               encoding at (seq_len + step), then kv_attention_decode
-#               over the cached keys — O(1) per token instead of a fresh
-#               full forward (ISSUE 8 / docs/serving.md).
+#   "full"         — logits over the whole sequence via causal fused
+#                    attention: the full-forward-per-token baseline (and
+#                    the parity oracle).
+#   "prefill"      — same causal forward over a prompt bucket, PLUS the
+#                    layers.kv_attention_prefill cache side effect:
+#                    per-layer persistable [B, S, H, D] K/V caches land
+#                    in the scope. With a prompt bucket LADDER one
+#                    prefill view exists per bucket length (all writing
+#                    the same cache_len caches), so mixed-length traffic
+#                    doesn't pay worst-case prefill.
+#   "decode"       — ONE token per call with per-row geometry
+#                    (pos/seq_len/gen_start/active), O(1) per token
+#                    instead of a fresh full forward.
+#   "prefill_slot" — the in-flight-batching prefill: ONE request
+#                    (batch 1) whose K/V rows are scattered into the
+#                    [n_slots, S, H, D] POOL caches at a slot index;
+#                    fetches the first generated token, sampled
+#                    on-device (layers.token_sample).
+#   "decode_slot"  — one decode step over the WHOLE slot pool: a fully
+#                    static [n_slots]-row program (free slots ride along
+#                    masked) that samples each row's next token
+#                    on-device. This is the executable the in-flight
+#                    scheduler re-dispatches forever (ISSUE 9).
 # Every parameter is explicitly named (LayerHelper's auto names are
 # globally unique, so cross-program sharing REQUIRES explicit names).
 # ---------------------------------------------------------------------------
 
 def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                vocab: int = 64, d_model: int = 32, d_inner: int = 64,
-               n_head: int = 2, n_layer: int = 2, name: str = "lm"):
-    """Emit the `mode` view ("full" | "prefill" | "decode") of the
-    decoder-only LM into the current default programs. Returns
-    (logits_var, feed_specs)."""
-    if mode not in ("full", "prefill", "decode"):
-        raise ValueError(f"decoder_lm mode {mode!r} not in "
-                         f"('full', 'prefill', 'decode')")
-    cache_len = prompt_len + max_new
+               n_head: int = 2, n_layer: int = 2, name: str = "lm",
+               cache_len=None, n_slots=None):
+    """Emit the `mode` view ("full" | "prefill" | "decode" |
+    "prefill_slot" | "decode_slot") of the decoder-only LM into the
+    current default programs. ``cache_len`` decouples the cache size
+    from this view's prompt bucket (ladder prefills at P < P_max still
+    write full-size caches); slot modes need ``n_slots``. Returns
+    (output_var, feed_specs) — logits for full/prefill/decode, the
+    on-device-sampled next token for the slot views."""
+    _MODES = ("full", "prefill", "decode", "prefill_slot", "decode_slot")
+    if mode not in _MODES:
+        raise ValueError(f"decoder_lm mode {mode!r} not in {_MODES}")
+    if mode.endswith("_slot") and not n_slots:
+        raise ValueError(f"mode {mode!r} needs n_slots")
+    cache_len = int(cache_len) if cache_len else prompt_len + max_new
+    if prompt_len > cache_len:
+        raise ValueError(f"prompt_len {prompt_len} > cache_len "
+                         f"{cache_len}")
     d_k = d_model // n_head
     main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
     pe = _const_var(name + "_pos_enc",
                     position_encoding(cache_len, d_model))
 
@@ -215,15 +240,79 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     def pa(pname):
         return fluid.ParamAttr(name=f"{name}_{pname}")
 
+    # pool caches: persistable in main (read+written by the slot ops —
+    # donated state), zero-filled by startup. The startup fills are
+    # DEFERRED to after the whole net is built: rng is salted per
+    # startup-op index, so parameter initializers must sit at the same
+    # indices in every mode's startup for the views to share weights.
+    _pool_fills = []
+
+    def pool_var(pname):
+        shape = [int(n_slots), cache_len, n_head, d_k]
+        v = main.global_block().create_var(
+            name=pname, shape=shape, dtype="float32",
+            persistable=True, stop_gradient=True)
+        _pool_fills.append((pname, shape))
+        return v
+
     if mode == "decode":
         tok = layers.data(name="tok", shape=[1, 1], dtype="int64")
-        step = layers.data(name="step", shape=[1], dtype="int64",
-                           append_batch_size=False)
+        pos = layers.data(name="pos", shape=[1], dtype="int64")
         seq_len = layers.data(name="seq_len", shape=[1], dtype="int64")
+        gen_start = layers.data(name="gen_start", shape=[1],
+                                dtype="int64")
+        active = layers.data(name="active", shape=[1], dtype="int64")
         feed_specs = {"tok": ([-1, 1, 1], "int64"),
-                      "step": ([1], "int64"),
-                      "seq_len": ([-1, 1], "int64")}
+                      "pos": ([-1, 1], "int64"),
+                      "seq_len": ([-1, 1], "int64"),
+                      "gen_start": ([-1, 1], "int64"),
+                      "active": ([-1, 1], "int64")}
         x_ids, t = tok, 1
+    elif mode == "decode_slot":
+        S = int(n_slots)
+
+        def sdata(nm, shape, dtype="int64"):
+            return layers.data(name=nm, shape=shape, dtype=dtype,
+                               append_batch_size=False)
+        tok = sdata("tok", [S, 1, 1])
+        pos = sdata("pos", [S, 1])
+        seq_len = sdata("seq_len", [S, 1])
+        gen_start = sdata("gen_start", [S, 1])
+        active = sdata("active", [S, 1])
+        seed_in = sdata("seed", [S, 1])
+        sample_step = sdata("sample_step", [S, 1])
+        temp = sdata("temperature", [S, 1], "float32")
+        top_k = sdata("top_k", [S, 1])
+        feed_specs = {"tok": ([S, 1, 1], "int64"),
+                      "pos": ([S, 1], "int64"),
+                      "seq_len": ([S, 1], "int64"),
+                      "gen_start": ([S, 1], "int64"),
+                      "active": ([S, 1], "int64"),
+                      "seed": ([S, 1], "int64"),
+                      "sample_step": ([S, 1], "int64"),
+                      "temperature": ([S, 1], "float32"),
+                      "top_k": ([S, 1], "int64")}
+        x_ids, t = tok, 1
+    elif mode == "prefill_slot":
+        # one request at a time joins the pool (batch 1, static)
+        t = prompt_len
+
+        def sdata(nm, shape, dtype="int64"):
+            return layers.data(name=nm, shape=shape, dtype=dtype,
+                               append_batch_size=False)
+        ids = sdata("ids", [1, t, 1])
+        slot = sdata("slot", [1, 1])
+        seq_len = sdata("seq_len", [1, 1])
+        seed_in = sdata("seed", [1, 1])
+        temp = sdata("temperature", [1, 1], "float32")
+        top_k = sdata("top_k", [1, 1])
+        feed_specs = {"ids": ([1, t, 1], "int64"),
+                      "slot": ([1, 1], "int64"),
+                      "seq_len": ([1, 1], "int64"),
+                      "seed": ([1, 1], "int64"),
+                      "temperature": ([1, 1], "float32"),
+                      "top_k": ([1, 1], "int64")}
+        x_ids = ids
     else:
         t = prompt_len if mode == "prefill" else cache_len
         ids = layers.data(name="ids", shape=[t, 1], dtype="int64")
@@ -233,15 +322,17 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     emb = layers.embedding(x_ids, size=[vocab, d_model],
                            param_attr=pa("emb"))
     x = layers.scale(emb, scale=d_model ** 0.5)
-    if mode == "decode":
-        # semantic position of this token for row b is seq_len[b] + step
-        # (prompts are right-padded to the bucket; the cache SLOT is
-        # prompt_len + step — storage only, the mask orders attention)
-        pos_ids = layers.elementwise_add(seq_len, step)
+    if mode in ("decode", "decode_slot"):
+        # semantic position of this token for row b is
+        # seq_len[b] + generated-so-far = seq_len + (pos - gen_start)
+        # (prompts are right-padded to their bucket; the cache SLOT is
+        # storage only, the mask orders attention)
+        gen = layers.elementwise_sub(pos, gen_start)
+        pos_ids = layers.elementwise_add(seq_len, gen)
         pe_t = layers.gather(pe, pos_ids)                  # [B, M]
         pe_t = layers.reshape(pe_t, shape=[-1, 1, d_model])
         x = layers.elementwise_add(x, pe_t)
-    elif mode == "prefill" and t != cache_len:
+    elif t != cache_len:
         pe_t = layers.slice(pe, axes=[0], starts=[0], ends=[t])
         x = layers.elementwise_add(x, pe_t, axis=1)
     else:
@@ -255,6 +346,17 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
             attn = layers.fused_multi_head_attention(
                 attn_in, attn_in, d_model, n_head, causal=True,
                 param_attr=attn_pa(i))
+        elif mode.endswith("_slot"):
+            pk = pool_var(f"{name}_slot_k_{i}")
+            pv = pool_var(f"{name}_slot_v_{i}")
+            if mode == "prefill_slot":
+                attn = layers.kv_attention_prefill_slot(
+                    attn_in, slot, d_model, n_head, pk, pv,
+                    param_attr=attn_pa(i))
+            else:
+                attn = layers.kv_attention_decode(
+                    attn_in, pos, seq_len, gen_start, active, d_model,
+                    n_head, pk, pv, param_attr=attn_pa(i))
         else:
             ck = main.global_block().create_var(
                 name=f"{name}_cache_k_{i}",
@@ -270,8 +372,8 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                     param_attr=attn_pa(i))
             else:
                 attn = layers.kv_attention_decode(
-                    attn_in, step, seq_len, d_model, n_head, ck, cv,
-                    prompt_len=prompt_len, param_attr=attn_pa(i))
+                    attn_in, pos, seq_len, gen_start, active, d_model,
+                    n_head, ck, cv, param_attr=attn_pa(i))
         x = layers.elementwise_add(x, attn)
         ffn_in = layers.layer_norm(x, begin_norm_axis=2,
                                    param_attr=pa(f"l{i}_ln2_scale"),
@@ -289,6 +391,30 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                           bias_attr=pa("lnf_bias"))
     logits = layers.fc(x, size=vocab, num_flatten_dims=2,
                        param_attr=pa("head_w"), bias_attr=False)
+
+    # startup pool fills go AFTER every param initializer (rng-salt
+    # stability across modes — see pool_var above)
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    for pname, shape in _pool_fills:
+        sv = startup.global_block().create_var(
+            name=pname, shape=shape, dtype="float32", persistable=True)
+        ConstantInitializer(0.0)(sv, startup.global_block())
+
+    if mode == "prefill_slot":
+        # first generated token, sampled on-device from the logits row
+        # at the prompt's true end (batch 1: flatten [1,P,V] -> [P,V])
+        flat = layers.reshape(logits, shape=[-1, vocab])
+        one = layers.fill_constant([1, 1], "int64", 1)
+        last_idx = layers.elementwise_sub(seq_len, one)
+        last = layers.gather(flat, last_idx)               # [1, V]
+        zero = layers.fill_constant([1, 1], "int64", 0)
+        tok_out = layers.token_sample(last, temp, top_k, seed_in, zero)
+        return tok_out, feed_specs
+    if mode == "decode_slot":
+        flat = layers.reshape(logits, shape=[-1, vocab])   # [S, V]
+        tok_out = layers.token_sample(flat, temp, top_k, seed_in,
+                                      sample_step)
+        return tok_out, feed_specs
     return logits, feed_specs
 
 
@@ -297,23 +423,46 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
                               d_inner: int = 64, n_head: int = 2,
                               n_layer: int = 2, name: str = "lm",
                               seed: int = 7, modes=("prefill", "decode",
-                                                    "full")):
-    """The serving program triple: {mode: (main, startup, feed_specs,
-    fetch_name)}. All three mains share every parameter name — run ONE
-    startup (any of them; they are identical) into a scope and it serves
-    prefill, decode, and the full-forward baseline alike."""
-    cfg = dict(prompt_len=prompt_len, max_new=max_new, vocab=vocab,
-               d_model=d_model, d_inner=d_inner, n_head=n_head,
-               n_layer=n_layer, name=name)
+                                                    "full"),
+                              prompt_buckets=None, n_slots=None):
+    """The serving program family: {key: (main, startup, feed_specs,
+    fetch_name)}. All mains share every parameter name — run ONE startup
+    (any of them; their parameter initializers are identical) into a
+    scope and it serves every view alike.
+
+    ``prompt_buckets`` (ascending lengths, largest == prompt_len) emits
+    one prefill view PER bucket — keys ``prefill@P`` (and
+    ``prefill_slot@P`` when slot modes are requested), with the bare
+    mode name aliased to the largest bucket. ``n_slots`` sizes the
+    decode slot pool for the "prefill_slot"/"decode_slot" views
+    (in-flight batching, ISSUE 9)."""
+    cache_len = prompt_len + max_new
+    buckets = tuple(sorted(set(int(b)
+                               for b in (prompt_buckets or (prompt_len,)))))
+    if buckets[-1] != prompt_len:
+        raise ValueError(f"largest prompt bucket {buckets[-1]} must "
+                         f"equal prompt_len {prompt_len}")
+    cfg = dict(max_new=max_new, vocab=vocab, d_model=d_model,
+               d_inner=d_inner, n_head=n_head, n_layer=n_layer,
+               name=name, cache_len=cache_len, n_slots=n_slots)
     out = {}
-    for mode in modes:
+
+    def emit(key, mode, p_len):
         main, startup = fluid.Program(), fluid.Program()
         main.random_seed = seed
         startup.random_seed = seed
         with fluid.program_guard(main, startup):
-            logits, feed_specs = decoder_lm(mode, **cfg)
+            outv, feed_specs = decoder_lm(mode, prompt_len=p_len, **cfg)
         main._is_test = True
-        out[mode] = (main, startup, feed_specs, logits.name)
+        out[key] = (main, startup, feed_specs, outv.name)
+
+    for mode in modes:
+        if mode in ("prefill", "prefill_slot"):
+            for p in buckets:
+                emit(f"{mode}@{p}", mode, p)
+            out[mode] = out[f"{mode}@{buckets[-1]}"]
+        else:
+            emit(mode, mode, prompt_len)
     return out
 
 
@@ -325,8 +474,20 @@ def serve_lint_prefill():
 
 def serve_lint_decode():
     """proglint --module entry: the single-token KV-cache decode
-    program."""
+    program (per-row pos/seq_len/gen_start/active geometry)."""
     decoder_lm("decode")
+
+
+def serve_lint_prefill_slot():
+    """proglint --module entry: the in-flight-batching prefill that
+    scatters one request's K/V into the slot-pool caches."""
+    decoder_lm("prefill_slot", n_slots=4)
+
+
+def serve_lint_decode_slot():
+    """proglint --module entry: the slot-pool decode step with on-device
+    token sampling (the in-flight scheduler's executable)."""
+    decoder_lm("decode_slot", n_slots=4)
 
 
 def build(is_train: bool = True, src_vocab: int = 32000,

@@ -1,41 +1,58 @@
-"""Decode-mode attention: the KV-cache op pair that turns autoregressive
-serving from O(T) full forwards into prefill + O(1)-per-token decode.
+"""Decode-mode attention: the KV-cache op family that turns
+autoregressive serving from O(T) full forwards into prefill +
+O(1)-per-token decode — and, since ISSUE 9, lets requests join and
+leave a RUNNING decode without recompiling anything.
 
-Two inference-only ops (no VJP — serving programs are is_test), both
-spelled with the same numerics as ``ops/attention_block.py`` (fp32 MXU
+Inference-only ops (no VJP — serving programs are is_test), all spelled
+with the same numerics as ``ops/attention_block.py`` (fp32 MXU
 accumulation via preferred_element_type, softmax in fp32, probabilities
 applied in the storage dtype) so a prefill+decode transcript matches the
 full-forward graph token for token:
 
 - ``kv_attention_prefill`` — causal self-attention over the whole
   (padded) prompt in one shot, PLUS the cache side effect: the K/V
-  projections land in ``[B, S, H, D]`` cache tensors (``S = cache_len =
-  prompt bucket + max new tokens``), zero beyond the prompt. The caches
-  are program outputs bound to PERSISTABLE vars, so ``CompiledBlock``
-  carries them into the serving scope (created_persistable) where the
-  decode program finds them.
+  projections land in ``[B, S, H, D]`` cache tensors (``S = cache_len``,
+  zero beyond the prompt). The caches are program outputs bound to
+  PERSISTABLE vars, so ``CompiledBlock`` carries them into the serving
+  scope (created_persistable) where the decode program finds them.
 
-- ``kv_attention_decode`` — ONE new token per call: project q/k/v for
-  ``X [B, 1, M]``, write k/v into the cache at ``pos = prompt_len +
-  step`` (``jax.lax.dynamic_update_slice`` — pos is a traced scalar, so
-  every decode step runs the SAME executable; zero steady-state
-  compiles), then attend over the masked cache. The caches are read AND
-  written under the same var names, so they are donated state: the
-  update is in-place in HBM.
+- ``kv_attention_prefill_slot`` — the in-flight-batching prefill: same
+  causal attention, but the K/V rows are scattered into a POOL cache
+  ``[n_slots, S, H, D]`` at per-row slot indices (``Slot [B, 1]``), so a
+  new request's cache joins a live pool without disturbing the slots
+  that are mid-decode. The whole ``[S, H, D]`` row is written (zeros
+  beyond the prompt), so a reused slot never leaks its previous
+  occupant's keys.
+
+- ``kv_attention_decode`` — ONE new token per ROW per call, with fully
+  per-row geometry: ``Pos [B,1]`` is each row's cache write index,
+  ``GenStart [B,1]`` is where its generated region begins (the prompt
+  bucket it was prefilled at), ``SeqLen [B,1]`` its true prompt length,
+  and ``Active [B,1]`` gates the cache write — an inactive (free) slot
+  flows through the batch untouched. Every decode step of every mix of
+  in-flight requests runs the SAME static-shape executable: zero
+  steady-state compiles. (The wave-per-batch path is the special case
+  Pos = GenStart + step, Active = 1.)
+
+- ``token_sample`` — on-device next-token selection: greedy argmax when
+  ``temperature <= 0`` or ``top_k == 1`` (bit-identical to host argmax
+  over the same logits), otherwise temperature-scaled top-k sampling via
+  the Gumbel trick with a key derived ONLY from the per-request
+  ``Seed`` and the token index — reproducible across processes and
+  server restarts, independent of the framework step seed.
 
 Cache layout & masking (docs/serving.md):
-  cache[b, j] is valid for row b iff  j < seq_len[b]          (prompt)
-                                  or  prompt_len <= j <= pos  (generated)
-  Prompts are RIGHT-padded to the prompt bucket; generated tokens land
-  contiguously from ``prompt_len``. Each row's semantic position (for
-  the model's additive positional encoding, applied upstream at the
-  embedding) is ``seq_len[b] + step`` — slot index is storage only,
-  attention order comes entirely from the mask.
+  cache[b, j] is valid for row b iff  j < seq_len[b]            (prompt)
+                                  or  gen_start[b] <= j <= pos[b]  (gen)
+  Prompts are RIGHT-padded to their prompt bucket; generated tokens land
+  contiguously from ``gen_start``. Each row's semantic position (for the
+  model's additive positional encoding, applied upstream at the
+  embedding) is ``seq_len[b] + (pos[b] - gen_start[b])`` — slot index is
+  storage only, attention order comes entirely from the mask.
 
 The decode step's cost is O(S) in the STATIC cache length and
 independent of how many tokens were already emitted — ``analyzed_flops``
-of the decode executable is position-free by construction, the
-acceptance criterion tools/serve_bench.py measures.
+of the decode executable is position-free by construction.
 """
 
 from __future__ import annotations
@@ -56,6 +73,28 @@ def _scores_to_probs(s, mask, dt):
     return p.astype(dt)
 
 
+def _causal_prefill(x, wq, wk, wv, wo, h):
+    """Shared prefill math: causal self-attention over X [B,T,M] plus
+    the K/V projections ([B,T,H,D]) the caller caches."""
+    b, t, m = x.shape
+    d = m // h
+    dt = x.dtype
+    q = _ab._proj(x, wq, h)                     # [B,T,H,D]
+    k = _ab._proj(x, wk, h)
+    v = _ab._proj(x, wv, h)
+    s = jax.lax.dot_general(q, k, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,T,T]
+    causal = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
+    p = _scores_to_probs(s, causal[None, None], dt)
+    c = jax.lax.dot_general(p, v, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+    return out, k, v
+
+
 @register_op("kv_attention_prefill", no_grad=True,
              ref="TPU-native serving op: causal attention + KV-cache "
                  "population (decode counterpart of "
@@ -69,71 +108,88 @@ def _kv_attention_prefill(ctx, ins, attrs):
     wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
     h = int(attrs["n_head"])
     cache_len = int(attrs["cache_len"])
-    b, t, m = x.shape
-    d = m // h
+    t = x.shape[1]
     dt = x.dtype
-
-    q = _ab._proj(x, wq, h)                     # [B,T,H,D]
-    k = _ab._proj(x, wk, h)
-    v = _ab._proj(x, wv, h)
-
-    s = jax.lax.dot_general(q, k, (((3,), (3,)), ((0, 2), (0, 2))),
-                            preferred_element_type=jnp.float32)
-    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,T,T]
-    causal = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
-    p = _scores_to_probs(s, causal[None, None], dt)
-    c = jax.lax.dot_general(p, v, (((3,), (1,)), ((0, 1), (0, 2))),
-                            preferred_element_type=jnp.float32).astype(dt)
-    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
-                              (((1, 3), (0, 1)), ((), ())),
-                              preferred_element_type=jnp.float32).astype(dt)
-
+    out, k, v = _causal_prefill(x, wq, wk, wv, wo, h)
     pad = [(0, 0), (0, cache_len - t), (0, 0), (0, 0)]
     cache_k = jnp.pad(k.astype(dt), pad)
     cache_v = jnp.pad(v.astype(dt), pad)
     return {"Out": [out], "CacheK": [cache_k], "CacheV": [cache_v]}
 
 
+@register_op("kv_attention_prefill_slot", no_grad=True,
+             ref="TPU-native serving op: causal prefill whose K/V rows "
+                 "join a live [n_slots, S, H, D] pool cache at per-row "
+                 "slot indices (in-flight batching; the pool is "
+                 "read+written under one var name — donated state)")
+def _kv_attention_prefill_slot(ctx, ins, attrs):
+    """X [B,T,M], Wq..Wo [M,M], PoolK/PoolV [NS,S,H,Dk], Slot [B,1] int
+    -> Out [B,T,M] + the pools with rows ``Slot`` overwritten by this
+    prompt's padded K/V (zeros beyond T — a reused slot never leaks its
+    previous occupant). attrs: n_head."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    pool_k, pool_v = first(ins, "PoolK"), first(ins, "PoolV")
+    slot = first(ins, "Slot")
+    h = int(attrs["n_head"])
+    t = x.shape[1]
+    cache_len = pool_k.shape[1]
+    out, k, v = _causal_prefill(x, wq, wk, wv, wo, h)
+    pad = [(0, 0), (0, cache_len - t), (0, 0), (0, 0)]
+    rows_k = jnp.pad(k.astype(pool_k.dtype), pad)    # [B,S,H,D]
+    rows_v = jnp.pad(v.astype(pool_v.dtype), pad)
+    idx = jnp.asarray(slot).reshape(-1).astype(jnp.int32)
+    pool_k = pool_k.at[idx].set(rows_k)
+    pool_v = pool_v.at[idx].set(rows_v)
+    return {"Out": [out], "PoolKOut": [pool_k], "PoolVOut": [pool_v]}
+
+
 @register_op("kv_attention_decode", no_grad=True,
              ref="TPU-native serving op: one-token decode step over a "
-                 "static-shape KV cache (in-place dynamic_update_slice "
-                 "write; O(cache_len) cost, position-free executable)")
+                 "static-shape KV cache with per-row position/active "
+                 "masking (in-flight batching; O(cache_len) cost, "
+                 "position-free executable)")
 def _kv_attention_decode(ctx, ins, attrs):
     """X [B,1,M], Wq..Wo [M,M], CacheK/CacheV [B,S,H,Dk],
-    Step [1] int (tokens already generated), SeqLen [B,1] int (true
-    prompt lengths). attrs: n_head, prompt_len (the prompt BUCKET the
-    cache was prefilled at). Writes k/v at pos = prompt_len + step and
-    attends over {j < seq_len} ∪ {prompt_len <= j <= pos}."""
+    Pos [B,1] int (this token's cache write index, per row),
+    SeqLen [B,1] int (true prompt lengths),
+    GenStart [B,1] int (first generated slot — the prompt bucket the
+    row was prefilled at), Active [B,1] int (0 = free slot: the cache
+    row is left untouched and the output row is meaningless).
+    attrs: n_head. Writes k/v at ``Pos`` where active and attends over
+    {j < seq_len} ∪ {gen_start <= j <= pos}."""
     x = first(ins, "X")
     wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
     cache_k, cache_v = first(ins, "CacheK"), first(ins, "CacheV")
-    step = first(ins, "Step")
-    seq_len = first(ins, "SeqLen")
     h = int(attrs["n_head"])
-    prompt_len = int(attrs["prompt_len"])
     b, _, m = x.shape
     s_len = cache_k.shape[1]
     d = m // h
     dt = x.dtype
 
+    pos = jnp.asarray(first(ins, "Pos")).reshape(-1).astype(jnp.int32)
+    lens = jnp.asarray(first(ins, "SeqLen")).reshape(-1).astype(jnp.int32)
+    gen0 = jnp.asarray(first(ins, "GenStart")).reshape(-1)\
+        .astype(jnp.int32)
+    active = jnp.asarray(first(ins, "Active")).reshape(-1) > 0
+
     q = _ab._proj(x, wq, h)                     # [B,1,H,D]
     k_t = _ab._proj(x, wk, h).astype(cache_k.dtype)
     v_t = _ab._proj(x, wv, h).astype(cache_v.dtype)
 
-    pos = jnp.asarray(step).reshape(-1)[0].astype(jnp.int32) + prompt_len
-    zero = jnp.zeros((), jnp.int32)
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k_t,
-                                           (zero, pos, zero, zero))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v_t,
-                                           (zero, pos, zero, zero))
+    j = jnp.arange(s_len, dtype=jnp.int32)
+    # per-row one-hot write at pos, gated by active — a free slot's
+    # cache row is bit-identical before and after the step
+    write = (j[None, :] == pos[:, None]) & active[:, None]      # [B,S]
+    cache_k = jnp.where(write[:, :, None, None], k_t, cache_k)
+    cache_v = jnp.where(write[:, :, None, None], v_t, cache_v)
 
     s = jax.lax.dot_general(q, cache_k, (((3,), (3,)), ((0, 2), (0, 2))),
                             preferred_element_type=jnp.float32)
     s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,1,S]
-    j = jnp.arange(s_len, dtype=jnp.int32)
-    lens = jnp.asarray(seq_len).reshape(-1).astype(jnp.int32)   # [B]
     valid = (j[None, :] < lens[:, None]) | \
-            ((j[None, :] >= prompt_len) & (j[None, :] <= pos))  # [B,S]
+            ((j[None, :] >= gen0[:, None]) &
+             (j[None, :] <= pos[:, None]))           # [B,S]
     p = _scores_to_probs(s, valid[:, None, None, :], dt)
     c = jax.lax.dot_general(p, cache_v, (((3,), (1,)), ((0, 1), (0, 2))),
                             preferred_element_type=jnp.float32).astype(dt)
@@ -141,3 +197,61 @@ def _kv_attention_decode(ctx, ins, attrs):
                               (((1, 3), (0, 1)), ((), ())),
                               preferred_element_type=jnp.float32).astype(dt)
     return {"Out": [out], "CacheKOut": [cache_k], "CacheVOut": [cache_v]}
+
+
+@register_op("token_sample", no_grad=True,
+             ref="TPU-native serving op: on-device next-token selection "
+                 "— greedy argmax or temperature/top-k Gumbel sampling "
+                 "keyed ONLY by the per-request seed + token index "
+                 "(restart-reproducible; independent of the framework "
+                 "step seed)")
+def _token_sample(ctx, ins, attrs):
+    """Logits [B,V], Temperature [B,1] float, TopK [B,1] int
+    (<=0: no top-k filter; 1: argmax), Seed [B,1] int (per-request),
+    StepIdx [B,1] int (index of the token being sampled) -> Out [B,1]
+    int64. Rows with temperature <= 0 OR top_k == 1 take the raw argmax
+    (bit-identical to a host argmax over the same logits — the greedy
+    parity oracle); other rows sample from the temperature-scaled
+    top-k distribution via Gumbel-max, the gumbel noise derived
+    ELEMENTWISE from a murmur-finalizer mix of (seed, step_idx, vocab
+    index) — the same counter-based idiom as the flash kernels'
+    hash_keep_mask, so a row's noise is independent of the batch shape
+    and of which slot it occupies (vmapped jax.random streams are NOT:
+    they change with the batch)."""
+    logits = first(ins, "Logits")
+    temp = jnp.asarray(first(ins, "Temperature")).reshape(-1)\
+        .astype(jnp.float32)
+    topk = jnp.asarray(first(ins, "TopK")).reshape(-1).astype(jnp.int32)
+    seed = jnp.asarray(first(ins, "Seed")).reshape(-1).astype(jnp.int32)
+    stepi = jnp.asarray(first(ins, "StepIdx")).reshape(-1)\
+        .astype(jnp.int32)
+    v = logits.shape[-1]
+    lg = jnp.asarray(logits).reshape(-1, v).astype(jnp.float32)
+
+    greedy = jnp.argmax(lg, axis=-1)
+
+    scaled = lg / jnp.maximum(temp, 1e-6)[:, None]
+    k = jnp.clip(topk, 1, v)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    # ties AT the kth value are all kept (documented; deterministic)
+    keep = (scaled >= kth) | (topk <= 0)[:, None]
+    masked = jnp.where(keep, scaled, -jnp.inf)
+
+    j = jnp.arange(v, dtype=jnp.uint32)[None, :]
+    x = (j * jnp.uint32(0x9E3779B9)
+         ^ seed.astype(jnp.uint32)[:, None] * jnp.uint32(0x85EBCA6B))
+    x = x ^ (stepi.astype(jnp.uint32)[:, None] * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    # uniform in (0, 1) from the 24 high bits; never exactly 0 or 1
+    u = ((x >> jnp.uint32(8)).astype(jnp.float32) + 0.5) * (1.0 / (1 << 24))
+    noise = -jnp.log(-jnp.log(u))
+
+    sampled = jnp.argmax(masked + noise, axis=-1)
+    use_greedy = (temp <= 0.0) | (topk == 1)
+    out = jnp.where(use_greedy, greedy, sampled).astype(jnp.int64)
+    return {"Out": [out[:, None]]}

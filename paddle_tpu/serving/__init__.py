@@ -36,10 +36,16 @@ _LAZY = {
     "slice_outputs": ("paddle_tpu.serving.bucketing", "slice_outputs"),
     "ServedModel": ("paddle_tpu.serving.engine", "ServedModel"),
     "GenerativeModel": ("paddle_tpu.serving.engine", "GenerativeModel"),
+    "SlotGenerativeModel": ("paddle_tpu.serving.engine",
+                            "SlotGenerativeModel"),
+    "SlotExhaustedError": ("paddle_tpu.serving.engine",
+                           "SlotExhaustedError"),
     "PromptTooLongError": ("paddle_tpu.serving.engine",
                            "PromptTooLongError"),
     "ModelServer": ("paddle_tpu.serving.server", "ModelServer"),
     "RequestShedError": ("paddle_tpu.serving.server", "RequestShedError"),
+    "RequestCancelledError": ("paddle_tpu.serving.server",
+                              "RequestCancelledError"),
     "ModelNotFoundError": ("paddle_tpu.serving.server",
                            "ModelNotFoundError"),
     "SERVING_ENV": ("paddle_tpu.serving.server", "SERVING_ENV"),

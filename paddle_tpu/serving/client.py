@@ -37,6 +37,7 @@ import numpy as np
 from paddle_tpu.distributed.resilience import (CircuitBreaker, RetryError,
                                                RetryPolicy)
 from paddle_tpu.serving.server import (SERVING_ENV, ModelNotFoundError,
+                                       RequestCancelledError,
                                        RequestShedError, decode_array,
                                        encode_array)
 from paddle_tpu.utils import faults
@@ -67,6 +68,7 @@ class ServingRequestError(RuntimeError):
 _TYPED = {
     "shed": RequestShedError,
     "not_found": ModelNotFoundError,
+    "cancelled": RequestCancelledError,
 }
 
 
@@ -176,14 +178,35 @@ class ServingClient:
 
     def generate(self, model: str, prompts: Sequence,
                  max_new: int,
-                 request_id: Optional[str] = None) -> list:
+                 request_id: Optional[str] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: Optional[int] = None,
+                 eos_id: Optional[int] = None) -> list:
+        """Generation with optional on-device sampling (slot-scheduled
+        models): temperature<=0 or top_k==1 is exact greedy; a given
+        ``seed`` replays the same stream across retries AND server
+        restarts; ``eos_id`` ends streams early (their decode slots
+        free immediately)."""
         req_id = request_id or uuid.uuid4().hex
-        resp = self._call({
+        msg = {
             "method": "generate", "model": model, "req_id": req_id,
             "prompts": [np.asarray(p, np.int64).reshape(-1).tolist()
                         for p in prompts],
-            "max_new": int(max_new)})
+            "max_new": int(max_new),
+            "temperature": float(temperature), "top_k": int(top_k)}
+        if seed is not None:
+            msg["seed"] = int(seed)
+        if eos_id is not None:
+            msg["eos_id"] = int(eos_id)
+        resp = self._call(msg)
         return [np.asarray(t, np.int64) for t in resp["tokens"]]
+
+    def cancel(self, model: str, request_id: str) -> bool:
+        """Cancel a queued or in-flight generation; its decode slots
+        free within one step."""
+        resp = self._call({"method": "cancel", "model": model,
+                           "req_id": request_id})
+        return bool(resp.get("cancelled"))
 
     def close(self):
         with self._lock:

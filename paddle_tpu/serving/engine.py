@@ -1,6 +1,6 @@
 """Serving engines: the per-model execution layer under the server.
 
-Two engine kinds, one discipline — every runtime dispatch lands on a
+Three engine kinds, one discipline — every runtime dispatch lands on a
 shape signature that was WARMED (compiled or AOT-loaded) at startup, so
 steady-state serving performs zero XLA compilations
 (``serving.metrics.forbid_compiles`` turns the contract into an error;
@@ -21,6 +21,13 @@ steady-state serving performs zero XLA compilations
   serving becomes prefill + O(1)-per-token decode instead of a fresh
   full forward per token; ``analyzed_flops`` of the decode executable
   is independent of the decode position by construction.
+
+- :class:`SlotGenerativeModel` — in-flight batched decoding (ISSUE 9):
+  the decode executable is ONE fixed-shape ``[n_slots]``-row program
+  over pool caches; requests JOIN a free slot mid-flight (prefill
+  scatters their cache rows in) and LEAVE on EOS/max-tokens/cancel, so
+  the device stays saturated with whatever work exists right now — no
+  wave barrier, with on-device temperature/top-k sampling per slot.
 """
 
 from __future__ import annotations
@@ -199,14 +206,18 @@ class ServedModel:
 
 
 class GenerativeModel:
-    """Prefill + KV-cache decode serving for the decoder-LM family.
+    """Prefill + KV-cache decode serving for the decoder-LM family
+    (wave-per-batch: the whole coalesced batch decodes to completion —
+    the control arm the slot scheduler is measured against).
 
-    Built from the program triple of
+    Built from the program family of
     ``models.transformer.build_decoder_lm_programs`` (any model whose
-    programs share the same feed contract works): ``prefill`` consumes
-    ``ids [B, P, 1]`` and creates the per-layer caches in the model
-    scope; ``decode`` consumes ``tok [B, 1, 1] / step [1] /
-    seq_len [B, 1]`` and reads+writes the caches (donated state — the
+    programs share the same feed contract works): each ``prefill@P``
+    view consumes ``ids [B, P, 1]`` (a LADDER of prompt buckets — mixed
+    lengths pad to the nearest bucket instead of worst-case) and creates
+    the per-layer caches in the model scope; ``decode`` consumes
+    ``tok [B, 1, 1]`` plus the per-row ``pos / seq_len / gen_start /
+    active`` geometry and reads+writes the caches (donated state — the
     cache update is in-place in HBM). Greedy decoding; one scope per
     model, waves serialized by the server's batcher."""
 
@@ -218,22 +229,33 @@ class GenerativeModel:
         self.name = name
         self.policy = policy or bucketing.BucketPolicy()
         self.scope = scope or fluid.Scope()
-        pre_main, pre_start, pre_feeds, pre_fetch = programs["prefill"]
+        # prompt-length bucket ladder: every "prefill@P" view (the bare
+        # "prefill" key aliases the largest bucket)
+        pre = {}
+        for key, val in programs.items():
+            if key == "prefill" or key.startswith("prefill@"):
+                pre[int(val[2]["ids"][0][1])] = val
+        if not pre:
+            raise ValueError("programs must contain a 'prefill' view")
+        self.prompt_buckets = tuple(sorted(pre))
+        self.prompt_len = self.prompt_buckets[-1]
+        pre_main, pre_start, _, _ = pre[self.prompt_len]
         dec_main, dec_start, dec_feeds, dec_fetch = programs["decode"]
-        self.prompt_len = int(pre_feeds["ids"][0][1])
         if init:
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(pre_start, scope=self.scope)
-        self._cb_prefill = CompiledBlock(
-            pre_main.desc, 0, sorted(pre_feeds), [pre_fetch],
-            is_test=True, donate=False)
+        self._cb_prefill = {
+            p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
+                             is_test=True, donate=False)
+            for p, (m, _s, feeds, fetch) in pre.items()}
         self._cb_decode = CompiledBlock(
             dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
             is_test=True, donate=True)
         # max_new from the cache length the decode block declares
         cache_vars = [v for n, v in dec_main.desc.global_block.vars.items()
                       if n.endswith("_cache_k_0")]
-        self.max_new = (int(cache_vars[0].shape[1]) - self.prompt_len
+        self.cache_len = int(cache_vars[0].shape[1]) if cache_vars else 0
+        self.max_new = (self.cache_len - self.prompt_len
                         if cache_vars else 0)
         self._full = None
         if "full" in programs:
@@ -241,10 +263,11 @@ class GenerativeModel:
             self._full = CompiledBlock(
                 full_main.desc, 0, sorted(full_feeds), [full_fetch],
                 is_test=True, donate=False)
-        self._warmed: set = set()          # (kind, batch_bucket)
-        self._aot: Dict[Tuple[str, int], object] = {}
+        self._warmed: set = set()   # ("prefill", bucket, P) | ("decode", bucket)
+        self._aot: Dict[Tuple, object] = {}
         self._fingerprint = hashlib.sha256(json.dumps(
-            [pre_main.desc.to_dict(), dec_main.desc.to_dict()],
+            [pre[p][0].desc.to_dict() for p in self.prompt_buckets]
+            + [dec_main.desc.to_dict()],
             sort_keys=True, default=str).encode()).hexdigest()
 
     # -- plumbing --------------------------------------------------------
@@ -253,17 +276,16 @@ class GenerativeModel:
         consts = {n: self.scope.find_var(n) for n in cb.sig.const_names}
         return state, consts, feeds, np.uint32(0)
 
-    def _dispatch(self, kind: str, bucket: int, feeds) -> np.ndarray:
-        cb = self._cb_prefill if kind == "prefill" else self._cb_decode
+    def _run(self, cb, aot_key, feeds) -> np.ndarray:
         args = self._args(cb, feeds)
-        aot = self._aot.get((kind, bucket))
+        aot = self._aot.get(aot_key)
         if aot is not None:
             try:
                 fetches, new_state = aot(*args)
             except Exception:
                 # backend mis-mapped the deserialized executable: degrade
                 # to the (warmed) compile path for the rest of the run
-                self._aot.pop((kind, bucket), None)
+                self._aot.pop(aot_key, None)
                 fetches, new_state = cb.fn(*args)
         else:
             fetches, new_state = cb.fn(*args)
@@ -271,86 +293,126 @@ class GenerativeModel:
             self.scope.set_var(n, v)
         return np.asarray(fetches[0])
 
-    def _prefill_feeds(self, bucket: int):
-        return {"ids": np.zeros((bucket, self.prompt_len, 1), np.int64)}
+    def _dispatch(self, kind: str, bucket: int, feeds,
+                  p_len: Optional[int] = None) -> np.ndarray:
+        if kind == "prefill":
+            p = p_len or self.prompt_len
+            return self._run(self._cb_prefill[p],
+                             ("prefill", bucket, p), feeds)
+        return self._run(self._cb_decode, ("decode", bucket), feeds)
 
-    def _decode_feeds(self, bucket: int, step: int = 0):
+    def prompt_bucket_for(self, length: int) -> int:
+        """Smallest prompt bucket >= length (the prompt-ladder analogue
+        of BucketPolicy.bucket_for)."""
+        for p in self.prompt_buckets:
+            if length <= p:
+                return p
+        raise PromptTooLongError(
+            f"prompt of length {length} exceeds the prompt bucket "
+            f"{self.prompt_len}")
+
+    def _prefill_feeds(self, bucket: int, p_len: Optional[int] = None):
+        p = p_len or self.prompt_len
+        return {"ids": np.zeros((bucket, p, 1), np.int64)}
+
+    def _decode_feeds(self, bucket: int, step: int = 0,
+                      p_len: Optional[int] = None):
+        p = p_len or self.prompt_len
         return {"tok": np.zeros((bucket, 1, 1), np.int64),
-                "step": np.asarray([step], np.int64),
-                "seq_len": np.full((bucket, 1), self.prompt_len,
-                                   np.int64)}
+                "pos": np.full((bucket, 1), p + step, np.int64),
+                "seq_len": np.full((bucket, 1), p, np.int64),
+                "gen_start": np.full((bucket, 1), p, np.int64),
+                "active": np.ones((bucket, 1), np.int64)}
 
     # -- warmup / AOT ----------------------------------------------------
     def warmup(self, aot_dir: Optional[str] = None,
                persist: bool = True) -> Dict[str, int]:
-        """Compile-or-load (prefill, decode) for every batch bucket. With
-        ``aot_dir``, serialized executables are loaded when present and
-        written after a compile, so a restarted server skips the
-        compiler entirely."""
+        """Compile-or-load every (prefill bucket × batch bucket) plus
+        decode per batch bucket. With ``aot_dir``, serialized
+        executables are loaded when present and written after a compile,
+        so a restarted server skips the compiler entirely."""
         loaded = compiled = 0
         if aot_dir:
             loaded += self.load_compiled(aot_dir)
         for bucket in self.policy.batch_buckets:
-            for kind in ("prefill", "decode"):
-                if (kind, bucket) in self._warmed:
+            for p in self.prompt_buckets:
+                if ("prefill", bucket, p) in self._warmed:
                     continue
-                smetrics.count_compile(self.name, kind)
+                smetrics.count_compile(self.name, "prefill")
                 compiled += 1
-                if kind == "prefill":
-                    self._dispatch(kind, bucket,
-                                   self._prefill_feeds(bucket))
-                else:
-                    # the decode dispatch reads the cache state vars —
-                    # run a prefill at this bucket first so they exist
-                    # in the scope at the right shape even when the
-                    # prefill executable was AOT-loaded (no dispatch)
-                    self._dispatch("prefill", bucket,
-                                   self._prefill_feeds(bucket))
-                    self._dispatch(kind, bucket,
-                                   self._decode_feeds(bucket))
-                self._warmed.add((kind, bucket))
+                self._dispatch("prefill", bucket,
+                               self._prefill_feeds(bucket, p), p_len=p)
+                self._warmed.add(("prefill", bucket, p))
                 if aot_dir and persist:
-                    self._persist_one(aot_dir, kind, bucket)
+                    self._persist_one(aot_dir, "prefill", bucket, p)
+            if ("decode", bucket) not in self._warmed:
+                smetrics.count_compile(self.name, "decode")
+                compiled += 1
+                # the decode dispatch reads the cache state vars — run a
+                # prefill at this bucket first so they exist in the
+                # scope at the right shape even when the prefill
+                # executable was AOT-loaded (no dispatch)
+                self._dispatch("prefill", bucket,
+                               self._prefill_feeds(bucket))
+                self._dispatch("decode", bucket,
+                               self._decode_feeds(bucket))
+                self._warmed.add(("decode", bucket))
+                if aot_dir and persist:
+                    self._persist_one(aot_dir, "decode", bucket)
         return {"loaded": loaded, "compiled": compiled}
 
-    def _aot_path(self, dirname: str, kind: str, bucket: int) -> str:
+    def _aot_path(self, dirname: str, kind: str, bucket: int,
+                  p_len: Optional[int] = None) -> str:
+        tag = f"{kind}_b{bucket}" + (f"_p{p_len}" if p_len else "")
         return os.path.join(
-            dirname, f"__kv_{kind}_b{bucket}.{self._fingerprint[:12]}.pax")
+            dirname, f"__kv_{tag}.{self._fingerprint[:12]}.pax")
 
-    def _persist_one(self, dirname: str, kind: str, bucket: int):
-        cb = self._cb_prefill if kind == "prefill" else self._cb_decode
-        feeds = (self._prefill_feeds(bucket) if kind == "prefill"
-                 else self._decode_feeds(bucket))
+    def _persist_one(self, dirname: str, kind: str, bucket: int,
+                     p_len: Optional[int] = None):
+        if kind == "prefill":
+            cb = self._cb_prefill[p_len or self.prompt_len]
+            feeds = self._prefill_feeds(bucket, p_len)
+        else:
+            cb = self._cb_decode
+            feeds = self._decode_feeds(bucket)
         try:
             lowered = cb.fn.lower(*self._args(cb, feeds))
-            save_executable(self._aot_path(dirname, kind, bucket), lowered)
+            save_executable(self._aot_path(dirname, kind, bucket, p_len),
+                            lowered)
         except Exception:
             pass
 
     def load_compiled(self, dirname: str) -> int:
-        """Load every persisted (kind, bucket) executable matching this
-        program fingerprint; returns how many now serve without a
-        compile. The fingerprint hashes the program descs VERBATIM —
-        including generated intermediate var names, which restart
-        identically in a fresh process (the server-restart scenario
-        this serves) but shift if the programs are REbuilt inside one
-        process; a mismatch is safe, it just recompiles."""
+        """Load every persisted executable matching this program
+        fingerprint; returns how many now serve without a compile. The
+        fingerprint hashes the program descs VERBATIM — including
+        generated intermediate var names, which restart identically in a
+        fresh process (the server-restart scenario this serves) but
+        shift if the programs are REbuilt inside one process; a mismatch
+        is safe, it just recompiles."""
         n = 0
         for bucket in self.policy.batch_buckets:
-            for kind in ("prefill", "decode"):
-                exe = load_executable(self._aot_path(dirname, kind,
-                                                     bucket))
+            for p in self.prompt_buckets:
+                exe = load_executable(
+                    self._aot_path(dirname, "prefill", bucket, p))
                 if exe is not None:
-                    self._aot[(kind, bucket)] = exe
-                    self._warmed.add((kind, bucket))
+                    self._aot[("prefill", bucket, p)] = exe
+                    self._warmed.add(("prefill", bucket, p))
                     n += 1
+            exe = load_executable(self._aot_path(dirname, "decode",
+                                                 bucket))
+            if exe is not None:
+                self._aot[("decode", bucket)] = exe
+                self._warmed.add(("decode", bucket))
+                n += 1
         return n
 
     # -- generation ------------------------------------------------------
     def generate(self, prompts: Sequence[np.ndarray],
                  max_new: Optional[int] = None) -> List[np.ndarray]:
         """Greedy-decode ``max_new`` tokens for each prompt (1-D int
-        arrays of length <= prompt bucket). One prefill + max_new decode
+        arrays of length <= prompt bucket). One prefill (at the nearest
+        prompt bucket of the wave's longest prompt) + max_new decode
         steps per wave, all on warmed static-shape executables."""
         max_new = self.max_new if max_new is None else int(max_new)
         if max_new > self.max_new:
@@ -363,26 +425,32 @@ class GenerativeModel:
             raise PromptTooLongError(
                 f"{int(too_long.sum())} prompt(s) exceed the prompt "
                 f"bucket {self.prompt_len}")
+        p_len = self.prompt_bucket_for(int(lens.max()) if n else 1)
         bucket = self.policy.bucket_for(n)
-        for kind in ("prefill", "decode"):
-            if (kind, bucket) not in self._warmed:
+        for key, kind in ((("prefill", bucket, p_len), "prefill"),
+                          (("decode", bucket), "decode")):
+            if key not in self._warmed:
                 smetrics.count_compile(self.name, f"steady_{kind}")
-                self._warmed.add((kind, bucket))
-        ids = np.zeros((bucket, self.prompt_len), np.int64)
+                self._warmed.add(key)
+        ids = np.zeros((bucket, p_len), np.int64)
         for i, p in enumerate(prompts):
             ids[i, :len(p)] = np.asarray(p, np.int64)
         blens = _padding.pad_rows(lens[:, None], bucket)
 
         logits = self._dispatch("prefill", bucket,
-                                {"ids": ids[:, :, None]})
+                                {"ids": ids[:, :, None]}, p_len=p_len)
         smetrics.PREFILLS.labels(model=self.name).inc()
         tok = logits[np.arange(bucket), blens[:, 0] - 1].argmax(-1)
         out = [tok.astype(np.int64)]
+        gen_start = np.full((bucket, 1), p_len, np.int64)
+        active = np.ones((bucket, 1), np.int64)
         for s in range(max_new - 1):
             lg = self._dispatch(
                 "decode", bucket,
                 {"tok": out[-1][:, None, None],
-                 "step": np.asarray([s], np.int64), "seq_len": blens})
+                 "pos": np.full((bucket, 1), p_len + s, np.int64),
+                 "seq_len": blens, "gen_start": gen_start,
+                 "active": active})
             smetrics.DECODE_STEPS.labels(model=self.name).inc()
             out.append(lg[:, 0].argmax(-1).astype(np.int64))
         smetrics.TOKENS_GENERATED.labels(model=self.name).inc(
@@ -440,3 +508,349 @@ class GenerativeModel:
         t_total = self.prompt_len + self.max_new
         return self._full.analyzed_flops(
             self.scope, {"ids": np.zeros((bucket, t_total, 1), np.int64)})
+
+
+class SlotExhaustedError(RuntimeError):
+    """No free decode slot — the scheduler must wait for a leave (or
+    shed). Typed so the server can distinguish it from engine errors."""
+
+
+class SlotGenerativeModel:
+    """In-flight batched decoding over a persistent decode-slot pool
+    (ISSUE 9): the decode executable is ONE fixed-shape
+    ``[n_slots]``-row program where each slot carries its own KV-cache
+    rows, per-row position/active geometry, and per-request sampling
+    state. Requests JOIN a free slot mid-flight (``admit`` prefills the
+    prompt at the nearest prompt bucket and scatters its cache rows into
+    the pool via ``kv_attention_prefill_slot``) and LEAVE on
+    EOS/max-tokens (``step`` reports the leave and frees the slot) — no
+    wave barrier, zero steady-state compiles.
+
+    Sampling runs ON DEVICE (``token_sample``): greedy when
+    ``temperature <= 0`` or ``top_k == 1`` (bit-matches the greedy
+    oracle), otherwise temperature/top-k Gumbel sampling keyed only by
+    the per-request seed + token index — a sampled stream replays
+    identically across server restarts.
+
+    Built from ``build_decoder_lm_programs(..., modes=("prefill_slot",
+    "decode_slot"), n_slots=..., prompt_buckets=...)``. Thread
+    discipline: one dispatcher at a time (the server's scheduler
+    thread); ``admit``/``step``/``release`` are not internally locked."""
+
+    def __init__(self, name: str, programs: Dict, scope=None,
+                 init: bool = True):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.core.lowering import CompiledBlock
+        self.name = name
+        pre = {}
+        for key, val in programs.items():
+            if key == "prefill_slot" or key.startswith("prefill_slot@"):
+                pre[int(val[2]["ids"][0][1])] = val
+        if not pre or "decode_slot" not in programs:
+            raise ValueError("programs must contain 'prefill_slot' and "
+                             "'decode_slot' views (build_decoder_lm_"
+                             "programs(..., n_slots=...))")
+        self.prompt_buckets = tuple(sorted(pre))
+        self.prompt_len = self.prompt_buckets[-1]
+        dec_main, dec_start, dec_feeds, dec_fetch = programs["decode_slot"]
+        self.n_slots = int(dec_feeds["tok"][0][0])
+        # server compatibility: max prompts one request may carry
+        self.policy = bucketing.BucketPolicy((self.n_slots,))
+        self.scope = scope or fluid.Scope()
+        if init:
+            exe = fluid.Executor(fluid.TPUPlace())
+            # any slot startup: params + zero-filled pool caches
+            exe.run(dec_start, scope=self.scope)
+        self._cb_prefill = {
+            p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
+                             is_test=True, donate=True)
+            for p, (m, _s, feeds, fetch) in pre.items()}
+        self._cb_decode = CompiledBlock(
+            dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
+            is_test=True, donate=True)
+        pool_vars = [v for n, v in dec_main.desc.global_block.vars.items()
+                     if n.endswith("_slot_k_0")]
+        self.cache_len = int(pool_vars[0].shape[1]) if pool_vars else 0
+        self.max_new = self.cache_len - self.prompt_len
+        self._warmed: set = set()
+        self._aot: Dict[Tuple, object] = {}
+        self._fingerprint = hashlib.sha256(json.dumps(
+            [pre[p][0].desc.to_dict() for p in self.prompt_buckets]
+            + [dec_main.desc.to_dict()],
+            sort_keys=True, default=str).encode()).hexdigest()
+        # host mirror of the per-slot device state
+        s = self.n_slots
+        self._active = np.zeros(s, bool)
+        self._tok = np.zeros(s, np.int64)        # last emitted token
+        self._seq = np.zeros(s, np.int64)        # true prompt length
+        self._gen0 = np.zeros(s, np.int64)       # prompt bucket (gen start)
+        self._gen_count = np.zeros(s, np.int64)  # tokens emitted so far
+        self._seed = np.zeros(s, np.int64)
+        self._temp = np.zeros(s, np.float32)
+        self._topk = np.zeros(s, np.int64)
+        self._budget = np.zeros(s, np.int64)
+        self._eos: List[Optional[int]] = [None] * s
+
+    # -- plumbing (same dispatch/AOT discipline as GenerativeModel) ------
+    _args = GenerativeModel._args
+    _run = GenerativeModel._run
+    prompt_bucket_for = GenerativeModel.prompt_bucket_for
+
+    def free_count(self) -> int:
+        return int((~self._active).sum())
+
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    def occupancy(self) -> float:
+        return self.active_count() / float(self.n_slots)
+
+    def _decode_feeds(self):
+        return {"tok": self._tok[:, None, None],
+                "pos": (self._gen0 + self._gen_count - 1)[:, None],
+                "seq_len": self._seq[:, None],
+                "gen_start": self._gen0[:, None],
+                "active": self._active.astype(np.int64)[:, None],
+                "seed": self._seed[:, None],
+                "sample_step": self._gen_count[:, None],
+                "temperature": self._temp[:, None],
+                "top_k": self._topk[:, None]}
+
+    def _prefill_feeds(self, p_len: int):
+        return {"ids": np.zeros((1, p_len, 1), np.int64),
+                "slot": np.zeros((1, 1), np.int64),
+                "seq_len": np.ones((1, 1), np.int64),
+                "seed": np.zeros((1, 1), np.int64),
+                "temperature": np.zeros((1, 1), np.float32),
+                "top_k": np.zeros((1, 1), np.int64)}
+
+    # -- warmup / AOT ----------------------------------------------------
+    def warmup(self, aot_dir: Optional[str] = None,
+               persist: bool = True) -> Dict[str, int]:
+        """Compile-or-load one prefill executable per prompt bucket plus
+        THE decode-slot executable — after this, any join/leave mix of
+        in-flight requests dispatches with zero compiles."""
+        loaded = compiled = 0
+        if aot_dir:
+            loaded += self.load_compiled(aot_dir)
+        for p in self.prompt_buckets:
+            if ("prefill_slot", p) in self._warmed:
+                continue
+            smetrics.count_compile(self.name, "prefill_slot")
+            compiled += 1
+            self._run(self._cb_prefill[p], ("prefill_slot", p),
+                      self._prefill_feeds(p))
+            self._warmed.add(("prefill_slot", p))
+            if aot_dir and persist:
+                self._persist_one(aot_dir, "prefill_slot", p)
+        if ("decode_slot",) not in self._warmed:
+            smetrics.count_compile(self.name, "decode_slot")
+            compiled += 1
+            self._run(self._cb_decode, ("decode_slot",),
+                      self._decode_feeds())
+            self._warmed.add(("decode_slot",))
+            if aot_dir and persist:
+                self._persist_one(aot_dir, "decode_slot")
+        # warmup dispatches touched slot 0's cache rows; no request was
+        # live, so just make sure the host mirror says so
+        self.reset()
+        return {"loaded": loaded, "compiled": compiled}
+
+    def _aot_path(self, dirname: str, kind: str,
+                  p_len: Optional[int] = None) -> str:
+        tag = kind + (f"_p{p_len}" if p_len else "")
+        return os.path.join(
+            dirname,
+            f"__slot_{tag}_s{self.n_slots}.{self._fingerprint[:12]}.pax")
+
+    def _persist_one(self, dirname: str, kind: str,
+                     p_len: Optional[int] = None):
+        if kind == "prefill_slot":
+            cb, feeds = self._cb_prefill[p_len], self._prefill_feeds(p_len)
+        else:
+            cb, feeds = self._cb_decode, self._decode_feeds()
+        try:
+            lowered = cb.fn.lower(*self._args(cb, feeds))
+            save_executable(self._aot_path(dirname, kind, p_len), lowered)
+        except Exception:
+            pass
+
+    def load_compiled(self, dirname: str) -> int:
+        n = 0
+        for p in self.prompt_buckets:
+            exe = load_executable(
+                self._aot_path(dirname, "prefill_slot", p))
+            if exe is not None:
+                self._aot[("prefill_slot", p)] = exe
+                self._warmed.add(("prefill_slot", p))
+                n += 1
+        exe = load_executable(self._aot_path(dirname, "decode_slot"))
+        if exe is not None:
+            self._aot[("decode_slot",)] = exe
+            self._warmed.add(("decode_slot",))
+            n += 1
+        return n
+
+    # -- slot lifecycle --------------------------------------------------
+    def admit(self, prompt, *, seed: int = 0, temperature: float = 0.0,
+              top_k: int = 0, max_new: Optional[int] = None,
+              eos_id: Optional[int] = None
+              ) -> Tuple[int, int, Optional[str]]:
+        """JOIN: prefill ``prompt`` into a free slot (nearest prompt
+        bucket) and sample its first token on-device. Returns
+        (slot, first_token, done_cause); done_cause is None while the
+        request stays in flight, or 'eos'/'max_new' when the very first
+        token already finished it (the slot is then freed again)."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        length = len(prompt)
+        if length < 1:
+            raise ValueError("empty prompt")
+        if length > self.prompt_len:
+            raise PromptTooLongError(
+                f"prompt of length {length} exceeds the prompt bucket "
+                f"{self.prompt_len}")
+        free = np.flatnonzero(~self._active)
+        if free.size == 0:
+            raise SlotExhaustedError(
+                f"model {self.name!r}: all {self.n_slots} decode slots "
+                f"are in flight")
+        slot = int(free[0])
+        p_len = self.prompt_bucket_for(length)
+        budget = self.max_new if max_new is None else int(max_new)
+        # capacity is set by the PROMPT BUCKET, not the true length:
+        # generated KV rows land from gen_start = p_len (the last fed-
+        # back token writes at p_len + budget - 2, which must stay
+        # inside the cache — otherwise the write silently misses and
+        # late tokens lose their predecessor's keys)
+        if budget < 1 or budget > self.cache_len - p_len:
+            raise ValueError(
+                f"max_new {budget} outside the cache budget "
+                f"(1..{self.cache_len - p_len} for a prompt padded to "
+                f"bucket {p_len})")
+        key = ("prefill_slot", p_len)
+        if key not in self._warmed:
+            smetrics.count_compile(self.name, "steady_prefill_slot")
+            self._warmed.add(key)
+        ids = np.zeros((1, p_len, 1), np.int64)
+        ids[0, :length, 0] = prompt
+        tok = self._run(self._cb_prefill[p_len], key, {
+            "ids": ids,
+            "slot": np.asarray([[slot]], np.int64),
+            "seq_len": np.asarray([[length]], np.int64),
+            "seed": np.asarray([[int(seed)]], np.int64),
+            "temperature": np.asarray([[float(temperature)]], np.float32),
+            "top_k": np.asarray([[int(top_k)]], np.int64)})
+        smetrics.PREFILLS.labels(model=self.name).inc()
+        smetrics.SLOT_ADMISSIONS.labels(model=self.name).inc()
+        smetrics.TOKENS_GENERATED.labels(model=self.name).inc()
+        first = int(np.asarray(tok).reshape(-1)[0])
+        self._active[slot] = True
+        self._tok[slot] = first
+        self._seq[slot] = length
+        self._gen0[slot] = p_len
+        self._gen_count[slot] = 1
+        self._seed[slot] = int(seed)
+        self._temp[slot] = float(temperature)
+        self._topk[slot] = int(top_k)
+        self._budget[slot] = budget
+        self._eos[slot] = eos_id
+        done = None
+        if eos_id is not None and first == eos_id:
+            done = "eos"
+        elif budget <= 1:
+            done = "max_new"
+        if done:
+            self.release(slot, cause=done)
+        else:
+            smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(
+                self.occupancy())
+        return slot, first, done
+
+    def step(self) -> List[Tuple[int, int, Optional[str]]]:
+        """One decode dispatch over the WHOLE pool (free slots ride
+        along masked). Returns (slot, token, done_cause) per active
+        slot; slots that hit EOS or their token budget are released —
+        the LEAVE side of in-flight batching."""
+        live = np.flatnonzero(self._active)
+        if live.size == 0:
+            return []
+        if ("decode_slot",) not in self._warmed:
+            smetrics.count_compile(self.name, "steady_decode_slot")
+            self._warmed.add(("decode_slot",))
+        out = self._run(self._cb_decode, ("decode_slot",),
+                        self._decode_feeds())
+        out = np.asarray(out).reshape(-1)
+        smetrics.DECODE_STEPS.labels(model=self.name).inc()
+        smetrics.TOKENS_GENERATED.labels(model=self.name).inc(
+            int(live.size))
+        events = []
+        for slot in live:
+            slot = int(slot)
+            tok = int(out[slot])
+            self._tok[slot] = tok
+            self._gen_count[slot] += 1
+            eos = self._eos[slot]
+            done = None
+            if eos is not None and tok == eos:
+                done = "eos"
+            elif self._gen_count[slot] >= self._budget[slot]:
+                done = "max_new"
+            if done:
+                self.release(slot, cause=done)
+            events.append((slot, tok, done))
+        smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(
+            self.occupancy())
+        return events
+
+    def release(self, slot: int, cause: str = "cancelled"):
+        """LEAVE: free ``slot`` for the next admission (its pool cache
+        rows are fully overwritten by that admission's prefill, so
+        nothing is scrubbed here)."""
+        if not self._active[slot]:
+            return
+        self._active[slot] = False
+        self._eos[slot] = None
+        smetrics.SLOT_EVICTIONS.labels(model=self.name,
+                                       cause=cause).inc()
+        smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(
+            self.occupancy())
+
+    def reset(self):
+        self._active[:] = False
+        self._gen_count[:] = 0
+        self._eos = [None] * self.n_slots
+        smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(0.0)
+
+    # -- convenience: drive the pool to completion -----------------------
+    def generate(self, prompts: Sequence, max_new: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seeds: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None) -> List[np.ndarray]:
+        """Admit every prompt (queuing past ``n_slots`` until slots
+        free) and step the pool until all are done — the single-caller
+        convenience the parity tests drive; the server's scheduler does
+        the same dance with interleaved arrivals. Assumes exclusive use
+        of the pool."""
+        pending = list(range(len(prompts)))[::-1]
+        collected: Dict[int, list] = {i: [] for i in range(len(prompts))}
+        slot2idx: Dict[int, int] = {}
+        while pending or slot2idx:
+            while pending and self.free_count() > 0:
+                i = pending.pop()
+                slot, first, done = self.admit(
+                    prompts[i],
+                    seed=int(seeds[i]) if seeds is not None else 0,
+                    temperature=temperature, top_k=top_k,
+                    max_new=max_new, eos_id=eos_id)
+                collected[i].append(first)
+                if not done:
+                    slot2idx[slot] = i
+            for slot, tok, done in self.step():
+                i = slot2idx.get(slot)
+                if i is None:
+                    continue
+                collected[i].append(tok)
+                if done:
+                    del slot2idx[slot]
+        return [np.asarray(collected[i], np.int64)
+                for i in range(len(prompts))]

@@ -68,8 +68,31 @@ DECODE_STEPS = _metrics.counter(
     "Single-token decode executable dispatches", labelnames=("model",))
 PREFILLS = _metrics.counter(
     "paddle_serving_prefills_total",
-    "Prefill executable dispatches (one per generation wave)",
+    "Prefill executable dispatches (one per generation wave, or one "
+    "per slot admission on the in-flight path)", labelnames=("model",))
+TTFT = _metrics.histogram(
+    "paddle_serving_ttft_seconds",
+    "Time to first token: submit to the first generated token of a "
+    "request. On the slot scheduler this is bounded by queue wait + one "
+    "prefill; on the wave path it includes the whole wave",
     labelnames=("model",))
+INTER_TOKEN = _metrics.histogram(
+    "paddle_serving_inter_token_latency_seconds",
+    "Per-token gap after the first token (one observation per emitted "
+    "token on the slot scheduler — the decode-step cadence)",
+    labelnames=("model",))
+SLOT_OCCUPANCY = _metrics.gauge(
+    "paddle_serving_decode_slot_occupancy_ratio",
+    "In-flight requests / decode slots of the slot pool (the in-flight "
+    "batching analogue of batch occupancy)", labelnames=("model",))
+SLOT_ADMISSIONS = _metrics.counter(
+    "paddle_serving_slot_admissions_total",
+    "Requests that JOINED a decode slot mid-flight (one per prompt "
+    "prefilled into the pool)", labelnames=("model",))
+SLOT_EVICTIONS = _metrics.counter(
+    "paddle_serving_slot_evictions_total",
+    "Slots freed, by cause: eos | max_new | cancelled | error",
+    labelnames=("model", "cause"))
 
 
 class CompileForbiddenError(RuntimeError):
@@ -117,11 +140,11 @@ def count_compile(model: str, kind: str):
     COMPILATIONS.labels(model=model, kind=kind).inc()
 
 
-def latency_percentile(model: str, q: float) -> float:
-    """Percentile estimate (upper bucket bound) from the request-latency
-    histogram — how the load test asserts p50/p99 without a client-side
+def histogram_percentile(family, q: float, **labels) -> float:
+    """Percentile estimate (upper bucket bound) from an exported
+    histogram — how the load tests assert p50/p99 without a client-side
     timer array. Returns 0.0 with no observations."""
-    hist = REQUEST_LATENCY.labels(model=model)
+    hist = family.labels(**labels)
     buckets, _, count = hist.snapshot()
     if count <= 0:
         return 0.0
@@ -130,3 +153,8 @@ def latency_percentile(model: str, q: float) -> float:
         if cum >= target:
             return ub
     return buckets[-1][0]
+
+
+def latency_percentile(model: str, q: float) -> float:
+    """Request-latency percentile (see :func:`histogram_percentile`)."""
+    return histogram_percentile(REQUEST_LATENCY, q, model=model)

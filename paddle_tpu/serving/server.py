@@ -1,5 +1,6 @@
 """The model server: N models, a bounded admission queue each, a
-continuous batcher per model, and a JSON/TCP front end.
+continuous batcher (or, for slot engines, an in-flight scheduler) per
+model, and a JSON/TCP front end.
 
 Request lifecycle (docs/serving.md):
 
@@ -11,6 +12,16 @@ Request lifecycle (docs/serving.md):
               up, not from a fixed time window)
            -> engine dispatch on a warmed bucket (pad-and-slice)
            -> per-request latency observed, futures fulfilled
+
+A hosted :class:`~paddle_tpu.serving.engine.SlotGenerativeModel` gets
+the IN-FLIGHT scheduler instead of the wave batcher: a single loop that
+admits queued prompts into free decode slots (one prefill each), steps
+the whole pool by one token per iteration, observes TTFT/inter-token
+latencies, and reaps slots on EOS/max-tokens/cancel — a request joins a
+RUNNING decode instead of waiting for the current wave to drain
+(ISSUE 9). ``cancel`` (in-process or over the wire) frees a request's
+slots within one decode step; the RPC handler cancels a generation
+whose client hung up mid-stream.
 
 Admission control: ``max_queue_depth`` bounds each model's queue;
 beyond it ``submit`` raises :class:`RequestShedError` (over the wire:
@@ -35,6 +46,7 @@ from __future__ import annotations
 
 import base64
 import json
+import socket as socket_module
 import socketserver
 import threading
 import time
@@ -47,7 +59,7 @@ import numpy as np
 from paddle_tpu.serving import bucketing
 from paddle_tpu.serving import metrics as smetrics
 from paddle_tpu.serving.engine import (GenerativeModel, PromptTooLongError,
-                                       ServedModel)
+                                       ServedModel, SlotGenerativeModel)
 from paddle_tpu.utils import faults
 
 SERVING_ENV = "PADDLE_SERVING"
@@ -61,6 +73,12 @@ class RequestShedError(RuntimeError):
 
 class ModelNotFoundError(KeyError):
     pass
+
+
+class RequestCancelledError(RuntimeError):
+    """The generation was cancelled before completion — by an explicit
+    ``cancel`` call or by the server noticing the requesting client hung
+    up mid-stream. Its decode slots were freed for the next admission."""
 
 
 def encode_array(a: np.ndarray) -> dict:
@@ -99,10 +117,12 @@ class _Future:
 
 class _Request:
     __slots__ = ("kind", "request_id", "feeds", "prompts", "max_new",
-                 "rows", "signature", "future", "t_enqueue")
+                 "rows", "signature", "future", "t_enqueue",
+                 "temperature", "top_k", "seed", "eos_id")
 
     def __init__(self, kind: str, request_id: str, rows: int,
-                 feeds=None, prompts=None, max_new=None, signature=None):
+                 feeds=None, prompts=None, max_new=None, signature=None,
+                 temperature=0.0, top_k=0, seed=None, eos_id=None):
         self.kind = kind                    # "infer" | "generate"
         self.request_id = request_id
         self.feeds = feeds
@@ -110,6 +130,10 @@ class _Request:
         self.max_new = max_new
         self.rows = rows
         self.signature = signature
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = seed                    # None -> derived per prompt
+        self.eos_id = eos_id
         self.future = _Future()
         self.t_enqueue = time.perf_counter()
 
@@ -130,9 +154,18 @@ class _HostedModel:
         self.settled: "OrderedDict[str, tuple]" = OrderedDict()
         self.dedup_capacity = dedup_capacity
         self.thread = threading.Thread(
-            target=self._batch_loop, daemon=True,
+            target=self._loop, daemon=True,
             name=f"paddle-serving-{name}")
         self.thread.start()
+
+    def _loop(self):
+        self._batch_loop()
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancellation is only meaningful on the in-flight scheduler
+        (_SlotHostedModel); the wave batcher runs requests to
+        completion."""
+        return False
 
     @property
     def max_rows(self) -> int:
@@ -246,8 +279,14 @@ class _HostedModel:
         smetrics.REQUESTS_APPLIED.labels(model=self.name).inc(len(wave))
         max_new = max(r.max_new for r in wave)
         toks = self.engine.generate(prompts, max_new=max_new)
+        # the wave yields no token before it drains: TTFT == settle time
+        # (the honest control-arm number the slot scheduler is measured
+        # against in tools/serve_bench.py)
+        now = time.perf_counter()
         i = 0
         for r in wave:
+            smetrics.TTFT.labels(model=self.name).observe(
+                now - r.t_enqueue)
             part = [t[:r.max_new] for t in toks[i:i + len(r.prompts)]]
             i += len(r.prompts)
             self._settle(r, result=part)
@@ -282,6 +321,198 @@ class _HostedModel:
         self.thread.join(timeout=5)
 
 
+class _GenStream:
+    """One in-flight generate request on the slot scheduler: which
+    prompts still wait for a slot, which slots it owns, and the tokens
+    collected so far."""
+
+    __slots__ = ("req", "pending", "tokens", "slot2pi", "last_tok_t",
+                 "cancelled")
+
+    def __init__(self, req: _Request):
+        self.req = req
+        self.pending = deque(enumerate(req.prompts))   # (prompt_idx, p)
+        self.tokens: Dict[int, list] = {}
+        self.slot2pi: Dict[int, int] = {}              # slot -> prompt_idx
+        self.last_tok_t: Dict[int, float] = {}
+        self.cancelled = False
+
+    def done(self) -> bool:
+        return not self.pending and not self.slot2pi
+
+
+class _SlotHostedModel(_HostedModel):
+    """In-flight scheduler for a :class:`SlotGenerativeModel`: ONE loop
+    that (1) reaps cancelled streams (slots freed within one step),
+    (2) admits queued prompts into free slots — each admission is a
+    prefill + the request's first token, so TTFT is bounded by queue
+    wait + prefill, not by the running decode's length — and (3) steps
+    the whole pool one token, settling requests as their last slot
+    leaves. Admission, decode, and settlement interleave freely: this is
+    continuous batching at token granularity."""
+
+    def __init__(self, name: str, engine, max_queue_depth: int,
+                 linger_s: float, dedup_capacity: int = 1024):
+        # scheduler state lives on the scheduler thread; create it
+        # BEFORE super() starts the thread
+        self._streams: Dict[str, _GenStream] = {}
+        self._slot_owner: Dict[int, tuple] = {}
+        self.sched_steps = 0
+        self.sched_slot_steps = 0       # occupied slot-steps (occupancy)
+        super().__init__(name, engine, max_queue_depth, linger_s,
+                         dedup_capacity)
+
+    # -- cancellation ----------------------------------------------------
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or in-flight generation. Queued requests
+        settle immediately; in-flight ones are flagged and their slots
+        freed by the scheduler within one decode step."""
+        with self.cond:
+            stream = self._streams.get(request_id)
+            if stream is not None and not stream.cancelled:
+                stream.cancelled = True
+                self.cond.notify()
+                return True
+            for i, req in enumerate(self.queue):
+                if req.request_id == request_id:
+                    del self.queue[i]
+                    smetrics.QUEUE_DEPTH.labels(model=self.name).set(
+                        len(self.queue))
+                    self._settle(req, exc=RequestCancelledError(
+                        f"request {request_id!r} cancelled while "
+                        f"queued"))
+                    return True
+        return False
+
+    def _reap_cancelled(self):
+        for rid in [r for r, s in self._streams.items() if s.cancelled]:
+            stream = self._streams.pop(rid)
+            for slot in list(stream.slot2pi):
+                self.engine.release(slot, cause="cancelled")
+                self._slot_owner.pop(slot, None)
+            self._settle(stream.req, exc=RequestCancelledError(
+                f"request {rid!r} cancelled mid-generation; "
+                f"{len(stream.slot2pi)} slot(s) freed"))
+
+    # -- admission (join) ------------------------------------------------
+    def _next_admission(self) -> Optional[_GenStream]:
+        # finish partially admitted streams before starting new ones
+        for stream in self._streams.values():
+            if stream.pending and not stream.cancelled:
+                return stream
+        with self.cond:
+            while self.queue:
+                req = self.queue.popleft()
+                smetrics.QUEUE_DEPTH.labels(model=self.name).set(
+                    len(self.queue))
+                if req.kind != "generate":
+                    self._settle(req, exc=TypeError(
+                        "slot-scheduled models serve generate "
+                        "requests only"))
+                    continue
+                stream = _GenStream(req)
+                self._streams[req.request_id] = stream
+                # execution starts here — the at-most-once witness
+                smetrics.REQUESTS_APPLIED.labels(model=self.name).inc()
+                return stream
+        return None
+
+    def _fail_stream(self, stream: _GenStream, exc: BaseException):
+        self._streams.pop(stream.req.request_id, None)
+        for slot in list(stream.slot2pi):
+            self.engine.release(slot, cause="error")
+            self._slot_owner.pop(slot, None)
+        self._settle(stream.req, exc=exc)
+
+    def _admit(self):
+        while self.engine.free_count() > 0:
+            stream = self._next_admission()
+            if stream is None:
+                return
+            pi, prompt = stream.pending.popleft()
+            req = stream.req
+            seed = (req.seed + pi if req.seed is not None
+                    else (hash(req.request_id) + pi) & 0x7FFFFFFF)
+            try:
+                slot, first, done = self.engine.admit(
+                    prompt, seed=seed, temperature=req.temperature,
+                    top_k=req.top_k, max_new=req.max_new,
+                    eos_id=req.eos_id)
+            except BaseException as e:
+                self._fail_stream(stream, e)
+                continue
+            now = time.perf_counter()
+            smetrics.TTFT.labels(model=self.name).observe(
+                now - req.t_enqueue)
+            stream.tokens[pi] = [first]
+            stream.last_tok_t[pi] = now
+            if done:
+                self._maybe_settle(stream)
+            else:
+                stream.slot2pi[slot] = pi
+                self._slot_owner[slot] = (stream, pi)
+
+    # -- settlement (leave) ----------------------------------------------
+    def _maybe_settle(self, stream: _GenStream):
+        if not stream.done():
+            return
+        self._streams.pop(stream.req.request_id, None)
+        result = [np.asarray(stream.tokens.get(pi, []), np.int64)
+                  for pi in range(len(stream.req.prompts))]
+        self._settle(stream.req, result=result)
+
+    # -- the scheduler loop ----------------------------------------------
+    def _loop(self):
+        engine = self.engine
+        while self.running:
+            try:
+                self._reap_cancelled()
+                self._admit()
+                if engine.active_count() == 0:
+                    with self.cond:
+                        if not self.queue:
+                            self.cond.wait(timeout=0.05)
+                    continue
+                try:
+                    events = engine.step()
+                except BaseException as e:
+                    for stream in list(self._streams.values()):
+                        self._fail_stream(stream, e)
+                    continue
+                self.sched_steps += 1
+                self.sched_slot_steps += len(events)
+                smetrics.BATCHES.labels(model=self.name).inc()
+                now = time.perf_counter()
+                for slot, tok, done in events:
+                    owner = self._slot_owner.get(slot)
+                    if owner is None:
+                        continue
+                    stream, pi = owner
+                    stream.tokens[pi].append(tok)
+                    smetrics.INTER_TOKEN.labels(
+                        model=self.name).observe(
+                        now - stream.last_tok_t[pi])
+                    stream.last_tok_t[pi] = now
+                    if done:
+                        del self._slot_owner[slot]
+                        del stream.slot2pi[slot]
+                        self._maybe_settle(stream)
+            except Exception:
+                # never let the scheduler die; back off so a
+                # persistent bookkeeping error can't hot-spin the
+                # thread, then re-evaluate from the maps
+                time.sleep(0.05)
+                continue
+
+    def mean_occupancy(self) -> float:
+        """Occupied slot-steps / total slot-steps since start — the
+        bench's aggregate slot-occupancy figure."""
+        if self.sched_steps == 0:
+            return 0.0
+        return self.sched_slot_steps / float(
+            self.sched_steps * self.engine.n_slots)
+
+
 class ModelServer:
     """Host N engines behind queues + batchers; optionally behind the
     JSON/TCP front end (``serve()``). The observability scrape endpoint
@@ -312,7 +543,10 @@ class ModelServer:
                 engine.warmup(aot_dir=aot_dir)
             else:
                 engine.warmup()
-        self._models[name] = _HostedModel(
+        hosted_cls = (_SlotHostedModel
+                      if isinstance(engine, SlotGenerativeModel)
+                      else _HostedModel)
+        self._models[name] = hosted_cls(
             name, engine,
             self._default_depth if max_queue_depth is None
             else max_queue_depth,
@@ -345,7 +579,16 @@ class ModelServer:
 
     def submit_generate(self, model: str, prompts: Sequence,
                         max_new: int,
-                        request_id: Optional[str] = None) -> _Future:
+                        request_id: Optional[str] = None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        seed: Optional[int] = None,
+                        eos_id: Optional[int] = None) -> _Future:
+        """Queue a generation. Sampling knobs ride on the request
+        (honored by slot-scheduled models; the wave batcher is greedy
+        and rejects non-greedy submits): ``temperature <= 0`` or
+        ``top_k == 1`` is exact greedy; ``seed`` makes a sampled stream
+        reproducible across retries AND server restarts (prompt i uses
+        seed + i); ``eos_id`` ends a stream early, freeing its slot."""
         m = self.model(model)
         prompts = [np.asarray(p, np.int64).reshape(-1) for p in prompts]
         if len(prompts) > m.max_rows:
@@ -356,19 +599,41 @@ class ModelServer:
         if max_allowed is not None and max_new > max_allowed:
             raise ValueError(f"max_new {max_new} exceeds the model's "
                              f"cache budget {max_allowed}")
+        sampled = float(temperature) > 0.0 and int(top_k) != 1
+        if (sampled or eos_id is not None or seed is not None) \
+                and not isinstance(m, _SlotHostedModel):
+            # reject rather than silently ignore: the wave batcher
+            # decodes every request to its full budget with no EOS
+            # reaping and no sampling state
+            raise ValueError(
+                f"model {model!r} is wave-scheduled (greedy, no "
+                f"eos/seed); host a SlotGenerativeModel for on-device "
+                f"sampling and EOS early-leave")
         req = _Request("generate", request_id or uuid.uuid4().hex,
                        len(prompts), prompts=prompts,
-                       max_new=int(max_new), signature="generate")
+                       max_new=int(max_new), signature="generate",
+                       temperature=temperature, top_k=top_k, seed=seed,
+                       eos_id=eos_id)
         return m.submit(req)
+
+    def cancel(self, model: str, request_id: str) -> bool:
+        """Cancel a queued or in-flight generation on a slot-scheduled
+        model; its slots are freed within one decode step. Returns
+        whether anything was cancelled."""
+        return self.model(model).cancel(request_id)
 
     def infer(self, model: str, feeds, request_id=None,
               timeout: Optional[float] = 60.0):
         return self.submit_infer(model, feeds, request_id).result(timeout)
 
     def generate(self, model: str, prompts, max_new: int,
-                 request_id=None, timeout: Optional[float] = 120.0):
-        return self.submit_generate(model, prompts, max_new,
-                                    request_id).result(timeout)
+                 request_id=None, timeout: Optional[float] = 120.0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: Optional[int] = None, eos_id: Optional[int] = None):
+        return self.submit_generate(
+            model, prompts, max_new, request_id,
+            temperature=temperature, top_k=top_k, seed=seed,
+            eos_id=eos_id).result(timeout)
 
     def stats(self) -> dict:
         out = {}
@@ -376,11 +641,18 @@ class ModelServer:
             with m.cond:
                 depth = len(m.queue)
                 inflight = len(m.inflight)
-            out[name] = {
+            row = {
                 "queue_depth": depth, "inflight": inflight,
                 "max_queue_depth": m.max_queue_depth,
                 "buckets": list(m.engine.policy.batch_buckets),
                 "kind": type(m.engine).__name__}
+            if isinstance(m, _SlotHostedModel):
+                row.update({
+                    "n_slots": m.engine.n_slots,
+                    "active_slots": m.engine.active_count(),
+                    "sched_steps": m.sched_steps,
+                    "mean_slot_occupancy": round(m.mean_occupancy(), 4)})
+            out[name] = row
         return out
 
     # -- RPC front end ---------------------------------------------------
@@ -424,10 +696,15 @@ class _RpcServer(socketserver.ThreadingTCPServer):
 _ERROR_KINDS = {
     RequestShedError: "shed",
     ModelNotFoundError: "not_found",
+    RequestCancelledError: "cancelled",
     PromptTooLongError: "bad_request",
     ValueError: "bad_request",
     TimeoutError: "timeout",
 }
+
+
+class _ClientGone(Exception):
+    """The requesting client hung up mid-request; nothing to reply to."""
 
 
 class _RpcHandler(socketserver.StreamRequestHandler):
@@ -444,6 +721,8 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                 req = json.loads(line)
                 faults.inject("serving.handle")
                 resp = self._dispatch(server, req)
+            except _ClientGone:
+                return
             except Exception as e:
                 kind = "error"
                 for klass, k in _ERROR_KINDS.items():
@@ -462,8 +741,20 @@ class _RpcHandler(socketserver.StreamRequestHandler):
             except (ConnectionError, OSError, BrokenPipeError):
                 return
 
-    @staticmethod
-    def _dispatch(server: ModelServer, req: dict) -> dict:
+    def _client_gone(self) -> bool:
+        """Peek the connection: readable-with-no-bytes means the client
+        hung up (our protocol is strict request/response, so nothing
+        legitimate arrives while a reply is pending)."""
+        import select
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket_module.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _dispatch(self, server: ModelServer, req: dict) -> dict:
         method = req.get("method")
         if method == "ping":
             return {"ok": True, "pong": True}
@@ -480,12 +771,35 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                     "outputs": [encode_array(np.asarray(o))
                                 for o in outs]}
         if method == "generate":
-            toks = server.generate(
+            req_id = req.get("req_id") or uuid.uuid4().hex
+            fut = server.submit_generate(
                 req["model"],
                 [np.asarray(p, np.int64) for p in req["prompts"]],
-                max_new=int(req.get("max_new", 1)),
-                request_id=req.get("req_id"))
+                max_new=int(req.get("max_new", 1)), request_id=req_id,
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                seed=req.get("seed"), eos_id=req.get("eos_id"))
+            deadline = time.monotonic() + 120.0
+            while True:
+                try:
+                    toks = fut.result(timeout=0.05)
+                    break
+                except TimeoutError:
+                    if time.monotonic() > deadline:
+                        # nobody will read a later reply on this
+                        # request/response wire — free its slots too
+                        server.cancel(req["model"], req_id)
+                        raise
+                    # a client killed mid-generation must not keep
+                    # burning its decode slots: cancel so the slots
+                    # free within one step (chaos-tested)
+                    if self._client_gone():
+                        server.cancel(req["model"], req_id)
+                        raise _ClientGone()
             return {"ok": True,
                     "tokens": [np.asarray(t).tolist() for t in toks]}
+        if method == "cancel":
+            ok = server.cancel(req["model"], req["req_id"])
+            return {"ok": True, "cancelled": bool(ok)}
         return {"ok": False, "kind": "bad_request",
                 "error": f"unknown method {method!r}"}

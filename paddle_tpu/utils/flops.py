@@ -207,10 +207,21 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
         h = int(attrs.get("n_head", 1))
         d = m // max(h, 1)
         return 2.0 * b * m * m * 4.0 * t + 2.0 * b * h * t * t * d
+    if op_type == "kv_attention_prefill_slot":
+        # same math as kv_attention_prefill; the pool scatter is a copy,
+        # not flops
+        x = ishape("X")
+        if x is None:
+            return 0.0
+        b, t, m = x[-3], x[-2], x[-1]
+        h = int(attrs.get("n_head", 1))
+        d = m // max(h, 1)
+        return 2.0 * b * m * m * 4.0 * t + 2.0 * b * h * t * t * d
     if op_type == "kv_attention_decode":
-        # one token: projections (4 × [B,1,M]·[M,M]) + dots over the
-        # STATIC cache length — independent of the decode position (the
-        # flat-decode-cost acceptance criterion)
+        # one token per row: projections (4 × [B,1,M]·[M,M]) + dots over
+        # the STATIC cache length — independent of the decode position
+        # AND of which rows are active (the flat-decode-cost acceptance
+        # criterion)
         x, ck = ishape("X"), ishape("CacheK")
         if x is None or ck is None:
             return 0.0
@@ -219,6 +230,13 @@ def op_fwd_flops(block, op_type, inputs, outputs, attrs, batch,
         h = int(attrs.get("n_head", 1))
         d = m // max(h, 1)
         return 2.0 * b * m * m * 4.0 + 2.0 * b * h * s * d * 2.0
+    if op_type == "token_sample":
+        lg = ishape("Logits")
+        if lg is None:
+            return 0.0
+        # argmax/top-k/gumbel over [B, V]: O(B·V) comparisons; the sort
+        # dominates but stays vector-unit small next to the matmuls
+        return float(_prod(lg))
     if op_type in ("dynamic_lstm", "dynamic_lstmp"):
         x = ishape("Input")              # [B, T, 4D] (pre-projected gates)
         if x is None:

@@ -196,6 +196,135 @@ def test_recv_fault_after_execution_dedups(served):
     client.close()
 
 
+# ---------------------------------------------------------------------------
+# in-flight batching chaos (ISSUE 9): slot lifecycle under failure
+# ---------------------------------------------------------------------------
+
+_SLOT_CACHE = {}
+
+
+def _slot_model():
+    """A slot engine with a LONG decode budget so cancellation always
+    races a generation that is genuinely mid-flight (the tiny model
+    finishes short budgets in milliseconds)."""
+    sgm = _SLOT_CACHE.get("sgm")
+    if sgm is None:
+        from paddle_tpu.models import transformer as T
+        sgm = serving.SlotGenerativeModel(
+            "lm_chaos_slot",
+            T.build_decoder_lm_programs(
+                prompt_len=8, max_new=512, vocab=32, d_model=16,
+                d_inner=32, n_head=2, n_layer=2,
+                modes=("prefill_slot", "decode_slot"), n_slots=2))
+        sgm.warmup()
+        _SLOT_CACHE["sgm"] = sgm
+    return sgm
+
+
+def _evictions(model, cause):
+    return smetrics.SLOT_EVICTIONS.labels(model=model,
+                                          cause=cause).value
+
+
+def test_cancel_frees_slot_within_one_step():
+    """An explicit cancel of an in-flight generation frees its slot
+    within one decode step: the future raises the typed error, the
+    eviction counter moves with cause=cancelled, and the slot is free
+    for the next admission."""
+    sgm = _slot_model()
+    server = serving.ModelServer()
+    server.add_model(sgm)
+    c0 = _evictions(sgm.name, "cancelled")
+    try:
+        fut = server.submit_generate(sgm.name, [np.arange(1, 6)],
+                                     max_new=500, request_id="cancel-1")
+        deadline = time.perf_counter() + 10
+        while sgm.active_count() == 0 and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        assert sgm.active_count() == 1
+        assert server.cancel(sgm.name, "cancel-1")
+        with pytest.raises(serving.RequestCancelledError):
+            fut.result(10)
+        # the future settles the moment the scheduler reaps — the slot
+        # is already free
+        assert sgm.active_count() == 0
+        assert _evictions(sgm.name, "cancelled") - c0 == 1
+        # the freed slot admits the next request immediately
+        (toks,) = server.generate(sgm.name, [np.arange(1, 6)],
+                                  max_new=4, timeout=30)
+        assert len(toks) == 4
+    finally:
+        server.stop()
+
+
+def test_killed_client_frees_slot_mid_generation():
+    """The mid-generation client kill: a raw socket starts a long
+    generation and dies; the RPC handler notices the hangup, cancels,
+    and the slot frees within one step instead of burning to
+    max-tokens."""
+    import json
+    import socket
+    sgm = _slot_model()
+    server = serving.ModelServer()
+    server.add_model(sgm)
+    endpoint = server.serve()
+    host, port = endpoint.rsplit(":", 1)
+    c0 = _evictions(sgm.name, "cancelled")
+    try:
+        s = socket.create_connection((host, int(port)), timeout=10)
+        s.sendall((json.dumps(
+            {"method": "generate", "model": sgm.name, "req_id": "kill-1",
+             "prompts": [[1, 2, 3]], "max_new": 500}) + "\n").encode())
+        deadline = time.perf_counter() + 10
+        while sgm.active_count() == 0 and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        assert sgm.active_count() == 1
+        time.sleep(0.05)                       # genuinely mid-flight
+        s.close()                              # the kill
+        deadline = time.perf_counter() + 10
+        while sgm.active_count() > 0 and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert sgm.active_count() == 0
+        assert _evictions(sgm.name, "cancelled") - c0 == 1
+    finally:
+        server.stop()
+
+
+def test_generate_retry_joins_inflight_stream():
+    """At-most-once on the slot scheduler: a retried generate
+    request_id JOINS the in-flight stream — same future, ONE slot
+    admission, ONE application — instead of double-allocating a slot."""
+    sgm = _slot_model()
+    server = serving.ModelServer()
+    server.add_model(sgm)
+    adm0 = smetrics.SLOT_ADMISSIONS.labels(model=sgm.name).value
+    app0 = smetrics.REQUESTS_APPLIED.labels(model=sgm.name).value
+    try:
+        f1 = server.submit_generate(sgm.name, [np.arange(1, 7)],
+                                    max_new=40, request_id="retry-1")
+        deadline = time.perf_counter() + 10
+        while sgm.active_count() == 0 and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        # the retry (lost-reply scenario) while the stream decodes
+        f2 = server.submit_generate(sgm.name, [np.arange(1, 7)],
+                                    max_new=40, request_id="retry-1")
+        assert f1 is f2                        # joined, not re-queued
+        (t1,) = f1.result(60)
+        assert len(t1) == 40
+        assert smetrics.SLOT_ADMISSIONS.labels(
+            model=sgm.name).value - adm0 == 1
+        assert smetrics.REQUESTS_APPLIED.labels(
+            model=sgm.name).value - app0 == 1
+        # a retry AFTER settlement answers from the idempotency cache
+        (t2,) = server.generate(sgm.name, [np.arange(1, 7)],
+                                max_new=40, request_id="retry-1")
+        np.testing.assert_array_equal(t1, t2)
+        assert smetrics.REQUESTS_APPLIED.labels(
+            model=sgm.name).value - app0 == 1
+    finally:
+        server.stop()
+
+
 def test_counters_match_full_fault_plan(served):
     """A combined plan across client and server sites: every counter
     (faults fired, retries, applies) matches the schedule exactly."""

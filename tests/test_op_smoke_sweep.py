@@ -199,20 +199,35 @@ spec("fused_attention_block",
       "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
       "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)]},
      {"n_head": 2, "causal": True})
-# serving KV-cache pair (ops/kv_attention.py): prefill populates a
-# [B, S, H, D] cache, decode writes one token at prompt_len + step
+# serving KV-cache family (ops/kv_attention.py): prefill populates a
+# [B, S, H, D] cache, prefill_slot scatters one request's rows into the
+# [n_slots, S, H, D] pool, decode writes one token per ACTIVE row at its
+# per-row pos, token_sample picks next tokens on-device
 spec("kv_attention_prefill",
      {"X": [f(2, 4, 8)],
       "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
       "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)]},
      {"n_head": 2, "cache_len": 6})
+spec("kv_attention_prefill_slot",
+     {"X": [f(1, 4, 8)],
+      "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
+      "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)],
+      "PoolK": [f(3, 6, 2, 4, seed=6)], "PoolV": [f(3, 6, 2, 4, seed=7)],
+      "Slot": [ints(1, 1, hi=3)]},
+     {"n_head": 2})
 spec("kv_attention_decode",
      {"X": [f(2, 1, 8)],
       "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
       "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)],
       "CacheK": [f(2, 6, 2, 4, seed=6)], "CacheV": [f(2, 6, 2, 4, seed=7)],
-      "Step": [ints(1, hi=2)], "SeqLen": [ints(2, 1, hi=4)]},
-     {"n_head": 2, "prompt_len": 4})
+      "Pos": [ints(2, 1, hi=6, seed=1)], "SeqLen": [ints(2, 1, hi=4)],
+      "GenStart": [ints(2, 1, hi=4, seed=2)],
+      "Active": [ints(2, 1, hi=2, seed=3)]},
+     {"n_head": 2})
+spec("token_sample",
+     {"Logits": [f(2, 16)], "Temperature": [f(2, 1, lo=0.0, hi=1.0)],
+      "TopK": [ints(2, 1, hi=5)], "Seed": [ints(2, 1, hi=100, seed=4)],
+      "StepIdx": [ints(2, 1, hi=4, seed=5)]})
 spec("batch_norm", {"X": [f(2, 3, 4, 4)], "Scale": [pos(3)],
                     "Bias": [f(3, seed=1)], "Mean": [f(3, seed=2)],
                     "Variance": [pos(3, seed=3)]}, {"is_test": False})

@@ -62,6 +62,23 @@ def _shared_lm():
     return gm
 
 
+def _shared_slot_lm():
+    """One warmed SlotGenerativeModel over the SAME config + seed as
+    :func:`_shared_lm` (identical weights), with a prompt bucket ladder
+    — the in-flight engine the parity tests drive against the wave
+    oracle."""
+    sgm = _LM_CACHE.get("sgm")
+    if sgm is None:
+        sgm = serving.SlotGenerativeModel(
+            "lm_slot_shared",
+            T.build_decoder_lm_programs(
+                **_LM_CFG, prompt_buckets=(4, 8),
+                modes=("prefill_slot", "decode_slot"), n_slots=4))
+        sgm.warmup()
+        _LM_CACHE["sgm"] = sgm
+    return sgm
+
+
 def _counter_value(family, **labels):
     return family.labels(**labels).value
 
@@ -471,6 +488,200 @@ def test_rpc_roundtrip(tmp_path):
     finally:
         client.close()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-flight batching: the slot scheduler (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_slot_scheduler_greedy_parity_random_arrivals():
+    """ACCEPTANCE: tokens produced by the slot scheduler under a
+    randomized join/leave interleaving (random arrival order, random
+    admission counts, mixed budgets and prompt lengths across the
+    prompt-bucket ladder) equal per-request sequential generate()
+    output — and the whole churn runs under forbid_compiles."""
+    gm, sgm = _shared_lm(), _shared_slot_lm()
+    rng = np.random.RandomState(11)
+    n_req = 10
+    prompts = [rng.randint(1, 32, (int(rng.randint(3, 9)),))
+               for _ in range(n_req)]
+    budgets = [int(rng.randint(2, 9)) for _ in range(n_req)]
+    oracle = [gm.generate([p], max_new=m)[0]
+              for p, m in zip(prompts, budgets)]
+
+    order = list(rng.permutation(n_req))       # randomized arrivals
+    collected, results, slot2idx = {}, {}, {}
+    sgm.reset()
+    with serving.forbid_compiles():
+        while order or slot2idx:
+            k = int(rng.randint(0, sgm.free_count() + 1))
+            if not slot2idx and order:
+                k = max(k, 1)                  # never stall
+            for _ in range(k):
+                if not order:
+                    break
+                i = order.pop(0)
+                slot, first, done = sgm.admit(prompts[i],
+                                              max_new=budgets[i])
+                collected[i] = [first]
+                if done:
+                    results[i] = collected[i]
+                else:
+                    slot2idx[slot] = i
+            for slot, tok, done in sgm.step():
+                i = slot2idx[slot]
+                collected[i].append(tok)
+                if done:
+                    results[i] = collected[i]
+                    del slot2idx[slot]
+    assert len(results) == n_req
+    for i in range(n_req):
+        np.testing.assert_array_equal(
+            np.asarray(results[i], np.int64), oracle[i][:budgets[i]])
+
+
+def test_slot_server_concurrent_join_leave_parity():
+    """The in-flight scheduler end to end: staggered concurrent submits
+    with mixed budgets (plus one EOS early-leave) each come back equal
+    to the sequential oracle, with ZERO compiles through the whole
+    join/leave churn."""
+    gm, sgm = _shared_lm(), _shared_slot_lm()
+    server = serving.ModelServer()
+    server.add_model(sgm)        # already warmed: warmup() is a no-op
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(1, 32, (int(rng.randint(3, 9)),))
+               for _ in range(8)]
+    budgets = [int(rng.randint(2, 9)) for _ in range(8)]
+    oracle = [gm.generate([p], max_new=m)[0]
+              for p, m in zip(prompts, budgets)]
+    try:
+        with serving.forbid_compiles():
+            futs = []
+            for i, p in enumerate(prompts):
+                futs.append(server.submit_generate(
+                    sgm.name, [p], max_new=budgets[i]))
+                if i % 3 == 0:
+                    time.sleep(0.003)          # interleave arrivals
+            outs = [f.result(60)[0] for f in futs]
+            # EOS leave: ask for the greedy stream's 2nd token as EOS —
+            # the stream must stop right there, freeing the slot
+            eos = int(oracle[0][1])
+            (cut,) = server.generate(sgm.name, [prompts[0]],
+                                     max_new=budgets[0], eos_id=eos)
+        for o, ref, m in zip(outs, oracle, budgets):
+            np.testing.assert_array_equal(o, ref[:m])
+        # the cut stream is a prefix of the greedy stream ending at EOS
+        assert len(cut) <= 2 and int(cut[-1]) == eos
+        np.testing.assert_array_equal(cut, oracle[0][:len(cut)])
+        assert sgm.active_count() == 0         # every slot left
+    finally:
+        server.stop()
+
+
+def test_on_device_sampling_parity_and_restart_reproducibility():
+    """Sampling satellite: temperature=0 and top_k=1 both bit-match the
+    greedy wave oracle; a seeded sampled stream replays identically on a
+    FRESH engine over freshly built programs (the server-restart
+    scenario); different seeds diverge."""
+    gm, sgm = _shared_lm(), _shared_slot_lm()
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 32, (6,)) for _ in range(3)]
+    greedy = [gm.generate([p], max_new=8)[0] for p in prompts]
+    for kwargs in (dict(temperature=0.0),
+                   dict(temperature=0.9, top_k=1)):
+        got = sgm.generate(prompts, max_new=8, **kwargs)
+        for a, b in zip(got, greedy):
+            np.testing.assert_array_equal(a, b)
+
+    seeds = [101, 102, 103]
+    s1 = sgm.generate(prompts, max_new=8, temperature=0.8, top_k=5,
+                      seeds=seeds)
+    sgm2 = serving.SlotGenerativeModel(
+        "lm_slot_restart",
+        T.build_decoder_lm_programs(
+            **_LM_CFG, modes=("prefill_slot", "decode_slot"),
+            n_slots=2))
+    sgm2.warmup()
+    s2 = sgm2.generate(prompts, max_new=8, temperature=0.8, top_k=5,
+                       seeds=seeds)
+    for a, b in zip(s1, s2):
+        np.testing.assert_array_equal(a, b)    # restart-reproducible
+    s3 = sgm.generate(prompts, max_new=8, temperature=0.8, top_k=5,
+                      seeds=[7, 8, 9])
+    assert any((a != b).any() for a, b in zip(s1, s3))
+
+
+def test_prompt_bucket_ladder_parity_and_cost():
+    """Prompt-ladder satellite: a GenerativeModel warmed over a bucket
+    ladder generates the same tokens as the single-bucket engine, and
+    short prompts prefill on the SMALL bucket's executable (strictly
+    fewer flops than worst-case prefill)."""
+    gm = _shared_lm()
+    gml = serving.GenerativeModel(
+        "lm_ladder",
+        T.build_decoder_lm_programs(**_LM_CFG, prompt_buckets=(4, 8)),
+        serving.BucketPolicy((2,)))
+    r = gml.warmup()
+    assert r["compiled"] == 3          # prefill@4, prefill@8, decode
+    rng = np.random.RandomState(14)
+    short = [rng.randint(1, 32, (3,)), rng.randint(1, 32, (4,))]
+    ref = gm.generate(short, max_new=6)
+    with serving.forbid_compiles():
+        out = gml.generate(short, max_new=6)
+    for a, b in zip(out, ref):
+        np.testing.assert_array_equal(a, b)
+    f4 = gml._cb_prefill[4].analyzed_flops(
+        gml.scope, gml._prefill_feeds(2, 4))
+    f8 = gml._cb_prefill[8].analyzed_flops(
+        gml.scope, gml._prefill_feeds(2, 8))
+    if f4 and f8:
+        assert f4 < f8
+
+
+def test_slot_metrics_on_scrape_endpoint():
+    """Observability satellite: TTFT + inter-token histograms and the
+    decode-slot-occupancy gauge are exported through the scrape
+    endpoint, and the TTFT histogram count matches the request
+    schedule."""
+    import urllib.request
+    from paddle_tpu.observability.exporters import MetricsServer
+    sgm = _shared_slot_lm()
+    server = serving.ModelServer()
+    server.add_model(sgm)
+    name = sgm.name
+    ttft0 = smetrics.TTFT.labels(model=name).count
+    itl0 = smetrics.INTER_TOKEN.labels(model=name).count
+    rng = np.random.RandomState(15)
+    n_req, budget = 3, 5
+    try:
+        futs = [server.submit_generate(
+            name, [rng.randint(1, 32, (5,))], max_new=budget)
+            for _ in range(n_req)]
+        outs = [f.result(60) for f in futs]
+        assert all(len(o[0]) == budget for o in outs)
+    finally:
+        server.stop()
+    # one TTFT observation per admitted prompt — the request schedule
+    assert smetrics.TTFT.labels(model=name).count - ttft0 == n_req
+    # every token after the first observes an inter-token gap
+    assert smetrics.INTER_TOKEN.labels(model=name).count - itl0 == \
+        n_req * (budget - 1)
+    assert smetrics.histogram_percentile(smetrics.TTFT, 0.99,
+                                         model=name) > 0
+    msrv = MetricsServer(port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://{msrv.endpoint}/metrics", timeout=10).read().decode()
+    finally:
+        msrv.stop()
+    assert f'paddle_serving_ttft_seconds_bucket{{model="{name}"' in body
+    assert (f'paddle_serving_inter_token_latency_seconds_bucket'
+            f'{{model="{name}"' in body)
+    assert (f'paddle_serving_decode_slot_occupancy_ratio'
+            f'{{model="{name}"}}' in body)
+    assert f'paddle_serving_slot_admissions_total{{model="{name}"}}' \
+        in body
+    assert "paddle_serving_slot_evictions_total" in body
 
 
 # ---------------------------------------------------------------------------

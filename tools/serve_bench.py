@@ -1,22 +1,32 @@
 """serve_bench: load generator + decode-path benchmark for the model
 server (paddle_tpu/serving; docs/serving.md).
 
-Two phases, one JSON row (default ``SERVE_r01.json``):
+Three phases, two JSON rows:
 
-1. **Decode benchmark** (the ISSUE 8 perf headline): greedy-generate
-   ``max_new`` tokens per prompt through (a) the prefill + KV-cache
-   decode path and (b) the full-forward-per-token baseline over the
-   SAME weights, and record tokens/s for both plus the speedup. Also
-   records ``analyzed_flops`` of the decode executable vs one full
-   forward — the flops-level witness that decode cost is flat in the
-   generated position.
+1. **Decode benchmark** (the ISSUE 8 perf headline, ``SERVE_r01.json``):
+   greedy-generate ``max_new`` tokens per prompt through (a) the
+   prefill + KV-cache decode path and (b) the full-forward-per-token
+   baseline over the SAME weights, and record tokens/s for both plus
+   the speedup. Also records ``analyzed_flops`` of the decode
+   executable vs one full forward — the flops-level witness that decode
+   cost is flat in the generated position.
 
-2. **Load test**: a ModelServer hosting a classifier ServedModel +
-   the generative model, hammered by concurrent client threads with
-   mixed batch sizes over the RPC front end; records requests/s,
-   tokens/s, batch occupancy, queue sheds, p50/p99 request latency
-   (from the exported histogram), and asserts the compile counter
-   stayed FLAT across the load (zero steady-state compiles).
+2. **Load test** (also ``SERVE_r01.json``): a ModelServer hosting a
+   classifier ServedModel, hammered by concurrent client threads with
+   mixed batch sizes over the RPC front end; records requests/s, batch
+   occupancy, queue sheds, p50/p99 request latency, and asserts the
+   compile counter stayed FLAT across the load.
+
+3. **Generation load** (the ISSUE 9 headline, ``SERVE_r02.json``):
+   Poisson arrivals with mixed prompt lengths and mixed token budgets,
+   replayed against BOTH generation schedulers over the same weights —
+   the wave-per-batch control arm (GenerativeModel) and the in-flight
+   slot scheduler (SlotGenerativeModel). Records aggregate tokens/s,
+   TTFT p50/p99 (from the exported ``paddle_serving_ttft_seconds``
+   histogram), mean decode-slot occupancy, and the flat compile
+   counter; the acceptance target is >=2x aggregate tokens/s for the
+   slot arm with TTFT p99 bounded by prefill+queue rather than wave
+   length.
 
     python tools/serve_bench.py                  # defaults (T=64)
     python tools/serve_bench.py --prompt-len 64 --max-new 64 --out SERVE_r01.json
@@ -178,6 +188,109 @@ def bench_load(args) -> dict:
     return row
 
 
+def bench_generation(args) -> dict:
+    """ISSUE 9: Poisson-arrival generation load, wave-per-batch control
+    arm vs the in-flight slot scheduler over the same weights and the
+    same request schedule."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics as smetrics
+    from paddle_tpu.models import transformer as T
+
+    p_max = args.gen_prompt_len
+    n_max = args.gen_max_new
+    n_slots = args.gen_slots
+    buckets = tuple(sorted({max(1, p_max // 4), max(1, p_max // 2),
+                            p_max}))
+    cfg = dict(prompt_len=p_max, max_new=n_max, vocab=args.vocab,
+               d_model=args.gen_d_model, d_inner=4 * args.gen_d_model,
+               n_head=args.n_head, n_layer=args.gen_n_layer)
+    gm = serving.GenerativeModel(
+        "lm_wave",
+        T.build_decoder_lm_programs(**cfg, prompt_buckets=buckets,
+                                    modes=("prefill", "decode")),
+        serving.BucketPolicy.pow2(n_slots))
+    sgm = serving.SlotGenerativeModel(
+        "lm_slot",
+        T.build_decoder_lm_programs(**cfg, prompt_buckets=buckets,
+                                    modes=("prefill_slot",
+                                           "decode_slot"),
+                                    n_slots=n_slots))
+    server = serving.ModelServer(linger_s=0.001, max_queue_depth=4096)
+    t0 = time.perf_counter()
+    server.add_model(gm)
+    server.add_model(sgm)
+    warmup_s = time.perf_counter() - t0
+
+    # one schedule, replayed against both arms: Poisson arrivals fast
+    # enough to contend the pool, mixed prompt lengths, and a
+    # heavy-tailed (bimodal) budget mix — the chat-traffic shape where
+    # wave-per-batch hurts most: the whole wave decodes to its LONGEST
+    # member's budget while finished rows ride along as padding
+    rng = np.random.RandomState(0)
+    n_req = args.gen_requests
+    arrivals = np.cumsum(rng.exponential(
+        args.gen_interarrival_ms / 1000.0, n_req))
+    plens = rng.randint(3, p_max + 1, n_req)
+    short_hi = max(3, n_max // 8)
+    budgets = np.where(
+        rng.rand(n_req) < 0.75,
+        rng.randint(2, short_hi + 1, n_req),           # most: short
+        rng.randint(3 * n_max // 4, n_max + 1, n_req))  # tail: long
+    prompts = [rng.randint(1, args.vocab, (int(l),)) for l in plens]
+
+    def run_arm(model: str) -> dict:
+        futs = [None] * n_req
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            wait = arrivals[i] - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            futs[i] = server.submit_generate(
+                model, [prompts[i]], max_new=int(budgets[i]))
+        outs = [f.result(600) for f in futs]
+        elapsed = time.perf_counter() - t0
+        tokens = sum(len(o[0]) for o in outs)
+        return {
+            "requests": n_req,
+            "tokens": int(tokens),
+            "elapsed_s": round(elapsed, 3),
+            "tokens_per_s": round(tokens / elapsed, 1),
+            "ttft_p50_s": smetrics.histogram_percentile(
+                smetrics.TTFT, 0.5, model=model),
+            "ttft_p99_s": smetrics.histogram_percentile(
+                smetrics.TTFT, 0.99, model=model),
+        }
+
+    compiles0 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    with serving.forbid_compiles():      # join/leave churn, zero compiles
+        wave = run_arm("lm_wave")
+        slot = run_arm("lm_slot")
+    compiles1 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    hosted = server.model("lm_slot")
+    slot["mean_slot_occupancy"] = round(hosted.mean_occupancy(), 3)
+    slot["sched_steps"] = hosted.sched_steps
+    server.stop()
+    return {
+        "config": {"prompt_len": p_max, "max_new": n_max,
+                   "n_slots": n_slots, "prompt_buckets": list(buckets),
+                   "requests": n_req,
+                   "interarrival_ms": args.gen_interarrival_ms,
+                   "vocab": args.vocab, "d_model": args.gen_d_model,
+                   "n_head": args.n_head, "n_layer": args.gen_n_layer},
+        "warmup_s": round(warmup_s, 3),
+        "wave_per_batch": wave,
+        "slot_scheduler": slot,
+        "tokens_per_s_ratio": round(
+            slot["tokens_per_s"] / wave["tokens_per_s"], 2),
+        "ttft_p99_ratio": round(
+            wave["ttft_p99_s"] / slot["ttft_p99_s"], 2)
+        if slot["ttft_p99_s"] else None,
+        "steady_state_compiles": compiles1 - compiles0,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--prompt-len", type=int, default=64)
@@ -191,19 +304,33 @@ def main(argv=None):
     ap.add_argument("--load-requests", type=int, default=50,
                     help="requests per client thread")
     ap.add_argument("--load-max-batch", type=int, default=8)
+    ap.add_argument("--gen-prompt-len", type=int, default=32)
+    ap.add_argument("--gen-max-new", type=int, default=96)
+    ap.add_argument("--gen-d-model", type=int, default=256,
+                    help="generation-phase model width (the decode "
+                         "phase keeps --d-model)")
+    ap.add_argument("--gen-n-layer", type=int, default=4)
+    ap.add_argument("--gen-slots", type=int, default=8)
+    ap.add_argument("--gen-requests", type=int, default=96)
+    ap.add_argument("--gen-interarrival-ms", type=float, default=2.0,
+                    help="mean Poisson inter-arrival time")
     ap.add_argument("--skip-load", action="store_true")
+    ap.add_argument("--skip-gen", action="store_true")
     ap.add_argument("--out", default="SERVE_r01.json")
+    ap.add_argument("--gen-out", default="SERVE_r02.json")
     args = ap.parse_args(argv)
+
+    def _resolve(path):
+        return os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), path) \
+            if not os.path.isabs(path) else path
 
     row = {"bench": "serving",
            "device": os.environ.get("JAX_PLATFORMS", "auto"),
            "decode": bench_decode(args)}
     if not args.skip_load:
         row["load"] = bench_load(args)
-    out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), args.out) \
-        if not os.path.isabs(args.out) else args.out
-    with open(out, "w") as f:
+    with open(_resolve(args.out), "w") as f:
         json.dump(row, f, indent=2)
         f.write("\n")
     print(json.dumps(row, indent=2))
@@ -211,6 +338,19 @@ def main(argv=None):
     print(f"serve_bench: decode speedup {speedup}x vs full-forward "
           f"baseline at T={args.prompt_len} "
           f"({'>=5x OK' if speedup >= 5 else 'BELOW the 5x target'})")
+
+    if not args.skip_gen:
+        gen = {"bench": "serving_generation",
+               "device": os.environ.get("JAX_PLATFORMS", "auto"),
+               "generation": bench_generation(args)}
+        with open(_resolve(args.gen_out), "w") as f:
+            json.dump(gen, f, indent=2)
+            f.write("\n")
+        print(json.dumps(gen, indent=2))
+        ratio = gen["generation"]["tokens_per_s_ratio"]
+        print(f"serve_bench: slot scheduler {ratio}x aggregate tokens/s "
+              f"vs wave-per-batch under Poisson load "
+              f"({'>=2x OK' if ratio >= 2 else 'BELOW the 2x target'})")
     return 0
 
 

@@ -31,12 +31,14 @@ import sys
 # builds; the full zoo is covered by tests/test_analysis.py)
 LINT_MODELS = ("mnist", "smallnet")
 
-# the serving program pair (prefill + KV-cache decode) linted in is-test
-# mode — the exported executables the model server warms must stay
-# verifier-green (ISSUE 8 satellite; docs/serving.md)
+# the serving programs (prefill + KV-cache decode, wave AND slot-pool
+# views) linted in is-test mode — the exported executables the model
+# server warms must stay verifier-green (ISSUE 8/9; docs/serving.md)
 LINT_SERVING_MODULES = (
     "paddle_tpu.models.transformer:serve_lint_prefill",
     "paddle_tpu.models.transformer:serve_lint_decode",
+    "paddle_tpu.models.transformer:serve_lint_prefill_slot",
+    "paddle_tpu.models.transformer:serve_lint_decode_slot",
 )
 
 
