@@ -396,7 +396,7 @@ class Executor:
         # the flags-unset hot path pays nothing here (<2% overhead
         # contract on the bench step loop)
         span = (_obs_tracing.span("executor.run", iterations=iterations)
-                if (obs_on or _obs_tracing.default_tracer().enabled)
+                if (obs_on or _obs_tracing.active())
                 else contextlib.nullcontext())
         with span:
             if iterations > 1:

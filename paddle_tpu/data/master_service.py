@@ -51,6 +51,7 @@ from typing import Optional, Tuple
 from paddle_tpu.data.master import Master, Task
 from paddle_tpu.distributed.resilience import RetryError, RetryPolicy
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace_context as tctx
 from paddle_tpu.utils import faults
 
 MASTER_ENV = "PADDLE_MASTER"
@@ -113,7 +114,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             try:
                 req = json.loads(line)
-                resp = self._dispatch(master, req, self.server)
+                # adopt the worker's trace context so this RPC's span
+                # (and anything it triggers — snapshot persists) parents
+                # under the worker's span in the merged trace
+                ctx = tctx.extract(req)
+                with tctx.activate(ctx if ctx is not None
+                                   else tctx.current()):
+                    with tctx.span("master." + str(req.get("method")),
+                                   worker=str(req.get("worker") or "")):
+                        resp = self._dispatch(master, req, self.server)
             except Exception as e:  # malformed request: report, keep serving
                 resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
             try:
@@ -513,14 +522,19 @@ class MasterClient:
                 raise RuntimeError(f"master error: {resp.get('error')}")
             return resp
 
-        with self._lock:
-            try:
-                return (retry or self._retry).call(
-                    attempt, what=str(req.get("method")))
-            except RetryError as e:
-                raise MasterUnavailableError(
-                    f"{self._addr[0]}:{self._addr[1]}", e.attempts,
-                    e.elapsed_s, e.__cause__) from e.__cause__
+        # one client span per LOGICAL call (retries included); the
+        # traceparent is injected while it is current, so master-side
+        # spans — heartbeats included — parent under this worker's span
+        with tctx.client_span("master." + str(req.get("method"))):
+            tctx.inject(req)
+            with self._lock:
+                try:
+                    return (retry or self._retry).call(
+                        attempt, what=str(req.get("method")))
+                except RetryError as e:
+                    raise MasterUnavailableError(
+                        f"{self._addr[0]}:{self._addr[1]}", e.attempts,
+                        e.elapsed_s, e.__cause__) from e.__cause__
 
     # -- Master duck interface ------------------------------------------
     def get_task(self) -> Optional[Task]:

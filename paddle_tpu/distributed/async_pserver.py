@@ -45,6 +45,7 @@ from paddle_tpu.fluid import framework
 
 from paddle_tpu.fluid.transpiler import GRAD_SUFFIX
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace_context as tctx
 
 # async-pserver telemetry (docs/observability.md): RPC latency by op,
 # client-side retries by op, server-side applies. The trainer client's
@@ -234,22 +235,34 @@ class AsyncPServer:
                 msg = conn.recv()
                 kind = msg[0]
                 if kind == "push":
-                    # ("push", name, value[, trainer_id]); id-less pushes
-                    # (old protocol) get no DC compensation rather than
-                    # borrowing trainer 0's backup
+                    # ("push", name, value[, trainer_id[, traceparent]]);
+                    # id-less pushes (old protocol) get no DC compensation
+                    # rather than borrowing trainer 0's backup
                     name, value = msg[1], msg[2]
                     tid = msg[3] if len(msg) > 3 else None
+                    ctx = (tctx.from_traceparent(msg[4])
+                           if len(msg) > 4 else None)
                     try:
-                        self.apply_grad(name, value, trainer_id=tid)
+                        with tctx.activate(ctx if ctx is not None
+                                           else tctx.current()):
+                            with tctx.span("pserver.push", grad=name):
+                                self.apply_grad(name, value, trainer_id=tid)
                     except Exception as e:      # reply, don't kill the conn
                         conn.send(("err", f"push {name!r}: {e!r}"))
                         continue
                     conn.send(("ok",))
                 elif kind == "pull":
-                    # ("pull", names[, trainer_id])
+                    # ("pull", names[, trainer_id[, traceparent]])
                     tid = msg[2] if len(msg) > 2 else None
+                    ctx = (tctx.from_traceparent(msg[3])
+                           if len(msg) > 3 else None)
                     try:
-                        params = self.get_params(msg[1], trainer_id=tid)
+                        with tctx.activate(ctx if ctx is not None
+                                           else tctx.current()):
+                            with tctx.span("pserver.pull",
+                                           params=len(msg[1])):
+                                params = self.get_params(msg[1],
+                                                         trainer_id=tid)
                     except Exception as e:
                         conn.send(("err", f"pull: {e!r}"))
                         continue
@@ -319,6 +332,16 @@ class AsyncTrainerClient:
             self._conn = None
 
     def _rpc(self, msg, site: str, idempotent: bool = True):
+        # one client span per LOGICAL call (retries included); the
+        # traceparent rides the positional wire protocol as an optional
+        # trailing element — old servers' len()-guarded parsing ignores it
+        with tctx.client_span("pserver." + str(msg[0])):
+            ctx = tctx.current()
+            if ctx is not None:
+                msg = tuple(msg) + (ctx.to_traceparent(),)
+            return self._rpc_inner(msg, site, idempotent)
+
+    def _rpc_inner(self, msg, site: str, idempotent: bool = True):
         import time as _time
 
         from paddle_tpu.distributed.resilience import Unretryable

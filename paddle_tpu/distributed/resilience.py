@@ -219,6 +219,12 @@ class CircuitBreaker:
                 self._publish_state()
                 if not was_open:
                     BREAKER_OPENS.labels(name=self.name).inc()
+                    # a breaker trip is exactly the kind of last-moments
+                    # context the flight recorder exists for (no-op when
+                    # the recorder is off)
+                    from paddle_tpu.observability import flight_recorder
+                    flight_recorder.note("breaker_open", breaker=self.name,
+                                         failures=self._failures)
 
     def call(self, fn: Callable):
         if not self.allow():

@@ -158,6 +158,25 @@ define("verify_program", bool, False,
        "provenance; warnings are counted in "
        "paddle_analysis_diagnostics_total. Standalone linting: "
        "tools/proglint.py; rule catalog: docs/static_analysis.md.")
+define("trace_spool_dir", str, "",
+       "Directory the per-process span spool appends to "
+       "(<role>.<pid>.jsonl, one JSON span per line, flushed per span — "
+       "crash-tolerant). Empty (default) disables. Merge every spool "
+       "into one Perfetto trace with tools/trace_collect.py; see "
+       "docs/observability.md 'Distributed tracing'.")
+define("trace_role", str, "",
+       "Role label naming this process's spool file and Perfetto "
+       "process track ('server', 'client', 'trainer0'...). Defaults to "
+       "the process name derived from sys.argv when empty.")
+define("flight_recorder_dir", str, "",
+       "Directory for the crash flight recorder: a bounded in-memory "
+       "ring of recent spans, metric deltas and fault-site hits, dumped "
+       "atomically (<role>.<pid>.dump.json) on unhandled exception, "
+       "SIGTERM, or a fault-injection fire — plus an always-flushed "
+       "blackbox JSONL that survives SIGKILL. Empty (default) disables "
+       "(paddle_tpu.observability.flight_recorder).")
+define("flight_recorder_capacity", int, 256,
+       "Ring capacity (recent events kept) of the flight recorder.")
 define("peak_flops", float, 0.0,
        "Override the peak-FLOP/s denominator of the MFU gauge "
        "(paddle_mfu_ratio). 0 (default) autodetects from the attached "

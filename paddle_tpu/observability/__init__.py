@@ -19,7 +19,16 @@ watchable:
 - :mod:`~paddle_tpu.observability.exporters` — background JSONL step
   log + Prometheus text file (``FLAGS_metrics_dump_path`` /
   ``FLAGS_metrics_dump_interval``) and an optional stdlib http scrape
-  endpoint (``FLAGS_metrics_port``).
+  endpoint (``FLAGS_metrics_port``, with ``/healthz``);
+- :mod:`~paddle_tpu.observability.trace_context` — W3C-traceparent
+  style cross-process trace context (inject/extract on every JSON wire
+  format) so spans parent correctly across processes;
+- :mod:`~paddle_tpu.observability.spool` — crash-tolerant per-process
+  span spool (``FLAGS_trace_spool_dir``), merged by
+  ``tools/trace_collect.py`` into one Perfetto trace;
+- :mod:`~paddle_tpu.observability.flight_recorder` — black-box ring of
+  recent spans / metric deltas / fault fires, dumped on crash signals
+  (``FLAGS_flight_recorder_dir``).
 
 Everything is off by default; with no observability flag set the hot
 path pays one flag lookup per executor dispatch. Metric catalog and
@@ -30,13 +39,18 @@ from __future__ import annotations
 
 from paddle_tpu.observability import metrics  # noqa: F401
 from paddle_tpu.observability import tracing  # noqa: F401
+from paddle_tpu.observability import trace_context  # noqa: F401
 from paddle_tpu.observability import runtime  # noqa: F401
 from paddle_tpu.observability import exporters  # noqa: F401
+from paddle_tpu.observability import spool  # noqa: F401
+from paddle_tpu.observability import flight_recorder  # noqa: F401
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
     gauge, histogram)
 from paddle_tpu.observability.tracing import (  # noqa: F401
     Tracer, default_tracer, span, trace)
+from paddle_tpu.observability.trace_context import (  # noqa: F401
+    TraceContext, extract, inject, new_trace)
 
 _force_enabled = False
 

@@ -132,7 +132,18 @@ class MetricsDumper:
 
 class _ScrapeHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] not in ("/metrics", "/"):
+        route = self.path.split("?")[0]
+        if route == "/healthz":
+            # liveness probe for process-launch tests / orchestrators:
+            # no registry render, just "this process serves HTTP"
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if route not in ("/metrics", "/"):
             self.send_error(404)
             return
         body = self.server.registry.render_prometheus().encode()
@@ -184,6 +195,10 @@ def _preregister_catalog():
     snapshot of ANY observed run) holds without those paths firing."""
     import importlib
     for mod in ("paddle_tpu.observability.runtime",
+                # the tracer's ring-overflow counter
+                # (paddle_trace_dropped_spans_total) — silent span loss
+                # is a lying timeline, so it's part of the catalog
+                "paddle_tpu.observability.tracing",
                 "paddle_tpu.distributed.resilience",
                 "paddle_tpu.distributed.async_pserver",
                 "paddle_tpu.data.master_service",

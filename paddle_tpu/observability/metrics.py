@@ -118,8 +118,12 @@ class Histogram:
         self._bucket_counts = [0] * len(self.upper_bounds)
         self._sum = 0.0
         self._count = 0
+        # last exemplar per bucket index (len(upper_bounds) = +Inf):
+        # bounded by construction, so an outlier in the top bucket is
+        # one lookup away from its trace (docs/observability.md)
+        self._exemplars: Dict[int, str] = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
         v = float(value)
         # le semantics: v lands in the smallest bucket whose bound >= v
         # (bisect_left keeps an exact-bound observation in that bucket)
@@ -130,6 +134,8 @@ class Histogram:
                 self._bucket_counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = str(exemplar)
 
     def time(self):
         """``with hist.time(): ...`` — observe the block's duration."""
@@ -160,6 +166,13 @@ class Histogram:
                 out.append((ub, acc))
             out.append((float("inf"), self._count))
             return out, self._sum, self._count
+
+    def exemplars(self) -> Dict[float, str]:
+        """{bucket_upper_bound: last exemplar} for buckets that have
+        one (e.g. the trace_id of the last sample to land there)."""
+        with self._lock:
+            bounds = self.upper_bounds + (float("inf"),)
+            return {bounds[i]: ex for i, ex in self._exemplars.items()}
 
 
 class _HistTimer:
@@ -240,8 +253,8 @@ class Family:
     def set(self, value: float):
         self._solo().set(value)
 
-    def observe(self, value: float):
-        self._solo().observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None):
+        self._solo().observe(value, exemplar)
 
     def time(self):
         return self._solo().time()
@@ -360,10 +373,16 @@ class MetricsRegistry:
                 labels = dict(zip(fam.labelnames, values))
                 if fam.kind == "histogram":
                     buckets, hsum, hcount = child.snapshot()
-                    samples.append({
+                    sample = {
                         "labels": labels, "sum": hsum, "count": hcount,
                         "buckets": [[("inf" if ub == float("inf") else ub),
-                                     c] for ub, c in buckets]})
+                                     c] for ub, c in buckets]}
+                    ex = child.exemplars()
+                    if ex:
+                        sample["exemplars"] = {
+                            ("inf" if ub == float("inf") else str(ub)): e
+                            for ub, e in ex.items()}
+                    samples.append(sample)
                 else:
                     samples.append({"labels": labels,
                                     "value": child.value})

@@ -15,9 +15,24 @@ Two always-cheap layers:
   tracer is *enabled* (``start()``/``stop()``), bounded by ``max_spans``
   so a forgotten ``start()`` cannot grow memory without bound.
 
+Distributed additions (docs/observability.md "Distributed tracing"):
+- spans carry optional **trace identity** (trace_id/span_id/parent_id
+  from ``observability.trace_context``), and :meth:`Tracer.span`
+  auto-parents under the thread's current :class:`TraceContext`, so an
+  RPC handler that activated its caller's context gets correctly
+  parented ``executor.run`` / ``master.*`` spans for free;
+- **sinks** — callables invoked with each finished :class:`Span`
+  (outside the tracer lock); the per-process spool and the flight
+  recorder attach here. Spans are *constructed* when enabled OR a sink
+  is attached; the in-memory ring only fills while enabled.
+- ring overflow is no longer silent: drops count into
+  ``paddle_trace_dropped_spans_total`` (exporter-preregistered) and the
+  first drop emits a one-time warning.
+
 Export: :func:`to_chrome_trace` emits the chrome://tracing JSON dict,
 which Perfetto (ui.perfetto.dev) opens natively — the host-side half of
 the timeline; device-side traces stay with jax.profiler (XPlane).
+Cross-process merge is ``tools/trace_collect.py`` over the spools.
 """
 
 from __future__ import annotations
@@ -27,8 +42,17 @@ import functools
 import json
 import threading
 import time
+import warnings
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
+
+from paddle_tpu.observability import metrics as _metrics
+
+DROPPED_SPANS = _metrics.counter(
+    "paddle_trace_dropped_spans_total",
+    "Spans dropped on the tracer ring's max_spans bound — a non-zero "
+    "value means the in-memory timeline is truncated (raise max_spans "
+    "or export more often); spool/flight-recorder sinks still saw them")
 
 
 @dataclass
@@ -38,6 +62,10 @@ class Span:
     end_s: float
     tid: int                  # real thread id (threading.get_ident())
     args: Optional[dict] = None
+    # distributed identity (None for purely local spans)
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
 
     @property
     def duration_s(self) -> float:
@@ -62,7 +90,9 @@ class Tracer:
         self._events: Dict[str, _EventStat] = {}
         self._spans: List[Span] = []
         self._dropped = 0
+        self._dropped_warned = False
         self._enabled = False
+        self._sinks: List[Callable[[Span], None]] = []
         self.max_spans = int(max_spans)
 
     # -- control ---------------------------------------------------------
@@ -76,18 +106,45 @@ class Tracer:
     def stop(self):
         self._enabled = False
 
+    def active(self) -> bool:
+        """True when spans are being captured (ring enabled or any sink
+        attached) — the cheap gate hot paths check before building span
+        arguments."""
+        return self._enabled or bool(self._sinks)
+
+    def add_sink(self, sink: Callable[[Span], None]):
+        """Attach a per-span callback (spool writer, flight recorder).
+        Called OUTSIDE the tracer lock; exceptions are swallowed — a
+        broken sink must not take down the traced code."""
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks.append(sink)
+
+    def remove_sink(self, sink: Callable[[Span], None]):
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
     def reset(self):
         with self._lock:
             self._events.clear()
             self._spans.clear()
             self._dropped = 0
+            self._dropped_warned = False
 
     # -- recording -------------------------------------------------------
     def record(self, name: str, start_s: float, end_s: float,
-               tid: Optional[int] = None, args: Optional[dict] = None):
+               tid: Optional[int] = None, args: Optional[dict] = None,
+               trace=None):
         """Record one finished span: aggregates always, the span record
-        only while enabled. Safe from any thread."""
+        while enabled (ring) or sinks are attached (spool / flight
+        recorder). ``trace`` is an optional
+        ``trace_context.TraceContext`` giving the span its distributed
+        identity. Safe from any thread."""
         dt = end_s - start_s
+        sp = None
+        sinks = ()
+        dropped = first_drop = False
         with self._lock:
             e = self._events.get(name)
             if e is None:
@@ -98,24 +155,63 @@ class Tracer:
                 e.min = dt
             if dt > e.max:
                 e.max = dt
-            if self._enabled:
-                if len(self._spans) < self.max_spans:
-                    self._spans.append(Span(
-                        name, start_s, end_s,
-                        tid if tid is not None else threading.get_ident(),
-                        args))
-                else:
-                    self._dropped += 1
+            if self._enabled or self._sinks:
+                sp = Span(
+                    name, start_s, end_s,
+                    tid if tid is not None else threading.get_ident(),
+                    args,
+                    trace.trace_id if trace is not None else None,
+                    trace.span_id if trace is not None else None,
+                    trace.parent_id if trace is not None else None)
+                if self._enabled:
+                    if len(self._spans) < self.max_spans:
+                        self._spans.append(sp)
+                    else:
+                        self._dropped += 1
+                        dropped = True
+                        if not self._dropped_warned:
+                            self._dropped_warned = first_drop = True
+                sinks = tuple(self._sinks)
+        # metric/warning/sinks outside the lock: none of them may block
+        # (or re-enter) the recording path
+        if dropped:
+            DROPPED_SPANS.inc()
+            if first_drop:
+                warnings.warn(
+                    f"tracer ring full ({self.max_spans} spans): further "
+                    f"spans are dropped and counted in "
+                    f"paddle_trace_dropped_spans_total", RuntimeWarning,
+                    stacklevel=3)
+        if sp is not None:
+            for cb in sinks:
+                try:
+                    cb(sp)
+                except Exception:
+                    pass
 
     @contextlib.contextmanager
     def span(self, name: str, **args):
-        """``with tracer.span("step"): ...`` — RAII span + aggregate."""
+        """``with tracer.span("step"): ...`` — RAII span + aggregate.
+
+        While capturing, the span auto-parents under the thread's
+        current :class:`TraceContext` (and exposes itself as current for
+        the block), so spans nest causally across process boundaries
+        once an RPC layer activated the caller's context."""
+        ctx = token = tc = None
+        if self._enabled or self._sinks:
+            from paddle_tpu.observability import trace_context as tc
+            parent = tc.current()
+            if parent is not None:
+                ctx = parent.child()
+                token = tc.attach(ctx)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.record(name, t0, time.perf_counter(),
-                        args=args or None)
+            t1 = time.perf_counter()
+            if token is not None:
+                tc.detach(token)
+            self.record(name, t0, t1, args=args or None, trace=ctx)
 
     def trace(self, name_or_fn=None):
         """Decorator form: ``@tracer.trace`` or ``@tracer.trace("name")``."""
@@ -167,10 +263,39 @@ class Tracer:
 
 
 _DEFAULT = Tracer()
+_autostart_done = False
 
 
 def default_tracer() -> Tracer:
     return _DEFAULT
+
+
+def _autostart_from_flags():
+    """One-shot: attach the span spool / flight recorder when their
+    flags are set (how a ``tools/launch.py`` child — which cannot call
+    our Python API before main — turns capture on via env)."""
+    global _autostart_done
+    _autostart_done = True
+    from paddle_tpu.observability import flight_recorder, spool
+    spool.maybe_start_from_flags()
+    flight_recorder.maybe_start_from_flags()
+
+
+def active() -> bool:
+    """One cheap check for hot paths: is ANY span capture on (tracer
+    ring, spool, flight recorder)? First call consults the spool/flight
+    flags so flag-configured processes start capturing lazily."""
+    if not _autostart_done:
+        _autostart_from_flags()
+    return _DEFAULT._enabled or bool(_DEFAULT._sinks)
+
+
+def add_sink(sink: Callable[[Span], None]) -> None:
+    _DEFAULT.add_sink(sink)
+
+
+def remove_sink(sink: Callable[[Span], None]) -> None:
+    _DEFAULT.remove_sink(sink)
 
 
 def span(name: str, **args):

@@ -36,6 +36,7 @@ import numpy as np
 
 from paddle_tpu.distributed.resilience import (CircuitBreaker, RetryError,
                                                RetryPolicy)
+from paddle_tpu.observability import trace_context as tctx
 from paddle_tpu.serving.server import (SERVING_ENV, ModelNotFoundError,
                                        RequestCancelledError,
                                        RequestShedError, decode_array,
@@ -96,6 +97,10 @@ class ServingClient:
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
+        # trace_id of the last successful RPC (the server returns the
+        # request_id↔trace_id mapping): feed it to the exemplar lookup
+        # recipe / grep it in the merged tools/trace_collect.py trace
+        self.last_trace_id: Optional[str] = None
 
     # -- wire ------------------------------------------------------------
     def _connect(self):
@@ -115,6 +120,20 @@ class ServingClient:
         self._sock = self._rfile = None
 
     def _call(self, req: dict) -> dict:
+        # the client-side request span: one per LOGICAL call (retries
+        # included), with the traceparent injected while it is current —
+        # every server-side span of this request parents under it, so
+        # the merged trace shows the client span containing the server's
+        # admission → prefill → decode → settle. No-op when tracing off.
+        with tctx.client_span(f"serving.{req.get('method')}"):
+            tctx.inject(req)
+            resp = self._call_locked(req)
+        tid = resp.get("trace_id")
+        if tid:
+            self.last_trace_id = tid
+        return resp
+
+    def _call_locked(self, req: dict) -> dict:
         def raw_attempt():
             try:
                 if self._sock is None:

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu.observability import trace_context as tctx
 from paddle_tpu.serving import bucketing
 from paddle_tpu.serving import metrics as smetrics
 from paddle_tpu.utils import padding as _padding
@@ -437,8 +438,11 @@ class GenerativeModel:
             ids[i, :len(p)] = np.asarray(p, np.int64)
         blens = _padding.pad_rows(lens[:, None], bucket)
 
-        logits = self._dispatch("prefill", bucket,
-                                {"ids": ids[:, :, None]}, p_len=p_len)
+        with tctx.span(f"serving.prefill@{p_len}", model=self.name,
+                       rows=bucket):
+            logits = self._dispatch("prefill", bucket,
+                                    {"ids": ids[:, :, None]},
+                                    p_len=p_len)
         smetrics.PREFILLS.labels(model=self.name).inc()
         tok = logits[np.arange(bucket), blens[:, 0] - 1].argmax(-1)
         out = [tok.astype(np.int64)]
@@ -733,13 +737,18 @@ class SlotGenerativeModel:
             self._warmed.add(key)
         ids = np.zeros((1, p_len, 1), np.int64)
         ids[0, :length, 0] = prompt
-        tok = self._run(self._cb_prefill[p_len], key, {
-            "ids": ids,
-            "slot": np.asarray([[slot]], np.int64),
-            "seq_len": np.asarray([[length]], np.int64),
-            "seed": np.asarray([[int(seed)]], np.int64),
-            "temperature": np.asarray([[float(temperature)]], np.float32),
-            "top_k": np.asarray([[int(top_k)]], np.int64)})
+        # span named by the PROMPT BUCKET the admission landed on, under
+        # the admitting request's trace (the scheduler activates it)
+        with tctx.span(f"serving.prefill@{p_len}", model=self.name,
+                       slot=slot):
+            tok = self._run(self._cb_prefill[p_len], key, {
+                "ids": ids,
+                "slot": np.asarray([[slot]], np.int64),
+                "seq_len": np.asarray([[length]], np.int64),
+                "seed": np.asarray([[int(seed)]], np.int64),
+                "temperature": np.asarray([[float(temperature)]],
+                                          np.float32),
+                "top_k": np.asarray([[int(top_k)]], np.int64)})
         smetrics.PREFILLS.labels(model=self.name).inc()
         smetrics.SLOT_ADMISSIONS.labels(model=self.name).inc()
         smetrics.TOKENS_GENERATED.labels(model=self.name).inc()

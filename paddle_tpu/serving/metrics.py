@@ -41,6 +41,13 @@ QUEUE_DEPTH = _metrics.gauge(
     "paddle_serving_queue_depth",
     "Requests waiting in the model's admission queue",
     labelnames=("model",))
+QUEUE_WAIT = _metrics.histogram(
+    "paddle_serving_queue_wait_seconds",
+    "Admission-to-dispatch wait (enqueue until the batcher coalesces "
+    "the request into a wave, or the slot scheduler pops it for "
+    "admission) — the queueing-delay component the depth gauge cannot "
+    "show; p50/p99 surface in tools/serve_bench.py",
+    labelnames=("model",))
 BATCH_OCCUPANCY = _metrics.gauge(
     "paddle_serving_batch_occupancy_ratio",
     "Real rows / bucket rows of the last dispatched batch (padding "
@@ -158,3 +165,22 @@ def histogram_percentile(family, q: float, **labels) -> float:
 def latency_percentile(model: str, q: float) -> float:
     """Request-latency percentile (see :func:`histogram_percentile`)."""
     return histogram_percentile(REQUEST_LATENCY, q, model=model)
+
+
+def queue_wait_percentile(model: str, q: float) -> float:
+    """Queue-wait percentile (see :func:`histogram_percentile`)."""
+    return histogram_percentile(QUEUE_WAIT, q, model=model)
+
+
+def histogram_exemplar(family, bucket: str = "top", **labels):
+    """The trace_id last recorded for a bucket of an exported histogram
+    — ``bucket="top"`` returns the exemplar of the HIGHEST bucket that
+    has one (the p99-outlier lookup recipe in docs/observability.md:
+    slow sample → trace_id → grep the merged trace). Returns None when
+    no exemplar was recorded."""
+    ex = family.labels(**labels).exemplars()
+    if not ex:
+        return None
+    if bucket == "top":
+        return ex[max(ex)]
+    return ex.get(float(bucket))

@@ -56,6 +56,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.observability import trace_context as tctx
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.serving import bucketing
 from paddle_tpu.serving import metrics as smetrics
 from paddle_tpu.serving.engine import (GenerativeModel, PromptTooLongError,
@@ -118,7 +120,7 @@ class _Future:
 class _Request:
     __slots__ = ("kind", "request_id", "feeds", "prompts", "max_new",
                  "rows", "signature", "future", "t_enqueue",
-                 "temperature", "top_k", "seed", "eos_id")
+                 "temperature", "top_k", "seed", "eos_id", "ctx")
 
     def __init__(self, kind: str, request_id: str, rows: int,
                  feeds=None, prompts=None, max_new=None, signature=None,
@@ -136,6 +138,11 @@ class _Request:
         self.eos_id = eos_id
         self.future = _Future()
         self.t_enqueue = time.perf_counter()
+        # distributed trace identity: the RPC handler's (or in-process
+        # caller's) context — every lifecycle span of this request
+        # parents here, so the client's request span contains them all.
+        # None when tracing is off (one boolean check).
+        self.ctx = tctx.current_or_new()
 
 
 class _HostedModel:
@@ -173,6 +180,11 @@ class _HostedModel:
 
     # -- admission -------------------------------------------------------
     def submit(self, req: _Request) -> _Future:
+        with tctx.span("serving.admission", ctx=req.ctx,
+                       model=self.name, request_id=req.request_id):
+            return self._submit(req)
+
+    def _submit(self, req: _Request) -> _Future:
         with self.cond:
             # at-most-once: a retry of a settled request answers from
             # the cache; a retry of an in-flight one joins its future
@@ -212,6 +224,8 @@ class _HostedModel:
                 self.cond.wait(timeout=0.1)
             if not self.running:
                 return []
+        trace_on = _tracing.active()
+        t_coalesce = time.perf_counter() if trace_on else 0.0
         if self.linger_s > 0:
             time.sleep(self.linger_s)
         wave: List[_Request] = []
@@ -229,6 +243,19 @@ class _HostedModel:
                 rows += req.rows
             smetrics.QUEUE_DEPTH.labels(model=self.name).set(
                 len(self.queue))
+        # admission-to-dispatch: the queueing delay the depth gauge
+        # can't show, plus a retroactive per-request queue_wait span
+        now = time.perf_counter()
+        for r in wave:
+            smetrics.QUEUE_WAIT.labels(model=self.name).observe(
+                now - r.t_enqueue)
+            tctx.record_span("serving.queue_wait", r.t_enqueue, now,
+                             ctx=r.ctx, model=self.name)
+        if trace_on and wave:
+            _tracing.default_tracer().record(
+                "serving.coalesce", t_coalesce, now,
+                args={"model": self.name, "requests": len(wave),
+                      "rows": rows})
         return wave
 
     def _batch_loop(self):
@@ -294,17 +321,25 @@ class _HostedModel:
     # -- settlement ------------------------------------------------------
     def _settle(self, req: _Request, result=None,
                 exc: Optional[BaseException] = None):
+        t0 = time.perf_counter()
+        outcome = "error" if exc is not None else "ok"
+        # exemplar: the trace_id rides the latency sample into its
+        # bucket, so a p99 outlier is one lookup from its causal trace
         smetrics.REQUEST_LATENCY.labels(model=self.name).observe(
-            time.perf_counter() - req.t_enqueue)
-        smetrics.REQUESTS.labels(
-            model=self.name, outcome="error" if exc is not None
-            else "ok").inc()
+            t0 - req.t_enqueue,
+            exemplar=req.ctx.trace_id if req.ctx is not None else None)
+        smetrics.REQUESTS.labels(model=self.name, outcome=outcome).inc()
         with self.cond:
             self.inflight.pop(req.request_id, None)
             self.settled[req.request_id] = (
                 ("exc", exc) if exc is not None else ("ok", result))
             while len(self.settled) > self.dedup_capacity:
                 self.settled.popitem(last=False)
+        # span recorded BEFORE the future resolves: its interval closes
+        # strictly inside the caller's request span, and a client that
+        # returns the moment the future settles never races the record
+        tctx.record_span("serving.settle", t0, time.perf_counter(),
+                         ctx=req.ctx, model=self.name, outcome=outcome)
         if exc is not None:
             req.future.set_exception(exc)
         else:
@@ -410,6 +445,11 @@ class _SlotHostedModel(_HostedModel):
                         "slot-scheduled models serve generate "
                         "requests only"))
                     continue
+                now = time.perf_counter()
+                smetrics.QUEUE_WAIT.labels(model=self.name).observe(
+                    now - req.t_enqueue)
+                tctx.record_span("serving.queue_wait", req.t_enqueue,
+                                 now, ctx=req.ctx, model=self.name)
                 stream = _GenStream(req)
                 self._streams[req.request_id] = stream
                 # execution starts here — the at-most-once witness
@@ -434,10 +474,13 @@ class _SlotHostedModel(_HostedModel):
             seed = (req.seed + pi if req.seed is not None
                     else (hash(req.request_id) + pi) & 0x7FFFFFFF)
             try:
-                slot, first, done = self.engine.admit(
-                    prompt, seed=seed, temperature=req.temperature,
-                    top_k=req.top_k, max_new=req.max_new,
-                    eos_id=req.eos_id)
+                # admit under the request's context: the engine's
+                # prefill@bucket span parents into this request's trace
+                with tctx.activate(req.ctx):
+                    slot, first, done = self.engine.admit(
+                        prompt, seed=seed, temperature=req.temperature,
+                        top_k=req.top_k, max_new=req.max_new,
+                        eos_id=req.eos_id)
             except BaseException as e:
                 self._fail_stream(stream, e)
                 continue
@@ -473,6 +516,10 @@ class _SlotHostedModel(_HostedModel):
                         if not self.queue:
                             self.cond.wait(timeout=0.05)
                     continue
+                # one flag check per pool step, not per token: the
+                # disabled path pays a single boolean
+                trace_on = tctx.active()
+                t_step = time.perf_counter() if trace_on else 0.0
                 try:
                     events = engine.step()
                 except BaseException as e:
@@ -489,6 +536,13 @@ class _SlotHostedModel(_HostedModel):
                         continue
                     stream, pi = owner
                     stream.tokens[pi].append(tok)
+                    if trace_on:
+                        # retroactive per-slot decode-step span under
+                        # the owning request's trace
+                        tctx.record_span(
+                            "serving.decode_step", t_step, now,
+                            ctx=stream.req.ctx, slot=slot,
+                            model=self.name)
                     smetrics.INTER_TOKEN.labels(
                         model=self.name).observe(
                         now - stream.last_tok_t[pi])
@@ -719,8 +773,22 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                 return
             try:
                 req = json.loads(line)
-                faults.inject("serving.handle")
-                resp = self._dispatch(server, req)
+                # adopt the caller's trace context (no-op when the
+                # message carries none); every span below — admission,
+                # queue_wait, prefill@bucket, decode_step, settle —
+                # parents under the CLIENT's request span
+                ctx = tctx.extract(req)
+                with tctx.activate(ctx if ctx is not None
+                                   else tctx.current()):
+                    with tctx.span("serving.handle",
+                                   method=str(req.get("method"))) as hs:
+                        faults.inject("serving.handle")
+                        resp = self._dispatch(server, req)
+                        if hs is not None and isinstance(resp, dict) \
+                                and resp.get("ok"):
+                            # request_id ↔ trace_id mapping back to the
+                            # client (the exemplar lookup recipe)
+                            resp.setdefault("trace_id", hs.trace_id)
             except _ClientGone:
                 return
             except Exception as e:

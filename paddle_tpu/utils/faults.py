@@ -156,6 +156,9 @@ class FaultRegistry:
         self._sites: Dict[str, _SiteState] = {}
         self._seed = seed
         self._loaded = False      # flags plan consulted yet?
+        # observers outlive reset(): they are process infrastructure
+        # (the flight recorder's black box), not part of any plan
+        self._observers = []
 
     # -- configuration ---------------------------------------------------
     def seed(self, n: int):
@@ -205,6 +208,27 @@ class FaultRegistry:
                         "mode": st.spec.mode}
                     for s, st in self._sites.items()}
 
+    # -- observers -------------------------------------------------------
+    def add_observer(self, fn):
+        """``fn(site, mode)`` is called for every fault that FIRES,
+        before its effect (raise/delay/truncate) — so a crash recorder
+        can name the kill point even when the effect ends the process."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn):
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
+
+    def _notify(self, site: str, mode: str):
+        for fn in tuple(self._observers):
+            try:
+                fn(site, mode)
+            except Exception:
+                pass
+
     # -- firing ----------------------------------------------------------
     def _fire(self, site: str, modes) -> Optional[FaultSpec]:
         """Count a hit for `site` if its spec's mode is serviced by this
@@ -235,6 +259,7 @@ class FaultRegistry:
         spec = self._fire(site, ("raise", "delay"))
         if spec is None:
             return
+        self._notify(site, spec.mode)
         if spec.mode == "delay":
             time.sleep(spec.delay_s)
             return
@@ -248,6 +273,7 @@ class FaultRegistry:
         spec = self._fire(site, ("truncate",))
         if spec is None:
             return
+        self._notify(site, spec.mode)
         with open(path, "r+b") as f:
             f.truncate(spec.truncate_to)
 
@@ -285,6 +311,14 @@ def seed(n: int) -> None:
 
 def stats() -> Dict[str, dict]:
     return _REG.stats()
+
+
+def add_observer(fn) -> None:
+    _REG.add_observer(fn)
+
+
+def remove_observer(fn) -> None:
+    _REG.remove_observer(fn)
 
 
 def reload_from_flags() -> None:

@@ -1,0 +1,44 @@
+"""SIGKILL target for the mid-lease chaos test: takes ONE chunk lease
+from the master (endpoint via the MASTER_ENV convention), breadcrumbs
+the held lease into the flight recorder's black box, then lingers
+"training" until the parent kills us — the parent reconstructs which
+lease died from the black box + the merged trace (the ``master.
+get_task`` client span in our spool, parented into the master's handler
+span). Prints "LEASED <task_id>" once holding the lease."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu import flags                              # noqa: E402
+
+
+def main():
+    share = sys.argv[1]
+    flags.set("trace_spool_dir", share)
+    flags.set("flight_recorder_dir", share)
+    flags.set("trace_role", "trainer")
+    from paddle_tpu.observability import flight_recorder, tracing
+    assert tracing.active(), "capture autostart failed"
+
+    from paddle_tpu.data.master_service import MasterClient
+    client = MasterClient(reconnect_timeout_s=30.0)
+    task = None
+    deadline = time.time() + 60
+    while task is None and time.time() < deadline:
+        task = client.get_task()
+        if task is None:
+            time.sleep(0.05)
+    assert task is not None, "no lease from master"
+    flight_recorder.note("lease_taken", task=task.id, path=task.path,
+                         epoch=task.epoch)
+    print(f"LEASED {task.id}", flush=True)
+    while True:                           # "train" until the parent kills us
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""SIGKILL target for the flight-recorder chaos test: hosts a tiny
+classifier ModelServer with the span spool + flight recorder enabled
+via FLAGS env (the parent sets FLAGS_flight_recorder_dir etc.), and a
+FLAGS_fault_plan delay at ``serving.handle`` as the kill window. The
+parent sends one request, SIGKILLs us mid-handle, and reconstructs the
+kill point from the black box (the fault observer records the site
+BEFORE the delay starts; every line is flushed, so it survives the
+kill). Prints "READY <endpoint>" once serving."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid                          # noqa: E402
+from paddle_tpu import serving                            # noqa: E402
+from paddle_tpu.fluid import layers                       # noqa: E402
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = 3
+    with fluid.program_guard(main_p, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        prob = layers.softmax(layers.fc(x, size=4))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = os.path.join(sys.argv[1], "clf_model")
+    os.makedirs(d, exist_ok=True)
+    fluid.io.save_inference_model(d, ["x"], [prob], exe,
+                                  main_program=main_p)
+    sm = serving.ServedModel("clf", d, serving.BucketPolicy((1,)))
+    server = serving.ModelServer()
+    server.add_model(sm)
+    endpoint = server.serve()
+    # capture must be live before READY: the autostart is lazy and the
+    # first request must already hit an attached fault observer
+    from paddle_tpu.observability import flight_recorder, tracing
+    assert tracing.active(), "flight recorder autostart failed"
+    assert flight_recorder.current() is not None
+    print(f"READY {endpoint}", flush=True)
+    while True:                           # serve until the parent kills us
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

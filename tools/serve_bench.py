@@ -179,6 +179,8 @@ def bench_load(args) -> dict:
         "requests_per_s": round(done[0] / elapsed, 2),
         "p50_latency_s": smetrics.latency_percentile("clf", 0.5),
         "p99_latency_s": smetrics.latency_percentile("clf", 0.99),
+        "queue_wait_p50_s": smetrics.queue_wait_percentile("clf", 0.5),
+        "queue_wait_p99_s": smetrics.queue_wait_percentile("clf", 0.99),
         "batch_occupancy": round(
             smetrics.BATCH_OCCUPANCY.labels(model="clf").value, 3),
         "shed": shed,
@@ -259,6 +261,10 @@ def bench_generation(args) -> dict:
                 smetrics.TTFT, 0.5, model=model),
             "ttft_p99_s": smetrics.histogram_percentile(
                 smetrics.TTFT, 0.99, model=model),
+            "queue_wait_p50_s": smetrics.queue_wait_percentile(
+                model, 0.5),
+            "queue_wait_p99_s": smetrics.queue_wait_percentile(
+                model, 0.99),
         }
 
     compiles0 = sum(c.value for c in

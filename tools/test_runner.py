@@ -90,9 +90,53 @@ def run_lint_gate(root: str, timeout: int) -> int:
               "(proglint --passes, measurement-forbidden)")
         r = subprocess.run(cmd + ["--passes"], cwd=root,
                            timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # distributed-tracing smoke: produce a two-role spool (client
+        # span -> traceparent -> server child spans) and run the
+        # trace_collect integrity gate over it — monotonic timestamps,
+        # parents resolve, flow events pair up (docs/observability.md
+        # "Distributed tracing & flight recorder")
+        print("test_runner: lint gate — trace spool smoke + "
+              "trace_collect --check")
+        import tempfile
+        with tempfile.TemporaryDirectory(prefix="trace_smoke_") as d:
+            r = subprocess.run(
+                [sys.executable, "-c", _TRACE_SMOKE, d],
+                cwd=root, timeout=timeout, env=env)
+            if r.returncode:
+                return r.returncode
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "trace_collect.py"),
+                 d, "--check"],
+                cwd=root, timeout=timeout, env=env)
         return r.returncode
     except subprocess.TimeoutExpired:
         sys.exit(f"test_runner: lint gate exceeded {timeout}s")
+
+
+# the trace smoke run: one process plays both roles (two spool files =
+# two process tracks), propagating the context the way the real RPC
+# layers do — client_span -> to_traceparent -> extract/activate -> spans
+_TRACE_SMOKE = """
+import sys, time
+from paddle_tpu.observability import spool, tracing
+from paddle_tpu.observability import trace_context as tctx
+d = sys.argv[1]
+client = spool.SpanSpool(d, role="client")
+tracing.add_sink(client)
+with tctx.client_span("rpc.call"):
+    header = tctx.current().to_traceparent()
+tracing.remove_sink(client); client.close()
+server = spool.SpanSpool(d, role="server")
+tracing.add_sink(server)
+with tctx.activate(tctx.from_traceparent(header)):
+    with tctx.span("server.handle"):
+        with tctx.span("server.work"):
+            time.sleep(0.001)
+tracing.remove_sink(server); server.close()
+"""
 
 
 def main(argv=None):

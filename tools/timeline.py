@@ -14,7 +14,13 @@ Usage:
         --profile_path trainer0=a.csv,trainer1=b.csv,ps=c.csv \
         --timeline_path merged.json
 or programmatically: profiler.export_chrome_trace(path) /
-merge_span_files([...])."""
+merge_span_files([...]).
+
+This tool merges SINGLE-process profiler CSVs; for multi-process runs
+with cross-process trace context (serving client -> server, trainer ->
+pserver), the span SPOOLS written under FLAGS_trace_spool_dir are
+merged by its sibling ``tools/trace_collect.py``, which adds flow
+events across the process edges."""
 
 from __future__ import annotations
 
