@@ -101,7 +101,13 @@ class Master:
         lock: without it, two ThreadingTCPServer handler threads could
         replace the file out of capture order and an OLDER snapshot —
         missing an already-acked report — could end up newest, silently
-        rolling back the persist-before-reply guarantee."""
+        rolling back the persist-before-reply guarantee.
+
+        The previous snapshot rotates to ``path + ".prev"`` first: the
+        rename makes a torn ``path`` impossible from THIS writer, but a
+        dying disk / external truncation can still corrupt the newest
+        file in place — recovery (:meth:`MasterServer`) then falls back
+        to the newest snapshot that passes :func:`verify_snapshot`."""
         import os
         import threading
 
@@ -111,6 +117,10 @@ class Master:
             tmp = f"{path}.tmp{os.getpid()}_{threading.get_ident()}"
             if native.lib().ptpu_master_snapshot(self._h, tmp.encode()) != 0:
                 raise IOError(f"snapshot to {tmp!r} failed")
+            try:
+                os.replace(path, path + ".prev")
+            except OSError:
+                pass                       # first snapshot: nothing to keep
             os.replace(tmp, path)
 
     def recover(self, path: str):
@@ -125,6 +135,64 @@ class Master:
             except Exception:
                 pass
             self._h = None
+
+
+def verify_snapshot(path: str) -> bool:
+    """Structural integrity check of a master snapshot file WITHOUT
+    loading it into a state machine. The C++ ``Recover`` parses with
+    ``operator>>`` and silently stops at the first short record — a
+    snapshot truncated mid-record (torn write, dying disk) would
+    otherwise recover to a state that LOOKS healthy but lost tasks.
+    This is the guard :class:`MasterServer` runs before trusting a
+    candidate file:
+
+    * header: ``ptpu_master_v1|v2`` + 4 (v1) / 5 (v2) integer fields;
+    * every record line: ``todo|pending id path begin end failures``
+      (+ ``lease_epoch`` on v2), integers where integers belong;
+    * the record count must equal ``total - done`` — the queue
+      invariant a truncation breaks even when it cuts at a line
+      boundary.
+    """
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return False
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        return False
+    head = lines[0].split()
+    if head[0] == "ptpu_master_v1":
+        version, want_head, want_rec = 1, 5, 6
+    elif head[0] == "ptpu_master_v2":
+        version, want_head, want_rec = 2, 6, 7
+    else:
+        return False
+    if len(head) != want_head:
+        return False
+    try:
+        _next_id, done, total, _dropped = (int(x) for x in head[1:5])
+    except ValueError:
+        return False
+    if version == 2:
+        try:
+            int(head[5])
+        except ValueError:
+            return False
+    records = 0
+    for ln in lines[1:]:
+        parts = ln.split()
+        if len(parts) != want_rec or parts[0] not in ("todo", "pending"):
+            return False
+        try:
+            for idx in ((1, 3, 4, 5, 6) if version == 2
+                        else (1, 3, 4, 5)):
+                int(parts[idx])
+        except ValueError:
+            return False
+        records += 1
+    # the queue invariant: everything not done is on disk as a record
+    return records == total - done
 
 
 def task_reader(master: Master, poll_interval: float = 0.05,

@@ -48,7 +48,7 @@ import time
 import uuid
 from typing import Optional, Tuple
 
-from paddle_tpu.data.master import Master, Task
+from paddle_tpu.data.master import Master, Task, verify_snapshot
 from paddle_tpu.distributed.resilience import RetryError, RetryPolicy
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability import trace_context as tctx
@@ -85,6 +85,10 @@ HEARTBEAT_AGE = _metrics.gauge(
 SNAPSHOT_PERSIST = _metrics.histogram(
     "paddle_master_snapshot_persist_seconds",
     "Durable-queue snapshot latency (persist-before-reply path)")
+SNAPSHOT_FALLBACK = _metrics.counter(
+    "paddle_master_snapshot_fallback_total",
+    "Recoveries that skipped a corrupt newest snapshot and fell back "
+    "to the rotated .prev (torn-write tolerance)")
 
 
 class MasterUnavailableError(ConnectionError):
@@ -283,12 +287,14 @@ class MasterServer:
         ``snapshot_path``: the served queue's durability file — the etcd
         analogue (reference: go/master/service.go:165 the master
         recovers its state from the etcd snapshot on start, :207 it
-        persists each state change). When set: if the file exists at
-        construction the master RECOVERS from it before serving (a
-        restarted master resumes the drain in place — pending leases
-        survive with their epochs, so in-flight workers' reports are
-        still accepted exactly-once); every accepted lease/report is
-        then snapshotted back atomically before its reply is sent.
+        persists each state change). When set: at construction the
+        master RECOVERS from the newest snapshot that passes
+        ``verify_snapshot`` — the file itself, or the rotated ``.prev``
+        when the newest was torn mid-record (a restarted master resumes
+        the drain in place — pending leases survive with their epochs,
+        so in-flight workers' reports are still accepted exactly-once);
+        every accepted lease/report is then snapshotted back atomically
+        before its reply is sent.
 
         ``heartbeat_timeout_s``: enable the worker heartbeat registry —
         clients that REGISTER by heartbeating (``MasterClient.
@@ -304,8 +310,8 @@ class MasterServer:
         self.master = master
         if snapshot_root is not None:
             os.makedirs(snapshot_root, exist_ok=True)
-        if snapshot_path and os.path.exists(snapshot_path):
-            master.recover(snapshot_path)
+        if snapshot_path:
+            self._recover_newest_verified(master, snapshot_path)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -350,6 +356,34 @@ class MasterServer:
             self._reaper = threading.Thread(target=self._reap_loop,
                                             daemon=True)
             self._reaper.start()
+
+    @staticmethod
+    def _recover_newest_verified(master: Master, snapshot_path: str):
+        """Recover from the NEWEST snapshot that passes
+        :func:`verify_snapshot`: the primary file first, then the
+        rotated ``.prev`` (one snapshot behind — the newest state every
+        in-flight lease was acked against before the torn write). A
+        torn newest + verified .prev counts a fallback; candidates that
+        exist but all fail verification raise instead of silently
+        serving a fresh (empty) queue over a durable-looking path."""
+        candidates = [snapshot_path, snapshot_path + ".prev"]
+        existing = [p for p in candidates if os.path.exists(p)]
+        if not existing:
+            return                       # cold start: nothing durable yet
+        for i, p in enumerate(existing):
+            if verify_snapshot(p):
+                master.recover(p)
+                if i > 0:
+                    SNAPSHOT_FALLBACK.inc()
+                    from paddle_tpu.observability import flight_recorder
+                    flight_recorder.note(
+                        "snapshot_fallback", corrupt=existing[0],
+                        recovered_from=p)
+                return
+        raise IOError(
+            f"no verifiable master snapshot among {existing}: refusing "
+            f"to serve an empty queue over a durable path (delete the "
+            f"files to start fresh)")
 
     def _reap_loop(self):
         """Fail the outstanding leases of workers whose heartbeat went

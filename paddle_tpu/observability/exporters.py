@@ -46,6 +46,20 @@ _lock = threading.Lock()
 _dumper: Optional["MetricsDumper"] = None
 _server: Optional["MetricsServer"] = None
 _started_from_flags = False
+_ready_probe = None
+
+
+def set_ready_probe(fn) -> None:
+    """Register the process's readiness callable for ``GET /readyz``
+    (``None`` clears it). Distinct from ``/healthz`` the same way the
+    replica wire protocol splits them (docs/serving.md): healthz says
+    "this process serves HTTP", readyz says "send me traffic" — false
+    during warmup and while draining. With no probe registered /readyz
+    answers 200 like /healthz (a process with no warmup phase is ready
+    the moment it serves). A probe that returns falsy OR raises answers
+    503 — a broken probe must read as not-ready, never as ready."""
+    global _ready_probe
+    _ready_probe = fn
 
 
 def offer_step_record(rec: dict):
@@ -138,6 +152,19 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
             # no registry render, just "this process serves HTTP"
             body = b"ok\n"
             self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if route == "/readyz":
+            probe = _ready_probe
+            try:
+                ready = True if probe is None else bool(probe())
+            except Exception:
+                ready = False
+            body = b"ready\n" if ready else b"not ready\n"
+            self.send_response(200 if ready else 503)
             self.send_header("Content-Type", "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -43,7 +43,11 @@ _LAZY = {
     "PromptTooLongError": ("paddle_tpu.serving.engine",
                            "PromptTooLongError"),
     "ModelServer": ("paddle_tpu.serving.server", "ModelServer"),
+    "Router": ("paddle_tpu.serving.router", "Router"),
+    "ROUTER_ENV": ("paddle_tpu.serving.router", "ROUTER_ENV"),
     "RequestShedError": ("paddle_tpu.serving.server", "RequestShedError"),
+    "ReplicaDrainingError": ("paddle_tpu.serving.server",
+                             "ReplicaDrainingError"),
     "RequestCancelledError": ("paddle_tpu.serving.server",
                               "RequestCancelledError"),
     "ModelNotFoundError": ("paddle_tpu.serving.server",
@@ -62,6 +66,8 @@ _LAZY = {
     "engine": ("paddle_tpu.serving.engine", None),
     "server": ("paddle_tpu.serving.server", None),
     "client": ("paddle_tpu.serving.client", None),
+    "router": ("paddle_tpu.serving.router", None),
+    "replica": ("paddle_tpu.serving.replica", None),
 }
 
 __all__ = sorted(_LAZY)

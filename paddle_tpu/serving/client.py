@@ -15,8 +15,14 @@ dropped connection or a mid-request kill never re-executes the work
 (chaos witness: ``paddle_serving_requests_applied_total``).
 
 Typed rejections cross the wire as ``ok=false, kind=...`` and surface
-as the matching exception — a shed (:class:`RequestShedError`) is NOT
-retried: admission control only works if clients back off.
+as the matching exception — raised through
+:class:`~paddle_tpu.distributed.resilience.Unretryable`, so a shed
+(:class:`RequestShedError`) or a cancellation is NOT retried even
+under a caller-widened ``retryable`` tuple: admission control only
+works if clients back off, and a cancelled request must never be
+silently resubmitted. The default :class:`CircuitBreaker` is keyed
+PER ENDPOINT (process-shared): one dead replica fast-fails its own
+callers without opening the circuit for the whole service.
 
 Fault sites ``serving.rpc.send`` / ``serving.rpc.recv`` mirror the
 master client's, so one ``utils/faults`` plan drives the whole chaos
@@ -35,7 +41,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from paddle_tpu.distributed.resilience import (CircuitBreaker, RetryError,
-                                               RetryPolicy)
+                                               RetryPolicy, Unretryable)
 from paddle_tpu.observability import trace_context as tctx
 from paddle_tpu.serving.server import (SERVING_ENV, ModelNotFoundError,
                                        RequestCancelledError,
@@ -70,7 +76,26 @@ _TYPED = {
     "shed": RequestShedError,
     "not_found": ModelNotFoundError,
     "cancelled": RequestCancelledError,
+    "draining": RequestShedError,
 }
+
+
+# one logical breaker per ENDPOINT, shared by every client of that
+# endpoint in the process: a dead replica fast-fails its own callers
+# without opening the circuit for the whole service (ISSUE 13). The
+# registry is bounded by the set of endpoints the process talks to.
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def _breaker_for(endpoint: str) -> CircuitBreaker:
+    with _breakers_lock:
+        b = _breakers.get(endpoint)
+        if b is None:
+            b = CircuitBreaker(failure_threshold=5, reset_timeout_s=5.0,
+                               name=f"serving:{endpoint}")
+            _breakers[endpoint] = b
+        return b
 
 
 class ServingClient:
@@ -92,8 +117,9 @@ class ServingClient:
             max_attempts=8, base_delay_s=0.02, max_delay_s=0.5,
             deadline_s=30.0,
             retryable=(ConnectionError, OSError, json.JSONDecodeError))
-        self._breaker = breaker or CircuitBreaker(
-            failure_threshold=5, reset_timeout_s=5.0, name="serving")
+        # default: the process-shared per-endpoint breaker — one bad
+        # replica opens ITS circuit, not the whole service's
+        self._breaker = breaker or _breaker_for(f"{host}:{int(port)}")
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
@@ -155,11 +181,19 @@ class ServingClient:
             # backs off through the cooldown instead of hammering)
             resp = self._breaker.call(raw_attempt)
             if not resp.get("ok"):
+                # typed application replies are Unretryable: the server
+                # ANSWERED — resubmitting a shed ignores backpressure,
+                # and resubmitting a cancelled request silently revives
+                # work the caller already gave up on. RetryPolicy
+                # re-raises the cause immediately (and counts it in
+                # paddle_unretryable_total) even under a caller-supplied
+                # retryable tuple broad enough to match these.
                 kind = resp.get("kind", "error")
                 exc = _TYPED.get(kind, ServingRequestError)
                 if exc is ServingRequestError:
-                    raise ServingRequestError(kind, resp.get("error", ""))
-                raise exc(resp.get("error", ""))
+                    raise Unretryable(
+                        ServingRequestError(kind, resp.get("error", "")))
+                raise Unretryable(exc(resp.get("error", "")))
             return resp
 
         with self._lock:

@@ -101,6 +101,33 @@ SLOT_EVICTIONS = _metrics.counter(
     "Slots freed, by cause: eos | max_new | cancelled | error",
     labelnames=("model", "cause"))
 
+# -- router families (serving/router.py) -------------------------------
+# ``replica`` is the router-assigned slot index ("0".."N-1") — bounded
+# by the pool size, stable across restarts of the replica in that slot.
+ROUTER_REPLICA_UP = _metrics.gauge(
+    "paddle_router_replica_up",
+    "1 while the replica in this pool slot is alive AND ready (readyz "
+    "true), else 0 — the router's routing-eligibility view",
+    labelnames=("replica",))
+ROUTER_REQUESTS = _metrics.counter(
+    "paddle_router_requests_total",
+    "Requests routed, by terminal outcome: ok | typed_error | "
+    "unavailable", labelnames=("outcome",))
+ROUTER_FAILOVERS = _metrics.counter(
+    "paddle_router_failovers_total",
+    "Re-dispatches of a request to another replica, by cause: "
+    "conn_error | breaker_open | dead_sticky | draining",
+    labelnames=("cause",))
+ROUTER_DRAIN_DURATION = _metrics.histogram(
+    "paddle_router_drain_duration_seconds",
+    "Observed drain time of a replica (drain RPC begin to in-flight "
+    "settled) during restart_replica / rolling restart")
+ROUTER_RESTARTS = _metrics.counter(
+    "paddle_router_replica_restarts_total",
+    "Replica respawns, by cause: crash (supervisor restart-with-"
+    "backoff) | rolling (operator-driven drain+replace)",
+    labelnames=("cause",))
+
 
 class CompileForbiddenError(RuntimeError):
     """An executable build was attempted under :func:`forbid_compiles` —

@@ -83,6 +83,14 @@ class RequestCancelledError(RuntimeError):
     up mid-stream. Its decode slots were freed for the next admission."""
 
 
+class ReplicaDrainingError(RequestShedError):
+    """Typed admission rejection for a DRAINING replica (wire kind
+    ``"draining"``): the server stopped admitting new work so its
+    in-flight requests can settle before a clean exit. Retries of
+    already-admitted request_ids still dedup/join — only NEW work is
+    turned away, so a router fails it over to another replica."""
+
+
 def encode_array(a: np.ndarray) -> dict:
     a = np.ascontiguousarray(a)
     return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
@@ -157,6 +165,7 @@ class _HostedModel:
         self.queue: deque = deque()
         self.cond = threading.Condition()
         self.running = True
+        self.draining = False
         self.inflight: Dict[str, _Request] = {}
         self.settled: "OrderedDict[str, tuple]" = OrderedDict()
         self.dedup_capacity = dedup_capacity
@@ -200,6 +209,14 @@ class _HostedModel:
             live = self.inflight.get(req.request_id)
             if live is not None:
                 return live.future
+            # the drain gate sits AFTER the dedup checks: a sticky
+            # retry of an admitted request still joins/answers on a
+            # draining replica; only NEW work is turned away
+            if self.draining:
+                smetrics.REQUESTS.labels(model=self.name,
+                                         outcome="drained").inc()
+                raise ReplicaDrainingError(
+                    f"model {self.name!r} is draining; request refused")
             if len(self.queue) >= self.max_queue_depth:
                 smetrics.REQUESTS.labels(model=self.name,
                                          outcome="shed").inc()
@@ -348,6 +365,10 @@ class _HostedModel:
     def _settle_all(self, wave: List[_Request], exc: BaseException):
         for r in wave:
             self._settle(r, exc=exc)
+
+    def drained(self) -> bool:
+        with self.cond:
+            return not self.queue and not self.inflight
 
     def stop(self):
         self.running = False
@@ -581,6 +602,65 @@ class ModelServer:
         self._default_depth = max_queue_depth
         self._rpc: Optional["_RpcServer"] = None
         self._rpc_thread = None
+        # replica lifecycle (docs/serving.md "Deployment"): readiness
+        # flips true only after warmup/AOT load so a router never sends
+        # traffic to a still-compiling replica; draining refuses new
+        # admissions while in-flight work settles; the exit event lets a
+        # replica host block until a drain RPC asks it to leave.
+        self._ready = threading.Event()
+        self._draining = False
+        self._exit = threading.Event()
+
+    # -- lifecycle (readyz / drain) --------------------------------------
+    @property
+    def ready(self) -> bool:
+        """True once :meth:`mark_ready` ran and no drain started —
+        the ``readyz`` answer a router gates traffic on."""
+        return self._ready.is_set() and not self._draining
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def mark_ready(self):
+        """Flip readiness true — call AFTER every hosted engine is
+        warmed (``serve()`` does it for the common in-process path;
+        a replica serves first with ``ready=False``, warms, then
+        marks)."""
+        self._ready.set()
+
+    def begin_drain(self):
+        """Stop admission on every hosted model (new submits get a
+        typed ``kind="draining"`` shed); already-admitted requests keep
+        running to settlement."""
+        self._draining = True
+        for m in self._models.values():
+            with m.cond:
+                m.draining = True
+                m.cond.notify_all()
+
+    def drain(self, timeout_s: float = 60.0) -> tuple:
+        """Begin drain, then wait for every model's queue AND in-flight
+        map to empty. Returns ``(drained, duration_s)`` — duration is
+        what the ``paddle_router_drain_duration_seconds`` histogram
+        observes on the router side."""
+        t0 = time.perf_counter()
+        self.begin_drain()
+        deadline = t0 + float(timeout_s)
+        while time.perf_counter() < deadline:
+            if all(m.drained() for m in self._models.values()):
+                return True, time.perf_counter() - t0
+            time.sleep(0.01)
+        return (all(m.drained() for m in self._models.values()),
+                time.perf_counter() - t0)
+
+    def request_exit(self):
+        self._exit.set()
+
+    def wait_exit(self, timeout: Optional[float] = None) -> bool:
+        """Block until a ``drain`` RPC (or :meth:`request_exit`) asked
+        this process to leave — the replica host's main-loop wait."""
+        return self._exit.wait(timeout)
 
     # -- hosting ---------------------------------------------------------
     def add_model(self, engine, max_queue_depth: Optional[int] = None,
@@ -710,9 +790,12 @@ class ModelServer:
         return out
 
     # -- RPC front end ---------------------------------------------------
-    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              ready: bool = True) -> str:
         """Bind the JSON/TCP front end (ephemeral port by default);
-        returns the endpoint string."""
+        returns the endpoint string. ``ready=False`` serves the wire
+        (so ``readyz`` answers) WITHOUT flipping readiness — the
+        replica path: serve, warm up, then :meth:`mark_ready`."""
         self._rpc = _RpcServer((host, port), _RpcHandler)
         self._rpc.model_server = self          # type: ignore[attr-defined]
         self._rpc_thread = threading.Thread(
@@ -720,6 +803,8 @@ class ModelServer:
             kwargs={"poll_interval": 0.05}, daemon=True,
             name="paddle-serving-rpc")
         self._rpc_thread.start()
+        if ready:
+            self.mark_ready()
         host, port = self._rpc.server_address[:2]
         return f"{host}:{port}"
 
@@ -746,8 +831,10 @@ class _RpcServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-# error kinds a client maps back to typed exceptions
+# error kinds a client maps back to typed exceptions (ordered isinstance
+# scan: subclasses BEFORE their bases)
 _ERROR_KINDS = {
+    ReplicaDrainingError: "draining",
     RequestShedError: "shed",
     ModelNotFoundError: "not_found",
     RequestCancelledError: "cancelled",
@@ -799,6 +886,10 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                         break
                 resp = {"ok": False, "kind": kind,
                         "error": f"{type(e).__name__}: {e}"}
+            # a drain reply asks the host process to exit AFTER the
+            # response is on the wire (never leaked into the reply)
+            exit_after = isinstance(resp, dict) and \
+                bool(resp.pop("_exit", False))
             try:
                 # a fault here models the mid-request kill: the request
                 # EXECUTED but the reply is lost — the client's retry
@@ -808,6 +899,9 @@ class _RpcHandler(socketserver.StreamRequestHandler):
                 self.wfile.flush()
             except (ConnectionError, OSError, BrokenPipeError):
                 return
+            finally:
+                if exit_after:
+                    server.request_exit()
 
     def _client_gone(self) -> bool:
         """Peek the connection: readable-with-no-bytes means the client
@@ -830,6 +924,27 @@ class _RpcHandler(socketserver.StreamRequestHandler):
             return {"ok": True, "models": server.models()}
         if method == "stats":
             return {"ok": True, "stats": server.stats()}
+        if method == "readyz":
+            # distinct from the scrape endpoint's /healthz liveness:
+            # ready means "warmed AND not draining" — safe for traffic
+            import os as _os
+            return {"ok": True, "ready": server.ready,
+                    "draining": server.draining,
+                    "models": server.models(), "pid": _os.getpid()}
+        if method == "drain":
+            ok, duration = server.drain(
+                timeout_s=float(req.get("timeout_s", 60.0)))
+            resp = {"ok": True, "drained": bool(ok),
+                    "duration_s": duration}
+            if req.get("exit", True):
+                resp["_exit"] = True       # popped before the reply
+            return resp
+        if method == "metricz":
+            # over-the-wire registry snapshot: the chaos suite's
+            # counter witness without an HTTP scrape port per replica
+            from paddle_tpu.observability import metrics as obs_metrics
+            return {"ok": True,
+                    "metrics": obs_metrics.default_registry().snapshot()}
         if method == "infer":
             feeds = {n: decode_array(d)
                      for n, d in (req.get("feeds") or {}).items()}

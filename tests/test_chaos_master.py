@@ -16,7 +16,7 @@ import pytest
 
 from paddle_tpu.core import native
 from paddle_tpu.data import master_service as ms
-from paddle_tpu.data.master import Master
+from paddle_tpu.data.master import Master, verify_snapshot
 from paddle_tpu.data.master_service import (MasterClient, MasterServer,
                                             MasterUnavailableError)
 from paddle_tpu.distributed import resilience
@@ -235,3 +235,73 @@ def test_snapshot_failure_fails_lease_back_not_strands(tmp_path):
     finally:
         client.close()
         srv.stop()
+
+
+def test_torn_snapshot_falls_back_to_prev_with_leases_intact(tmp_path):
+    """A snapshot truncated MID-RECORD (torn write: dying disk, external
+    truncation) must not be trusted: csrc/master.cc Recover parses with
+    operator>> and silently stops at the short record, recovering a
+    state that LOOKS healthy but lost tasks. The restarted MasterServer
+    instead detects the tear via verify_snapshot, falls back to the
+    rotated ``.prev`` — the newest VERIFIED state — and the pending
+    lease persisted there survives with its epoch, so the original
+    holder's finish is accepted exactly once."""
+    snap = str(tmp_path / "master_snapshot.json")
+    m = Master(timeout_s=30.0)
+    for i in range(3):
+        m.add_task(f"shard_{i}", 0, 1)
+    srv = MasterServer(m, snapshot_path=snap)
+    client = MasterClient(srv.endpoint)
+    try:
+        ta = client.get_task()        # persist: snap = {pending A, ...}
+        assert ta is not None
+        tb = client.get_task()        # rotates: .prev = {pending A, ...}
+        assert tb is not None
+    finally:
+        client.close()
+        srv.stop()
+
+    # tear the NEWEST snapshot mid-record, the way a torn write does:
+    # cut the last record line in half (not at a line boundary)
+    with open(snap, encoding="utf-8") as f:
+        text = f.read()
+    lines = text.splitlines()
+    assert len(lines) >= 2, f"expected header + records: {lines}"
+    torn = "\n".join(lines[:-1]) + "\n" + lines[-1][:len(lines[-1]) // 2]
+    with open(snap, "w", encoding="utf-8") as f:
+        f.write(torn)
+    assert not verify_snapshot(snap), "tear must be detectable"
+    assert verify_snapshot(snap + ".prev"), ".prev must be whole"
+
+    fallback0 = ms.SNAPSHOT_FALLBACK.value
+    m2 = Master(timeout_s=30.0)
+    srv2 = MasterServer(m2, snapshot_path=snap)   # recovers, then persists
+    client2 = MasterClient(srv2.endpoint)
+    try:
+        assert ms.SNAPSHOT_FALLBACK.value - fallback0 == 1
+        # .prev held {pending A, todo B, todo C}: B's lease was only in
+        # the torn file — it re-issues; A's lease survived WITH epoch
+        s = m2.stats()
+        assert s["pending"] == 1 and s["todo"] == 2 and s["done"] == 0, s
+        # the original holder reports A onto the recovered lease:
+        # accepted exactly once (epoch preserved by the v2 format)
+        assert client2.task_finished(ta)
+        assert not client2.task_finished(ta), "duplicate must be stale"
+        # drain the rest (B re-leases fresh) — nothing lost, nothing dup
+        finished = []
+        deadline = time.monotonic() + 10
+        while not client2.done:
+            t = client2.get_task()
+            if t is None:
+                assert time.monotonic() < deadline, m2.stats()
+                time.sleep(0.02)
+                continue
+            finished.append(t.path)
+            assert client2.task_finished(t)
+        assert sorted(finished + [ta.path]) == [f"shard_{i}"
+                                                for i in range(3)]
+        s = m2.stats()
+        assert s["done"] == 3 and s["dropped"] == 0, s
+    finally:
+        client2.close()
+        srv2.stop()

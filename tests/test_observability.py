@@ -253,6 +253,40 @@ def test_scrape_endpoint_ephemeral_port():
         srv.stop()
 
 
+def test_scrape_endpoint_readyz_probe():
+    """GET /readyz reflects the registered readiness probe (200/503),
+    defaults to ready with no probe, and a RAISING probe reads as
+    not-ready — the replica/router lifecycle split (readyz distinct
+    from healthz) surfaced to HTTP orchestrators."""
+    srv = exporters.MetricsServer(port=0)
+    url = f"http://127.0.0.1:{srv.port}/readyz"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.status == 200          # no probe: ready once serving
+        ready = [False]
+        exporters.set_ready_probe(lambda: ready[0])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 503
+        ready[0] = True
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.status == 200 and r.read() == b"ready\n"
+
+        def boom():
+            raise RuntimeError("probe crashed")
+        exporters.set_ready_probe(boom)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 503, "a broken probe must read not-ready"
+        # /healthz stays liveness-only: up even while readyz is 503
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        exporters.set_ready_probe(None)
+        srv.stop()
+
+
 # -- runtime: step stats + MFU --------------------------------------------
 
 def test_step_stats_rates_and_ring():

@@ -1,0 +1,292 @@
+"""Replicated-serving router, fast tier: attached-mode routing over
+in-process ModelServers (stickiness, failover, draining, breaker
+gating, zero-downtime refusals) plus the replica lifecycle protocol
+(readyz vs healthz, drain RPC) and the client-side resilience
+satellites (typed replies are Unretryable; the default CircuitBreaker
+is keyed per endpoint).
+
+Everything here runs against BARE ModelServers — no model is loaded,
+no program compiles — so the file stays inside the tier-1 budget. The
+process-level chaos (supervised replicas, SIGKILL under load, rolling
+restart, the merged client→router→replica trace) lives in
+tests/test_chaos_router.py behind the ``slow`` marker.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.serving import client as sclient
+from paddle_tpu.serving import metrics as smetrics
+from paddle_tpu.serving.client import ServingClient
+from paddle_tpu.serving.router import Router
+from paddle_tpu.serving.server import ModelServer, RequestCancelledError, \
+    RequestShedError
+
+
+def _call(endpoint, req, timeout=5.0):
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        line = s.makefile("rb").readline()
+    assert line, f"{endpoint} closed the connection"
+    return json.loads(line)
+
+
+def _wait(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _attached_pair(**router_kw):
+    a, b = ModelServer(), ModelServer()
+    ea, eb = a.serve(), b.serve()
+    router = Router(endpoints=[ea, eb], **router_kw)
+    router.start()
+    router.wait_ready(timeout_s=10)
+    return a, b, router
+
+
+# -- replica lifecycle protocol (readyz / drain over the wire) -----------
+
+def test_readyz_distinct_from_healthz():
+    """readyz is the ROUTING gate: false while serving (healthz-alive)
+    during warmup, true after mark_ready, false again while draining —
+    a router must never send traffic outside the ready window."""
+    srv = ModelServer()
+    ep = srv.serve(ready=False)           # the replica startup shape
+    try:
+        assert _call(ep, {"method": "ping"}).get("pong")      # alive
+        rz = _call(ep, {"method": "readyz"})
+        assert rz["ok"] and rz["ready"] is False
+        srv.mark_ready()
+        assert _call(ep, {"method": "readyz"})["ready"] is True
+        srv.begin_drain()
+        rz = _call(ep, {"method": "readyz"})
+        assert rz["ready"] is False and rz["draining"] is True
+    finally:
+        srv.stop()
+
+
+def test_drain_rpc_settles_and_requests_exit():
+    """The drain RPC reports drained + duration, and (exit=True) asks
+    the process loop to exit AFTER the reply is written."""
+    srv = ModelServer()
+    ep = srv.serve()
+    try:
+        resp = _call(ep, {"method": "drain", "timeout_s": 5.0,
+                          "exit": False})
+        assert resp["ok"] and resp["drained"] is True
+        assert resp["duration_s"] >= 0.0
+        assert not srv.wait_exit(timeout=0.05), "exit=False must not exit"
+        resp = _call(ep, {"method": "drain", "timeout_s": 5.0})
+        assert resp["ok"] and resp["drained"] is True
+        assert srv.wait_exit(timeout=5.0), "exit=True requests exit"
+    finally:
+        srv.stop()
+
+
+# -- attached-mode routing ----------------------------------------------
+
+def test_sticky_routing_keeps_request_id_on_one_replica():
+    a, b, router = _attached_pair()
+    try:
+        r1 = router.route({"method": "models", "req_id": "req-1"})
+        assert r1["ok"]
+        first = r1["routed_replica"]
+        for _ in range(5):
+            r = router.route({"method": "models", "req_id": "req-1"})
+            assert r["ok"] and r["routed_replica"] == first, \
+                "same request id must stay on its replica (dedup cache)"
+        assert router.stats()["sticky_entries"] >= 1
+    finally:
+        router.stop(terminate_replicas=False)
+        a.stop()
+        b.stop()
+
+
+def test_failover_redispatches_same_request_id_to_survivor():
+    """Kill the sticky replica: the SAME request id must complete on
+    the survivor, with the failover accounted by cause."""
+    a, b, router = _attached_pair()
+    servers = {0: a, 1: b}
+    fail0 = sum(c.value for c in
+                smetrics.ROUTER_FAILOVERS.children().values())
+    try:
+        r1 = router.route({"method": "models", "req_id": "req-f"})
+        assert r1["ok"]
+        victim = r1["routed_replica"]
+        servers.pop(victim).stop()         # replica death
+        # in-process stop() leaves established (daemon-thread) handler
+        # connections briefly alive — wait for the health probe verdict,
+        # the way real routing decisions are made; a SIGKILLed process
+        # (tests/test_chaos_router.py) drops both paths at once
+        _wait(lambda: router.stats()["replicas"][victim]["state"]
+              == "down", msg="monitor to mark the dead replica down")
+        r2 = router.route({"method": "models", "req_id": "req-f"})
+        assert r2["ok"], r2
+        assert r2["routed_replica"] != victim
+        fail1 = sum(c.value for c in
+                    smetrics.ROUTER_FAILOVERS.children().values())
+        assert fail1 - fail0 >= 1, "failover must be counted"
+    finally:
+        router.stop(terminate_replicas=False)
+        for s in servers.values():
+            s.stop()
+
+
+def test_draining_replica_stops_receiving_new_requests():
+    """begin_drain flips readyz; once the monitor sees it, NEW request
+    ids route to the other replica only."""
+    a, b, router = _attached_pair()
+    try:
+        a.begin_drain()
+        _wait(lambda: router.stats()["replicas"][0]["state"] == "draining",
+              msg="monitor to see the draining readyz")
+        for i in range(4):
+            r = router.route({"method": "models", "req_id": f"new-{i}"})
+            assert r["ok"] and r["routed_replica"] == 1, r
+    finally:
+        router.stop(terminate_replicas=False)
+        a.stop()
+        b.stop()
+
+
+def test_all_replicas_down_is_typed_unavailable():
+    a, b, router = _attached_pair(route_deadline_s=0.4)
+    try:
+        a.stop()
+        b.stop()
+        _wait(lambda: router.stats()["ready"] == 0,
+              msg="monitor to see both replicas down")
+        r = router.route({"method": "models", "req_id": "doomed"})
+        assert not r["ok"] and r["kind"] == "unavailable", r
+    finally:
+        router.stop(terminate_replicas=False)
+
+
+def test_attached_mode_refuses_restarts():
+    """Nothing to respawn: restart_replica / rolling_restart are typed
+    refusals, not crashes (tools/rolling_restart.py exits 2 on this)."""
+    a, b, router = _attached_pair()
+    try:
+        r = router.restart_replica(0)
+        assert not r["ok"], r
+        r = router.rolling_restart()
+        assert not r["ok"], r
+    finally:
+        router.stop(terminate_replicas=False)
+        a.stop()
+        b.stop()
+
+
+def test_router_front_end_speaks_the_serving_protocol():
+    """A ServingClient pointed at the router front end is none the
+    wiser; router_stats / readyz ride the same line protocol."""
+    a, b, router = _attached_pair()
+    ep = router.serve()
+    cl = ServingClient(ep)
+    try:
+        assert cl.ping()
+        assert cl.models() == []
+        rz = _call(ep, {"method": "readyz"})
+        assert rz["ok"] and rz["role"] == "router" and rz["ready"]
+        st = _call(ep, {"method": "router_stats"})["stats"]
+        assert len(st["replicas"]) == 2 and st["supervised"] is False
+    finally:
+        cl.close()
+        router.stop(terminate_replicas=False)
+        a.stop()
+        b.stop()
+
+
+# -- client resilience satellites ---------------------------------------
+
+def _canned_server(reply: dict):
+    """A one-trick wire server: every request gets ``reply``; returns
+    (endpoint, hit counter, closer)."""
+    hits = [0]
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    host, port = lsock.getsockname()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            with conn:
+                f = conn.makefile("rb")
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
+                    hits[0] += 1
+                    conn.sendall((json.dumps(reply) + "\n").encode())
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def close():
+        stop.set()
+        lsock.close()
+
+    return f"{host}:{port}", hits, close
+
+
+@pytest.mark.parametrize("kind,exc", [
+    ("cancelled", RequestCancelledError),
+    ("shed", RequestShedError),
+    ("draining", RequestShedError),
+])
+def test_typed_replies_are_unretryable(kind, exc):
+    """A typed rejection is an ANSWER: even under a caller-widened
+    retryable tuple the client must raise after exactly one attempt —
+    resubmitting a cancelled request silently revives abandoned work,
+    and retrying a shed defeats admission control."""
+    from paddle_tpu.distributed.resilience import (CircuitBreaker,
+                                                   RetryPolicy)
+    ep, hits, close = _canned_server(
+        {"ok": False, "kind": kind, "error": f"typed {kind}"})
+    try:
+        cl = ServingClient(
+            ep,
+            retry_policy=RetryPolicy(
+                max_attempts=6, base_delay_s=0.001, max_delay_s=0.002,
+                deadline_s=5.0,
+                retryable=(Exception,)),         # maximally widened
+            breaker=CircuitBreaker(failure_threshold=100,
+                                   reset_timeout_s=0.1))
+        with pytest.raises(exc):
+            cl.stats()
+        assert hits[0] == 1, \
+            f"typed {kind!r} reply must not be retried (hits={hits[0]})"
+        cl.close()
+    finally:
+        close()
+
+
+def test_client_breaker_is_keyed_per_endpoint():
+    """One dead replica opens ITS endpoint's breaker, not the whole
+    service's: same endpoint shares one breaker, different endpoints
+    get their own."""
+    b1 = sclient._breaker_for("10.0.0.1:7001")
+    b2 = sclient._breaker_for("10.0.0.1:7001")
+    b3 = sclient._breaker_for("10.0.0.2:7001")
+    assert b1 is b2
+    assert b1 is not b3
+    for _ in range(b1.failure_threshold):
+        b1.record_failure()
+    assert not b1.allow(), "threshold failures open the breaker"
+    assert b3.allow(), "a different endpoint's breaker stays closed"

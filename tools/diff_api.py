@@ -53,6 +53,8 @@ MODULES = [
     "paddle_tpu.serving.server",
     "paddle_tpu.serving.client",
     "paddle_tpu.serving.metrics",
+    "paddle_tpu.serving.router",
+    "paddle_tpu.serving.replica",
 ]
 
 

@@ -17,8 +17,15 @@ The training script calls `paddle_tpu.distributed.init_parallel_env()`
 with no arguments; the launcher provides PADDLE_COORDINATOR,
 PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM (and, for CPU simulation,
 XLA_FLAGS device-count forcing). Worker stdout/stderr stream through
-with `[rank N]` prefixes; the first failure kills the remaining workers
-and sets the exit code.
+with `[rank N]` prefixes; the first failure terminates the remaining
+workers and sets the exit code.
+
+Shutdown is graceful (ISSUE 13): a SIGTERM/SIGINT to the launcher is
+FORWARDED to the children, and teardown always SIGTERMs first and
+waits a ``--grace`` window before resorting to SIGKILL — a serving
+replica's SIGTERM handler drains in-flight work (serving/replica.py),
+which a hard kill would drop. Pump threads are reaped after the
+processes are gone.
 """
 
 from __future__ import annotations
@@ -38,8 +45,37 @@ def _pump(stream, rank, out):
     stream.close()
 
 
+def _graceful_stop(procs, grace_s: float):
+    """SIGTERM every live child, wait up to ``grace_s`` for clean
+    exits (drain handlers run here), SIGKILL the stragglers."""
+    import time
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        if p.poll() is not None:
+            continue
+        remaining = deadline - time.monotonic()
+        if remaining > 0:
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                pass
+        if p.poll() is None:
+            try:
+                p.kill()
+                p.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+
 def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
-           coordinator: str = "", use_cpu: bool = False) -> int:
+           coordinator: str = "", use_cpu: bool = False,
+           grace_s: float = 10.0) -> int:
     try:
         from paddle_tpu.utils.net import PortReservation
     except ImportError:      # `python tools/launch.py` puts only tools/
@@ -76,6 +112,26 @@ def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
         t.start()
         pumps.append(t)
 
+    # forward SIGTERM to the children: a supervisor (or operator) that
+    # terms the launcher gives every worker its drain window instead of
+    # orphaning (or, worse, hard-killing) them
+    termed = {"hit": False}
+
+    def _forward_term(signum, frame):
+        termed["hit"] = True
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_term)
+    except ValueError:
+        pass                   # not the main thread (library use)
+
     exit_code = 0
     try:
         remaining = set(range(nprocs))
@@ -92,19 +148,27 @@ def launch(nprocs: int, script_argv, devices_per_proc: int = 0,
                           file=sys.stderr)
                     for other in remaining:
                         procs[other].terminate()
+            if termed["hit"] and exit_code == 0:
+                exit_code = 128 + signal.SIGTERM   # conventional 143
             if remaining:
                 import time
                 time.sleep(0.2)
     except KeyboardInterrupt:
         for p in procs:
-            p.send_signal(signal.SIGINT)
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
         exit_code = 130
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        # grace first, SIGKILL only past the window: a replica's
+        # SIGTERM handler needs time to drain before the hard stop
+        _graceful_stop(procs, grace_s)
         for t in pumps:
             t.join(timeout=5)
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except ValueError:
+                pass
         if reservation is not None:
             reservation.close()
     return exit_code
@@ -123,12 +187,16 @@ def main(argv=None):
                          "(default: a free local port)")
     ap.add_argument("--use-cpu", action="store_true",
                     help="force the cpu backend in workers")
+    ap.add_argument("--grace", type=float, default=10.0,
+                    help="seconds to wait after SIGTERM before "
+                         "SIGKILLing stragglers (drain window)")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     return launch(args.nprocs, [args.script] + args.script_args,
                   devices_per_proc=args.devices_per_proc,
-                  coordinator=args.coordinator, use_cpu=args.use_cpu)
+                  coordinator=args.coordinator, use_cpu=args.use_cpu,
+                  grace_s=args.grace)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Zero-downtime rolling restart of a serving replica pool.
+
+Connects to a running ``paddle_tpu.serving.router`` endpoint and asks
+it to drain + replace its replicas ONE AT A TIME under live load: each
+replica stops admission (typed ``kind="draining"`` sheds re-route new
+work), settles its in-flight requests, exits cleanly, and its slot is
+respawned and readyz-gated back into rotation before the next replica
+is touched. The router refuses to start a restart that would leave no
+READY replica — the zero-downtime invariant is enforced server-side,
+this tool just drives and reports it.
+
+    python tools/rolling_restart.py 127.0.0.1:8500
+    python tools/rolling_restart.py 127.0.0.1:8500 --replica 1
+    python tools/rolling_restart.py --endpoint-file /run/router.endpoint
+
+Exit code 0 only when every requested restart completed and the pool
+is READY again.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+
+
+def _call(endpoint: str, req: dict, timeout_s: float) -> dict:
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        line = s.makefile("rb").readline()
+    if not line:
+        raise ConnectionError(f"router {endpoint} closed the connection")
+    return json.loads(line)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="drain + replace serving replicas one at a time")
+    ap.add_argument("endpoint", nargs="?", default=None,
+                    help="router host:port")
+    ap.add_argument("--endpoint-file", default=None,
+                    help="read the router endpoint from this file")
+    ap.add_argument("--replica", type=int, default=None,
+                    help="restart ONE pool slot instead of all")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="wall-clock budget for the whole operation")
+    args = ap.parse_args(argv)
+
+    endpoint = args.endpoint
+    if endpoint is None and args.endpoint_file:
+        with open(args.endpoint_file) as f:
+            endpoint = f.read().strip()
+    if not endpoint:
+        ap.error("give a router endpoint (positional or --endpoint-file)")
+
+    before = _call(endpoint, {"method": "router_stats"}, 10.0)["stats"]
+    print(f"pool: {len(before['replicas'])} replica(s), "
+          f"{before['ready']} ready "
+          f"({'supervised' if before['supervised'] else 'attached'})")
+    if not before["supervised"]:
+        print("router is in attached mode: nothing to restart",
+              file=sys.stderr)
+        return 2
+
+    if args.replica is not None:
+        resp = _call(endpoint, {"method": "router_restart",
+                                "replica": args.replica}, args.timeout)
+        results = [resp]
+    else:
+        resp = _call(endpoint, {"method": "router_rolling_restart"},
+                     args.timeout)
+        results = resp.get("results", [resp])
+
+    ok = True
+    for r in results:
+        if r.get("ok"):
+            print(f"replica {r['replica']}: drained in "
+                  f"{r.get('drain_duration_s', 0.0):.3f}s, ready again "
+                  f"after {r.get('ready_after_s', 0.0):.3f}s")
+        else:
+            ok = False
+            print(f"FAILED: {r.get('error', r)}", file=sys.stderr)
+
+    after = _call(endpoint, {"method": "router_stats"}, 10.0)["stats"]
+    print(f"pool after: {after['ready']}/{len(after['replicas'])} ready")
+    return 0 if ok and after["ready"] >= before["ready"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

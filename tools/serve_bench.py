@@ -28,8 +28,17 @@ Three phases, two JSON rows:
    slot arm with TTFT p99 bounded by prefill+queue rather than wave
    length.
 
+4. **Replicated router** (the ISSUE 13 robustness arm,
+   ``SERVE_r03.json``, opt-in via ``--replicas N``): a supervised
+   ``serving.router.Router`` fronting N replica processes under
+   sustained client load; one replica is SIGKILLed mid-run and the row
+   records aggregate requests/s, the steady vs failover-blip p99, the
+   respawned replica's readyz rejoin time, and the client error count
+   (expected ZERO — the router re-dispatches to the survivor).
+
     python tools/serve_bench.py                  # defaults (T=64)
     python tools/serve_bench.py --prompt-len 64 --max-new 64 --out SERVE_r01.json
+    python tools/serve_bench.py --skip-decode --skip-gen --replicas 2
 """
 
 from __future__ import annotations
@@ -297,6 +306,107 @@ def bench_generation(args) -> dict:
     }
 
 
+def bench_router(args) -> dict:
+    """ISSUE 13 (``SERVE_r03.json``): aggregate throughput through the
+    replicated router, the latency blip when one replica is SIGKILLed
+    under sustained load, and the time until the respawned replica
+    passes readyz and rejoins the pool. Client errors should be ZERO:
+    the router absorbs the failure by re-dispatching to the survivor."""
+    import signal as _signal
+    import tempfile
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.router import Router
+
+    tmp = tempfile.mkdtemp(prefix="serve_bench_router_")
+    clf_dir = build_clf_model_dir(tmp)
+    spec = {"model": {"kind": "saved", "name": "clf",
+                      "model_dir": clf_dir,
+                      "buckets": [1, 2, 4, args.load_max_batch]}}
+    router = Router(spec=spec, replicas=args.replicas,
+                    breaker_reset_s=0.5)
+    t0 = time.perf_counter()
+    router.start()
+    router.wait_ready(timeout_s=600)
+    pool_ready_s = time.perf_counter() - t0
+    endpoint = router.serve()
+
+    lat_lock = threading.Lock()
+    lats: list = []                  # (t_end_rel_s, seconds, ok)
+    stop = threading.Event()
+    t_base = time.perf_counter()
+
+    def client_loop(seed: int):
+        cl = serving.ServingClient(endpoint)
+        r = np.random.RandomState(seed)
+        try:
+            while not stop.is_set():
+                bs = int(r.choice([1, 2, args.load_max_batch]))
+                t0 = time.perf_counter()
+                ok = True
+                try:
+                    cl.infer("clf",
+                             {"x": r.rand(bs, 32).astype(np.float32)})
+                except Exception:    # pragma: no cover - bench only
+                    ok = False
+                t1 = time.perf_counter()
+                with lat_lock:
+                    lats.append((t1 - t_base, t1 - t0, ok))
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=client_loop, args=(200 + i,),
+                                daemon=True)
+               for i in range(args.load_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(args.router_steady_s)
+
+    # SIGKILL one replica mid-load: the blip is every request that
+    # lands while the router reroutes; rejoin is respawn + readyz
+    victim = router.stats()["replicas"][0]
+    os.kill(victim["pid"], _signal.SIGKILL)
+    kill_at = time.perf_counter() - t_base
+    rejoin_s = None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        st = router.stats()["replicas"][victim["index"]]
+        if st["state"] == "ready" and st["pid"] is not None \
+                and st["pid"] != victim["pid"]:
+            rejoin_s = round(time.perf_counter() - t_base - kill_at, 3)
+            break
+        time.sleep(0.05)
+    time.sleep(args.router_steady_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    router.stop()
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)), 4) if vals else None
+
+    blip_w = max(rejoin_s or 0.0, 1.0)
+    steady = [d for ts, d, ok in lats if ok and ts < kill_at]
+    blip = [d for ts, d, ok in lats
+            if ok and kill_at <= ts < kill_at + blip_w]
+    after = [d for ts, d, ok in lats if ok and ts >= kill_at + blip_w]
+    n_ok = sum(1 for _, _, ok in lats if ok)
+    span = max(ts for ts, _, _ in lats) if lats else 1.0
+    return {
+        "replicas": args.replicas,
+        "clients": args.load_clients,
+        "pool_ready_s": round(pool_ready_s, 3),
+        "requests_ok": n_ok,
+        "requests_failed": len(lats) - n_ok,
+        "requests_per_s": round(n_ok / span, 2),
+        "steady_p50_s": pct(steady, 50),
+        "steady_p99_s": pct(steady, 99),
+        "failover_blip_p99_s": pct(blip, 99),
+        "post_rejoin_p99_s": pct(after, 99),
+        "replica_rejoin_s": rejoin_s,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--prompt-len", type=int, default=64)
@@ -320,10 +430,20 @@ def main(argv=None):
     ap.add_argument("--gen-requests", type=int, default=96)
     ap.add_argument("--gen-interarrival-ms", type=float, default=2.0,
                     help="mean Poisson inter-arrival time")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run the replicated-router arm with N replica "
+                         "processes (0 = skip; ISSUE 13)")
+    ap.add_argument("--router-steady-s", type=float, default=5.0,
+                    help="seconds of steady load before (and after) "
+                         "the mid-load replica SIGKILL")
     ap.add_argument("--skip-load", action="store_true")
     ap.add_argument("--skip-gen", action="store_true")
+    ap.add_argument("--skip-decode", action="store_true",
+                    help="skip the decode + load phases (router-only "
+                         "runs)")
     ap.add_argument("--out", default="SERVE_r01.json")
     ap.add_argument("--gen-out", default="SERVE_r02.json")
+    ap.add_argument("--router-out", default="SERVE_r03.json")
     args = ap.parse_args(argv)
 
     def _resolve(path):
@@ -331,19 +451,20 @@ def main(argv=None):
             os.path.abspath(__file__))), path) \
             if not os.path.isabs(path) else path
 
-    row = {"bench": "serving",
-           "device": os.environ.get("JAX_PLATFORMS", "auto"),
-           "decode": bench_decode(args)}
-    if not args.skip_load:
-        row["load"] = bench_load(args)
-    with open(_resolve(args.out), "w") as f:
-        json.dump(row, f, indent=2)
-        f.write("\n")
-    print(json.dumps(row, indent=2))
-    speedup = row["decode"]["speedup"]
-    print(f"serve_bench: decode speedup {speedup}x vs full-forward "
-          f"baseline at T={args.prompt_len} "
-          f"({'>=5x OK' if speedup >= 5 else 'BELOW the 5x target'})")
+    if not args.skip_decode:
+        row = {"bench": "serving",
+               "device": os.environ.get("JAX_PLATFORMS", "auto"),
+               "decode": bench_decode(args)}
+        if not args.skip_load:
+            row["load"] = bench_load(args)
+        with open(_resolve(args.out), "w") as f:
+            json.dump(row, f, indent=2)
+            f.write("\n")
+        print(json.dumps(row, indent=2))
+        speedup = row["decode"]["speedup"]
+        print(f"serve_bench: decode speedup {speedup}x vs full-forward "
+              f"baseline at T={args.prompt_len} "
+              f"({'>=5x OK' if speedup >= 5 else 'BELOW the 5x target'})")
 
     if not args.skip_gen:
         gen = {"bench": "serving_generation",
@@ -357,6 +478,21 @@ def main(argv=None):
         print(f"serve_bench: slot scheduler {ratio}x aggregate tokens/s "
               f"vs wave-per-batch under Poisson load "
               f"({'>=2x OK' if ratio >= 2 else 'BELOW the 2x target'})")
+
+    if args.replicas:
+        rrow = {"bench": "serving_router",
+                "device": os.environ.get("JAX_PLATFORMS", "auto"),
+                "router": bench_router(args)}
+        with open(_resolve(args.router_out), "w") as f:
+            json.dump(rrow, f, indent=2)
+            f.write("\n")
+        print(json.dumps(rrow, indent=2))
+        r = rrow["router"]
+        print(f"serve_bench: router arm — {r['requests_per_s']} req/s "
+              f"over {args.replicas} replicas, failover blip p99 "
+              f"{r['failover_blip_p99_s']}s, rejoin "
+              f"{r['replica_rejoin_s']}s, "
+              f"{r['requests_failed']} client error(s)")
     return 0
 
 

@@ -111,6 +111,28 @@ def run_lint_gate(root: str, timeout: int) -> int:
                  os.path.join(root, "tools", "trace_collect.py"),
                  d, "--check"],
                 cwd=root, timeout=timeout, env=env)
+            if r.returncode:
+                return r.returncode
+        # router duo smoke: a supervised router + 2 replica processes,
+        # one replica SIGKILLed, the SAME request id re-dispatched and
+        # completed on the survivor — then the merged trace must stitch
+        # the client -> router -> replica span chain (ISSUE 13)
+        print("test_runner: lint gate — router duo smoke + "
+              "trace_collect --check --chain client,router,replica")
+        with tempfile.TemporaryDirectory(prefix="router_smoke_") as d:
+            smoke_env = dict(env)
+            smoke_env.pop("FLAGS_trace_role", None)
+            smoke_env["FLAGS_trace_spool_dir"] = d
+            r = subprocess.run(
+                [sys.executable, "-c", _ROUTER_SMOKE, d],
+                cwd=root, timeout=timeout, env=smoke_env)
+            if r.returncode:
+                return r.returncode
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "trace_collect.py"),
+                 d, "--check", "--chain", "client,router,replica"],
+                cwd=root, timeout=timeout, env=env)
         return r.returncode
     except subprocess.TimeoutExpired:
         sys.exit(f"test_runner: lint gate exceeded {timeout}s")
@@ -136,6 +158,84 @@ with tctx.activate(tctx.from_traceparent(header)):
         with tctx.span("server.work"):
             time.sleep(0.001)
 tracing.remove_sink(server); server.close()
+"""
+
+
+# the router duo smoke: this process is the CLIENT (role set via the
+# flags API so the router/replica children do not inherit it from env);
+# the router subprocess supervises two replica processes. One replica
+# is SIGKILLed and the same request id must complete on the survivor.
+_ROUTER_SMOKE = """
+import json, os, signal, socket, subprocess, sys, time
+d = sys.argv[1]
+from paddle_tpu import flags
+flags.set("trace_role", "client")
+from paddle_tpu.observability import spool
+from paddle_tpu.observability import trace_context as tctx
+
+SPEC = {"model": {"kind": "decoder_lm", "name": "lm", "params": {
+    "prompt_len": 8, "max_new": 8, "vocab": 32, "d_model": 16,
+    "d_inner": 32, "n_head": 2, "n_layer": 2}}}
+
+def call(endpoint, req, timeout=60.0):
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall((json.dumps(req) + "\\n").encode())
+        line = s.makefile("rb").readline()
+    assert line, "router closed the connection"
+    return json.loads(line)
+
+ef = os.path.join(d, "router.endpoint")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "paddle_tpu.serving.router",
+     "--spec-json", json.dumps(SPEC), "--replicas", "2",
+     "--endpoint-file", ef],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    deadline = time.monotonic() + 300
+    while not os.path.exists(ef):
+        assert time.monotonic() < deadline, "router endpoint never appeared"
+        assert proc.poll() is None, "router died during startup"
+        time.sleep(0.1)
+    endpoint = open(ef).read().strip()
+    while True:
+        assert time.monotonic() < deadline, "replicas never both ready"
+        try:
+            rz = call(endpoint, {"method": "readyz"}, 5.0)
+        except (ConnectionError, OSError):
+            time.sleep(0.2)
+            continue
+        if rz.get("ready") and rz["replicas"].count("ready") == 2:
+            break
+        time.sleep(0.2)
+
+    def gen(req_id):
+        req = {"method": "generate", "model": "lm", "req_id": req_id,
+               "prompts": [[1, 2, 3]], "max_new": 4,
+               "temperature": 0.0, "top_k": 0}
+        with tctx.client_span("serving.generate"):
+            tctx.inject(req)
+            return call(endpoint, req)
+
+    r1 = gen("duo-smoke-1")
+    assert r1.get("ok"), r1
+    victim = r1["routed_replica"]
+    stats = call(endpoint, {"method": "router_stats"})["stats"]
+    pid = next(s["pid"] for s in stats["replicas"]
+               if s["index"] == victim)
+    os.kill(pid, signal.SIGKILL)
+    r2 = gen("duo-smoke-1")     # same id: sticky target is dead
+    assert r2.get("ok"), r2
+    assert r2["routed_replica"] != victim, r2
+    assert r2["tokens"] == r1["tokens"], (r1, r2)  # greedy: same stream
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+spool.shutdown()
+print("router duo smoke ok")
 """
 
 

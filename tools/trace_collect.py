@@ -24,6 +24,10 @@ smoke spool: per-file record order must be time-monotonic (completion
 order, small slack for thread races), durations non-negative, every
 span's ``parent_id`` must resolve to a recorded span, and every flow
 id in the merged trace must pair up (one "s", one "f").
+``--check --chain client,router,replica`` additionally requires one
+request's span ancestry to cross those roles in order — the replicated
+serving deployment's three-hop stitch (client span -> router.route ->
+replica handler; docs/serving.md "Deployment").
 
 Single-process host timelines from profiler CSVs stay with
 ``tools/timeline.py``; this tool is its cross-process sibling and
@@ -146,7 +150,48 @@ def merge(paths: List[str]) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def check(paths: List[str]) -> List[str]:
+def check_chain(paths: List[str], chain: List[str]) -> List[str]:
+    """Require at least one request whose span ancestry crosses the
+    given roles in order (e.g. ``client,router,replica``): walking a
+    leaf span's parents in a ``chain[-1]``-role spool must pass through
+    every earlier role. This is how the router deployment proves its
+    three-hop trace stitches — a broken inject/extract at any hop
+    breaks the ancestry and fails the gate."""
+    role_of: Dict[str, str] = {}       # span_id -> role of its spool
+    recs: Dict[str, dict] = {}         # span_id -> record
+    leaves: List[str] = []
+    for path in paths:
+        meta, spans, _ = load_spool(path)
+        role = (meta or {}).get("role") or os.path.basename(path)
+        for rec in spans:
+            sid = rec.get("span_id")
+            if not sid:
+                continue
+            role_of[sid] = role
+            recs[sid] = rec
+            if role == chain[-1]:
+                leaves.append(sid)
+    for sid in leaves:
+        # roles along the ancestry, leaf -> root, deduping repeats
+        seq: List[str] = []
+        cur: Optional[str] = sid
+        hops = 0
+        while cur is not None and hops < 64:
+            r = role_of.get(cur)
+            if r is not None and (not seq or seq[-1] != r):
+                seq.append(r)
+            cur = (recs.get(cur) or {}).get("parent_id")
+            hops += 1
+        seq.reverse()                  # root -> leaf
+        it = iter(seq)
+        if all(role in it for role in chain):   # subsequence match
+            return []
+    return [f"no span chain matching {'->'.join(chain)} "
+            f"(roles found: {sorted(set(role_of.values()))})"]
+
+
+def check(paths: List[str],
+          chain: Optional[List[str]] = None) -> List[str]:
     """Validate spools + the merged trace; returns problem strings
     (empty = pass). The test_runner gate fails on any problem."""
     problems: List[str] = []
@@ -203,6 +248,8 @@ def check(paths: List[str]) -> List[str]:
     for fid, phs in sorted(flows.items()):
         if sorted(phs) != ["f", "s"]:
             problems.append(f"flow id {fid}: unpaired events {phs}")
+    if chain:
+        problems.extend(check_chain(paths, chain))
     return problems
 
 
@@ -217,6 +264,10 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="validate spools (monotonic ts, parents "
                          "resolve, flows pair up); write nothing")
+    ap.add_argument("--chain", default=None,
+                    help="with --check: comma-separated roles at least "
+                         "one request's span ancestry must cross in "
+                         "order (e.g. client,router,replica)")
     args = ap.parse_args(argv)
 
     paths = find_spools(args.spool_dir)
@@ -226,7 +277,9 @@ def main(argv=None) -> int:
         return 2
 
     if args.check:
-        problems = check(paths)
+        chain = ([r.strip() for r in args.chain.split(",") if r.strip()]
+                 if args.chain else None)
+        problems = check(paths, chain=chain)
         if problems:
             for p in problems:
                 print(f"CHECK FAIL: {p}", file=sys.stderr)
