@@ -348,6 +348,16 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
     mfu = flops_mod.mfu(main, batch_size, dt / nsteps * n_chips,
                         device=exe.device)
 
+    # roofline twin for embedding-bound programs: gather-scatter HBM
+    # bytes per step over the chip's peak bandwidth (None when the
+    # program has no lookup/pool ops, e.g. the conv models)
+    gather_bytes = flops_mod.program_gather_bytes(main, batch_size)
+    gather_bps = (gather_bytes / (dt / nsteps * n_chips)
+                  if gather_bytes else None)
+    peak_hbm = flops_mod.device_peak_hbm(exe.device)
+    bw_pct = (gather_bps / peak_hbm * 100
+              if gather_bps and peak_hbm else None)
+
     _write_metrics_snapshot(
         model_name, "train", nsteps, dt, batch_size,
         per_step if unit in ("tokens/sec", "words/sec") else None, mfu,
@@ -362,6 +372,9 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "unit": unit,
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
+        "gather_bytes_per_s": (round(gather_bps, 0)
+                               if gather_bps is not None else None),
+        "bw_pct": round(bw_pct, 1) if bw_pct is not None else None,
         "gflop_per_step": round(
             flops_mod.program_flops(main, batch_size) / 1e9, 1),
         "passes": applied_passes,
@@ -572,6 +585,8 @@ def aggregate_line(rows, head, n_ok):
              "u": r.get("unit")}
         if r.get("mfu_pct") is not None:
             c["mfu"] = r["mfu_pct"]
+        if r.get("bw_pct") is not None:
+            c["bw"] = r["bw_pct"]
         if r.get("value") is None:
             c["err"] = (r.get("error") or "?")[:40]
         compact.append(c)

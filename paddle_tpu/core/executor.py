@@ -228,6 +228,25 @@ class Executor:
         feed_names = sorted(feed)
         is_test = bool(getattr(program, "_is_test", False))
 
+        # sharded-table id translation (ops/embed_cache.py): feeds that
+        # carry vocab ids into a __sharded__-marked table are rewritten
+        # to cache SLOT ids host-side, after the cache pulls any cold
+        # rows from their owning shard — the jitted step below only ever
+        # sees in-range slots over the static-shape cache array (the
+        # zero-steady-state-recompile construction)
+        _caches = getattr(getattr(program, "desc", None),
+                          "_embed_caches", None)
+        if _caches and feed:
+            translated = None
+            for fname, cache in _caches.items():
+                if fname in feed:
+                    if translated is None:
+                        translated = dict(feed)
+                    translated[fname] = cache.translate(
+                        feed[fname], train=not is_test)
+            if translated is not None:
+                feed = translated
+
         cb = self._compiled(program, feed_names, fetch_names, is_test)
 
         feeds = {}

@@ -102,6 +102,51 @@ def analyze_block(block: ir.BlockDesc, feed_names: Sequence[str],
     )
 
 
+# lookup ops whose W may be a __sharded__-marked table (ISSUE 14): when
+# the hot-rows cache is enabled the runtime array under the table's name
+# is the [capacity + 1, D] cache and the executor feeds SLOT ids, so a
+# site's original vocab-space padding_idx must be rewritten to the
+# cache's pinned-zero pad slot — forward zeroing AND the row-sparse
+# VJP's padding-gradient drop then hold in slot space exactly.
+_SHARDED_LOOKUP_OPS = ("lookup_table", "fused_embedding_seq_pool")
+
+
+def _sharded_attrs(program: ir.ProgramDesc, op) -> dict:
+    """op.attrs, with padding_idx patched to the cache pad slot for
+    lookup sites over a __sharded__ table (and for their __vjp__ ops,
+    whose fwd_op payload carries the attrs the backward emitter reads).
+    Identity when no table is sharded — zero cost on the common path."""
+    pads = getattr(program, "_sharded_pad_slots", None)
+    if not pads:
+        return op.attrs
+
+    def patch(op_type, inputs, attrs):
+        if op_type in _SHARDED_LOOKUP_OPS:
+            w = (inputs.get("W") or [None])[0]
+            if w in pads:
+                gvar = program.global_block.vars.get(w)
+                if gvar is not None and gvar.attrs.get("__sharded__"):
+                    pidx = attrs.get("padding_idx", -1)
+                    if pidx is not None and int(pidx) >= 0:
+                        out = dict(attrs)
+                        out["padding_idx"] = pads[w]
+                        return out
+        return attrs
+
+    if op.type == "__vjp__":
+        fwd = op.attrs.get("fwd_op") or {}
+        patched = patch(fwd.get("type"), fwd.get("inputs", {}),
+                        fwd.get("attrs", {}))
+        if patched is not fwd.get("attrs", {}):
+            out = dict(op.attrs)
+            f2 = dict(fwd)
+            f2["attrs"] = patched
+            out["fwd_op"] = f2
+            return out
+        return op.attrs
+    return patch(op.type, op.inputs, op.attrs)
+
+
 def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
                 indices, env: Dict[str, Any], base_key, step_base,
                 is_test: bool, dist=None) -> None:
@@ -137,13 +182,14 @@ def emit_op_seq(program: ir.ProgramDesc, block: ir.BlockDesc,
         # plumbing ops (sum/scale/isfinite/...) rewrite sparsely; everything
         # else gets an exact densify — a consumer can never observe the
         # difference, only the fast path's cost profile
+        attrs = _sharded_attrs(program, op)
         if any(sr.is_sparse(v) for vals in ins.values() for v in vals) \
                 and op.type not in sr.SPARSE_APPLY_OPS:
-            outs = sr.try_sparse_emit(op.type, ins, op.attrs)
+            outs = sr.try_sparse_emit(op.type, ins, attrs)
             if outs is None:
-                outs = spec.emit(ctx, sr.densify_ins(ins), op.attrs)
+                outs = spec.emit(ctx, sr.densify_ins(ins), attrs)
         else:
-            outs = spec.emit(ctx, ins, op.attrs)
+            outs = spec.emit(ctx, ins, attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:

@@ -112,7 +112,9 @@ def __dir__():
     # lazy attributes must still show up on the documented surface
     # (tools/diff_api.py enumerates via dir())
     return sorted(set(globals())
-                  | {"AsyncPServer", "AsyncTrainerClient", "async_pserver"})
+                  | {"AsyncPServer", "AsyncTrainerClient", "async_pserver",
+                     "ShardSpec", "TableShardServer", "ShardedTableClient",
+                     "sharded_table"})
 
 
 def __getattr__(name):
@@ -124,6 +126,13 @@ def __getattr__(name):
         import importlib
         mod = importlib.import_module("paddle_tpu.distributed.async_pserver")
         if name == "async_pserver":
+            return mod
+        return getattr(mod, name)
+    if name in ("ShardSpec", "TableShardServer", "ShardedTableClient",
+                "sharded_table"):
+        import importlib
+        mod = importlib.import_module("paddle_tpu.distributed.sharded_table")
+        if name == "sharded_table":
             return mod
         return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,638 @@
+"""Terascale sharded embedding tables: vocab-range partitioning across
+the pserver fleet.
+
+Reference: the distributed lookup_table path (nn.py:300 ``embedding(
+is_sparse=True, is_distributed=True)`` + distribute_transpiler.py
+``_split_table_grad_and_add_send_vars`` / prefetch over
+``lookup_tables``): a table too large for one device is split by
+CONTIGUOUS ROW RANGE over the pserver fleet, trainers prefetch the rows
+a batch touches and push back row-sparse gradients — never a dense
+[V, D] tensor on the wire.
+
+TPU-native shape here (ISSUE 14): the shard fleet is a pure row store
+(param rows + row-aligned optimizer-state rows); ALL optimizer math
+stays on the trainer inside the jitted step, operating on the hot-rows
+device cache (``ops/embed_cache.py``). The shard server therefore has
+no optimizer subgraphs — it answers ``pull_rows`` (gather by local row
+index, zero-filling families it has never seen, so lazily-created adam
+moments need no registration step) and ``push_rows`` (overwrite rows by
+local index). Overwrite semantics make pushes idempotent, and a
+push-id dedup set backed by an append-only *applied log* (one fsync'd
+line per applied push) makes the at-most-once contract SIGKILL-provable:
+a restarted shard reloads the log and refuses replays, so client-side
+retries of an unacknowledged push can never double-apply.
+
+Wire compression (EQuARX, arXiv:2506.17615): the DCN-bound row exchange
+optionally ships bf16 or int8-with-per-row-scale instead of fp32 —
+``FLAGS_embed_exchange_codec`` picks the codec fleet-wide, and the
+exact-dense control arm is codec="none" (the flag analog of
+``FLAGS_disable_sparse_grad``).
+
+RPC transport/resilience: same positional-tuple protocol and
+RetryPolicy/CircuitBreaker discipline as ``async_pserver.py`` —
+``pull_rows`` retries freely (read-only), ``push_rows`` retries reuse
+the SAME push_id so a retry that races a previously-applied send is
+deduped server-side instead of double-applied.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace_context as tctx
+
+# exporter-catalog families (docs/observability.md; preregistered via
+# observability.exporters._preregister_catalog importing this module)
+SHARD_BYTES = _metrics.counter(
+    "paddle_pserver_shard_bytes_total",
+    "Row-exchange payload bytes between trainer and table shards, by "
+    "direction (push|pull) and owning shard index",
+    labelnames=("direction", "shard"))
+SHARD_RPC_RETRIES = _metrics.counter(
+    "paddle_pserver_shard_rpc_retries_total",
+    "Trainer-side table-shard RPC retries (one per backoff sleep)",
+    labelnames=("op",))
+SHARD_PUSHES_DEDUPED = _metrics.counter(
+    "paddle_pserver_shard_pushes_deduped_total",
+    "push_rows replays refused by the shard's applied-log dedup set")
+
+PAD = b"paddle_tpu"          # authkey shared with the async pserver
+
+
+# ---------------------------------------------------------------------------
+# ShardSpec: contiguous vocab-range partitioning
+# ---------------------------------------------------------------------------
+
+class ShardSpec:
+    """Contiguous row-range partition of a [height, D] table over
+    ``num_shards`` shards. Ranges are the balanced split the reference's
+    ``_split_table_grad_and_add_send_vars`` computes: the first
+    ``height % num_shards`` shards get one extra row, so
+    ``|len(range_i) - len(range_j)| <= 1``."""
+
+    def __init__(self, height: int, num_shards: int):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if height < num_shards:
+            raise ValueError(
+                f"cannot split {height} rows over {num_shards} shards")
+        self.height = int(height)
+        self.num_shards = int(num_shards)
+        base, extra = divmod(self.height, self.num_shards)
+        bounds, lo = [], 0
+        for i in range(self.num_shards):
+            hi = lo + base + (1 if i < extra else 0)
+            bounds.append((lo, hi))
+            lo = hi
+        self.bounds: List[Tuple[int, int]] = bounds
+        # searchsorted over the range STARTS: owner(r) is the last start
+        # <= r. np.searchsorted(starts, r, "right") - 1 gives exactly
+        # that, including rows sitting exactly ON a split point (they
+        # belong to the shard whose range STARTS there — [lo, hi) ranges).
+        self._starts = np.asarray([b[0] for b in bounds], dtype=np.int64)
+
+    def owner_of(self, rows) -> np.ndarray:
+        """Shard index for each (global) row id; vectorized."""
+        r = np.asarray(rows, dtype=np.int64)
+        if r.size and (r.min() < 0 or r.max() >= self.height):
+            bad = r[(r < 0) | (r >= self.height)][:5]
+            raise IndexError(
+                f"row ids {bad.tolist()} outside [0, {self.height})")
+        return (np.searchsorted(self._starts, r, side="right") - 1).astype(
+            np.int64)
+
+    def route(self, rows) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """Bucket global rows by owning shard: {shard: (positions,
+        local_rows)} where ``positions`` indexes back into the input
+        order and ``local_rows = rows[positions] - lo(shard)``."""
+        r = np.asarray(rows, dtype=np.int64).reshape(-1)
+        owners = self.owner_of(r)
+        out = {}
+        for s in np.unique(owners):
+            pos = np.nonzero(owners == s)[0]
+            out[int(s)] = (pos, r[pos] - self.bounds[int(s)][0])
+        return out
+
+    def partition(self, value: np.ndarray) -> List[np.ndarray]:
+        """Slice a full [height, D] array into per-shard row blocks."""
+        v = np.asarray(value)
+        if v.shape[0] != self.height:
+            raise ValueError(f"value has {v.shape[0]} rows, spec wants "
+                             f"{self.height}")
+        return [v[lo:hi] for lo, hi in self.bounds]
+
+    def __repr__(self):
+        return (f"ShardSpec(height={self.height}, "
+                f"num_shards={self.num_shards}, bounds={self.bounds})")
+
+
+# ---------------------------------------------------------------------------
+# Row codec (EQuARX-style): what actually crosses the DCN
+# ---------------------------------------------------------------------------
+
+CODECS = ("none", "bf16", "int8")
+
+
+def _resolve_codec(codec: Optional[str]) -> str:
+    if codec is None:
+        from paddle_tpu import flags
+        codec = flags.get("embed_exchange_codec")
+    if codec not in CODECS:
+        raise ValueError(f"unknown embed exchange codec {codec!r} "
+                         f"(want one of {CODECS})")
+    return codec
+
+
+def encode_rows(values: np.ndarray, codec: str) -> tuple:
+    """[K, D] float32 -> wire payload. ``none`` ships fp32 verbatim
+    (the exact-dense control arm); ``bf16`` truncates mantissas (2
+    bytes/elem); ``int8`` ships one fp32 scale per ROW (max-abs / 127)
+    plus int8 codes — the EQuARX block layout with block = row, which
+    keeps the quantization error relative to each embedding row's own
+    magnitude."""
+    v = np.ascontiguousarray(values, dtype=np.float32)
+    if codec == "none":
+        return ("none", v)
+    if codec == "bf16":
+        import ml_dtypes
+        return ("bf16", v.astype(ml_dtypes.bfloat16))
+    if codec == "int8":
+        scale = np.abs(v).max(axis=-1, keepdims=True) / 127.0
+        scale = np.where(scale == 0.0, 1.0, scale).astype(np.float32)
+        q = np.clip(np.rint(v / scale), -127, 127).astype(np.int8)
+        return ("int8", q, scale)
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def decode_rows(payload: tuple) -> np.ndarray:
+    kind = payload[0]
+    if kind == "none":
+        return np.asarray(payload[1], dtype=np.float32)
+    if kind == "bf16":
+        return np.asarray(payload[1]).astype(np.float32)
+    if kind == "int8":
+        q, scale = payload[1], payload[2]
+        return q.astype(np.float32) * scale
+    raise ValueError(f"unknown codec payload kind {kind!r}")
+
+
+def payload_nbytes(payload: tuple) -> int:
+    return sum(p.nbytes for p in payload[1:] if hasattr(p, "nbytes"))
+
+
+# ---------------------------------------------------------------------------
+# TableShardServer: one shard's row store
+# ---------------------------------------------------------------------------
+
+class TableShardServer:
+    """Row store for ONE contiguous range of one or more tables.
+
+    Families: each table is a dict family-name -> [R, D_fam] float32
+    (``param`` plus whatever row-aligned optimizer state the trainer
+    ships back — ``moment1``/``moment2`` for lazy adam). Families the
+    trainer pulls before ever pushing (a cold row's moments) come back
+    zero-filled at the param's row count and the puller's requested
+    width — lazy creation, no registration RPC.
+
+    At-most-once witness: every applied push appends its push_id to
+    ``applied_log`` (line-buffered + flushed before the ack), and a
+    (re)started server preloads the log into its dedup set. SIGKILL at
+    any point leaves the log a prefix of the acks sent; a client retry
+    of an un-acked push either applies cleanly (id absent) or is
+    refused as a duplicate (id present ⇒ it WAS applied before the
+    crash) — both end with exactly one apply."""
+
+    def __init__(self, shard_id: int, applied_log: Optional[str] = None):
+        self.shard_id = int(shard_id)
+        self._tables: Dict[str, Dict[str, np.ndarray]] = {}
+        self._rows_of: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._listener = None
+        self._stopping = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._applied: set = set()
+        self._applied_log_path = applied_log
+        self._applied_log = None
+        if applied_log:
+            if os.path.exists(applied_log):
+                with open(applied_log) as f:
+                    self._applied.update(
+                        line.strip() for line in f if line.strip())
+            self._applied_log = open(applied_log, "a")
+        self.applied_count = len(self._applied)
+
+    # -- state ------------------------------------------------------------
+
+    def load(self, table: str, values: np.ndarray,
+             family: str = "param") -> None:
+        """Install this shard's row block for ``table`` (the seed split:
+        ``ShardSpec.partition(full_value)[shard_id]``)."""
+        v = np.ascontiguousarray(values, dtype=np.float32)
+        with self._lock:
+            fams = self._tables.setdefault(table, {})
+            fams[family] = v.copy()
+            self._rows_of.setdefault(table, v.shape[0])
+            if v.shape[0] != self._rows_of[table]:
+                raise ValueError(
+                    f"{table}/{family}: {v.shape[0]} rows, table has "
+                    f"{self._rows_of[table]}")
+
+    def rows(self, table: str, family: str = "param") -> np.ndarray:
+        with self._lock:
+            return self._tables[table][family].copy()
+
+    # -- RPC handlers ------------------------------------------------------
+
+    def _pull_rows(self, table: str, local_rows: np.ndarray,
+                   families: Sequence[Tuple[str, int]], codec: str):
+        """{family: encoded [K, D_fam]} for local row indices; unknown
+        families zero-fill at the requested width (lazy optimizer
+        state). Param rows for a table never load()ed also zero-fill —
+        a shard joining empty behaves like an all-zeros init, and the
+        trainer's pull-before-first-use sees deterministic contents."""
+        rows = np.asarray(local_rows, dtype=np.int64)
+        out = {}
+        with self._lock:
+            fams = self._tables.get(table, {})
+            nrows = self._rows_of.get(table)
+            if nrows is not None and rows.size and rows.max() >= nrows:
+                raise IndexError(
+                    f"{table}: local rows up to {rows.max()} but shard "
+                    f"{self.shard_id} holds {nrows}")
+            for fam, width in families:
+                arr = fams.get(fam)
+                if arr is None:
+                    vals = np.zeros((rows.size, width), dtype=np.float32)
+                else:
+                    vals = arr[rows]
+                out[fam] = encode_rows(vals, codec)
+        return out
+
+    def _push_rows(self, table: str, local_rows: np.ndarray,
+                   payloads: Dict[str, tuple], push_id: Optional[str],
+                   nrows: Optional[int] = None):
+        """Overwrite rows (idempotent); dedup replayed push_ids via the
+        applied log. Returns True when applied, False when deduped.
+        Pushes are self-describing: the client ships the shard's range
+        row count, so a SIGKILLed shard restarted from just its applied
+        log (row store gone) re-creates families on the first retry."""
+        if push_id is not None and push_id in self._applied:
+            SHARD_PUSHES_DEDUPED.inc()
+            return False
+        rows = np.asarray(local_rows, dtype=np.int64)
+        with self._lock:
+            fams = self._tables.setdefault(table, {})
+            if nrows is not None:
+                self._rows_of.setdefault(table, int(nrows))
+            nrows = self._rows_of.get(table)
+            for fam, payload in payloads.items():
+                vals = decode_rows(payload)
+                arr = fams.get(fam)
+                if arr is None:
+                    if nrows is None:
+                        raise ValueError(
+                            f"{table}: pushed before load() and row "
+                            f"count unknown")
+                    arr = np.zeros((nrows, vals.shape[1]),
+                                   dtype=np.float32)
+                    fams[fam] = arr
+                arr[rows] = vals
+            if push_id is not None:
+                # log BEFORE the ack: a crash between apply and ack
+                # leaves the id in the log, so the client's retry is
+                # refused — at-most-once even across SIGKILL
+                self._applied.add(push_id)
+                if self._applied_log is not None:
+                    self._applied_log.write(push_id + "\n")
+                    self._applied_log.flush()
+                    os.fsync(self._applied_log.fileno())
+            self.applied_count = len(self._applied)
+        return True
+
+    # -- serving loop (async_pserver.py transport discipline) -------------
+
+    def serve(self, address=None, authkey: bytes = PAD, listener=None):
+        if listener is not None:
+            self._listener = listener
+        else:
+            if address is None:
+                raise ValueError("serve() needs address=... or listener=...")
+            from multiprocessing.connection import Listener
+            self._listener = Listener(tuple(address), authkey=authkey)
+
+        def accept_loop():
+            while not self._stopping.is_set():
+                try:
+                    conn = self._listener.accept()
+                except (OSError, EOFError):
+                    break
+                t = threading.Thread(target=self._client_loop,
+                                     args=(conn,), daemon=True)
+                t.start()
+                self._threads.append(t)
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self._listener.address
+
+    def _client_loop(self, conn):
+        try:
+            while True:
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == "pull_rows":
+                    # ("pull_rows", table, rows, families, codec
+                    #  [, traceparent])
+                    ctx = (tctx.from_traceparent(msg[5])
+                           if len(msg) > 5 else None)
+                    try:
+                        with tctx.activate(ctx if ctx is not None
+                                           else tctx.current()):
+                            with tctx.span("table_shard.pull_rows",
+                                           table=msg[1],
+                                           rows=int(np.size(msg[2]))):
+                                fams = self._pull_rows(msg[1], msg[2],
+                                                       msg[3], msg[4])
+                    except Exception as e:
+                        conn.send(("err", f"pull_rows: {e!r}"))
+                        continue
+                    conn.send(("rows", fams))
+                elif kind == "push_rows":
+                    # ("push_rows", table, rows, payloads, push_id,
+                    #  nrows [, traceparent])
+                    ctx = (tctx.from_traceparent(msg[6])
+                           if len(msg) > 6 else None)
+                    try:
+                        with tctx.activate(ctx if ctx is not None
+                                           else tctx.current()):
+                            with tctx.span("table_shard.push_rows",
+                                           table=msg[1],
+                                           rows=int(np.size(msg[2]))):
+                                applied = self._push_rows(
+                                    msg[1], msg[2], msg[3], msg[4],
+                                    nrows=msg[5])
+                    except Exception as e:
+                        conn.send(("err", f"push_rows: {e!r}"))
+                        continue
+                    conn.send(("ok", applied))
+                elif kind == "create_table":
+                    # ("create_table", table, nrows): declare the row
+                    # count so pushes can lazily create families
+                    # (idempotent; the seed path for subprocess shards)
+                    try:
+                        with self._lock:
+                            have = self._rows_of.setdefault(
+                                msg[1], int(msg[2]))
+                            if have != int(msg[2]):
+                                raise ValueError(
+                                    f"{msg[1]}: declared {msg[2]} rows, "
+                                    f"shard holds {have}")
+                    except Exception as e:
+                        conn.send(("err", f"create_table: {e!r}"))
+                        continue
+                    conn.send(("ok",))
+                elif kind == "stats":
+                    conn.send(("stats", {
+                        "shard_id": self.shard_id,
+                        "applied": self.applied_count,
+                        "tables": {t: sorted(f) for t, f in
+                                   self._tables.items()}}))
+                elif kind == "stop":
+                    conn.send(("ok",))
+                    self._stopping.set()
+                    break
+                else:
+                    conn.send(("err", f"unknown message {kind!r}"))
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if self._applied_log is not None:
+            try:
+                self._applied_log.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# ShardedTableClient: the trainer-side routing layer
+# ---------------------------------------------------------------------------
+
+class ShardedTableClient:
+    """Routes global row ids to owning shards: ONE pull and ONE push per
+    owning shard per step, rows shipped sparse (never densified to
+    [V, D] on the wire). Each shard connection carries its own
+    RetryPolicy + CircuitBreaker (``async_pserver.AsyncTrainerClient``
+    transport, breaker name ``table_shard<i>``): ``pull_rows`` is
+    idempotent and retried across connection death; ``push_rows``
+    retries REUSE the push_id, so a resend after an ambiguous failure is
+    deduped server-side — effectively-once without a coordinator."""
+
+    def __init__(self, endpoints: Sequence, spec: ShardSpec,
+                 authkey: bytes = PAD, codec: Optional[str] = None,
+                 retry_policy=None, breaker_factory=None):
+        from paddle_tpu.distributed.async_pserver import AsyncTrainerClient
+        from paddle_tpu.distributed.resilience import CircuitBreaker
+        if len(endpoints) != spec.num_shards:
+            raise ValueError(f"{len(endpoints)} endpoints for "
+                             f"{spec.num_shards}-shard spec")
+        self.spec = spec
+        self.codec = _resolve_codec(codec)
+        self._push_seq = 0
+        self._pushes_acked = 0
+        self._conns = []
+        for i, ep in enumerate(endpoints):
+            breaker = (breaker_factory(i) if breaker_factory else
+                       CircuitBreaker(failure_threshold=8,
+                                      reset_timeout_s=2.0,
+                                      name=f"table_shard{i}"))
+            self._conns.append(AsyncTrainerClient(
+                tuple(ep), authkey=authkey, retry_policy=retry_policy,
+                breaker=breaker))
+
+    # one logical RPC against one shard, riding AsyncTrainerClient's
+    # retry/breaker/trace plumbing (its _rpc appends the traceparent)
+    def _shard_rpc(self, shard: int, msg: tuple, site: str,
+                   idempotent: bool):
+        return self._conns[shard]._rpc(msg, site, idempotent=idempotent)
+
+    def pull_rows(self, table: str, rows,
+                  families: Sequence[Tuple[str, int]]
+                  ) -> Dict[str, np.ndarray]:
+        """Gather global ``rows`` across the fleet: one pull per owning
+        shard, reassembled in input order. Returns {family: [K, D_fam]}
+        float32 (decoded)."""
+        r = np.asarray(rows, dtype=np.int64).reshape(-1)
+        out = {fam: np.empty((r.size, width), dtype=np.float32)
+               for fam, width in families}
+        for shard, (pos, local) in self.spec.route(r).items():
+            kind, *rest = self._shard_rpc(
+                shard, ("pull_rows", table, local, tuple(families),
+                        self.codec),
+                "table_shard.pull_rows", idempotent=True)
+            if kind != "rows":
+                raise RuntimeError(f"pull_rows {table}: {rest}")
+            nbytes = 0
+            for fam, payload in rest[0].items():
+                out[fam][pos] = decode_rows(payload)
+                nbytes += payload_nbytes(payload)
+            SHARD_BYTES.labels(direction="pull", shard=str(shard)).inc(
+                nbytes)
+        return out
+
+    def push_rows(self, table: str, rows,
+                  values: Dict[str, np.ndarray],
+                  push_id: Optional[str] = None) -> int:
+        """Scatter rows back to their owners (overwrite): one push per
+        owning shard. ``values`` maps family -> [K, D_fam]. Returns the
+        number of shard pushes APPLIED (deduped replays don't count).
+        One user-level push fans out to <= num_shards wire pushes, each
+        with the derived id ``<push_id>/s<shard>`` — a retry of the
+        whole call reuses them all."""
+        r = np.asarray(rows, dtype=np.int64).reshape(-1)
+        if push_id is None:
+            push_id = f"push-{id(self):x}-{self._push_seq}"
+            self._push_seq += 1
+        applied = 0
+        for shard, (pos, local) in self.spec.route(r).items():
+            payloads = {fam: encode_rows(np.asarray(v)[pos], self.codec)
+                        for fam, v in values.items()}
+            nbytes = sum(payload_nbytes(p) for p in payloads.values())
+            lo, hi = self.spec.bounds[shard]
+            kind, *rest = self._shard_rpc(
+                shard, ("push_rows", table, local, payloads,
+                        f"{push_id}/s{shard}", hi - lo),
+                "table_shard.push_rows", idempotent=False)
+            if kind != "ok":
+                raise RuntimeError(f"push_rows {table}: {rest}")
+            SHARD_BYTES.labels(direction="push", shard=str(shard)).inc(
+                nbytes)
+            if rest[0]:
+                applied += 1
+                self._pushes_acked += 1
+        return applied
+
+    def push_sparse_grad(self, table: str, grad,
+                         push_id: Optional[str] = None) -> int:
+        """Ship a ``RowSparseGrad`` by range: dedupe, drop the padding
+        slots (rows == height), bucket by owner, one sparse push per
+        shard — the wire never sees a dense [V, D] gradient."""
+        g = grad.deduped() if hasattr(grad, "deduped") else grad
+        rows = np.asarray(g.rows)
+        vals = np.asarray(g.values, dtype=np.float32)
+        keep = rows < self.spec.height           # padding slots out
+        return self.push_rows(table, rows[keep], {"grad": vals[keep]},
+                              push_id=push_id)
+
+    def create_table(self, table: str) -> None:
+        """Declare ``table`` on every shard with its range's row count
+        (idempotent) so later pushes can lazily create families."""
+        for shard, (lo, hi) in enumerate(self.spec.bounds):
+            kind, *rest = self._shard_rpc(
+                shard, ("create_table", table, hi - lo),
+                "table_shard.create_table", idempotent=True)
+            if kind != "ok":
+                raise RuntimeError(f"create_table {table}: {rest}")
+
+    def seed_from_value(self, table: str, value: np.ndarray,
+                        push_id: Optional[str] = None) -> None:
+        """Scatter a full [height, D] seed (e.g. the startup-initialized
+        param pulled off the device once, before the cache swap) across
+        the fleet: declare the table, then one bulk row push per shard.
+        Codec-independent: seeds always ship fp32 so every arm of a
+        codec A/B starts from identical shard state."""
+        v = np.asarray(value, dtype=np.float32)
+        if v.shape[0] != self.spec.height:
+            raise ValueError(f"seed has {v.shape[0]} rows, spec wants "
+                             f"{self.spec.height}")
+        self.create_table(table)
+        codec, self.codec = self.codec, "none"
+        try:
+            self.push_rows(table, np.arange(v.shape[0]), {"param": v},
+                           push_id=push_id or f"seed-{table}")
+        finally:
+            self.codec = codec
+
+    @property
+    def pushes_acked(self) -> int:
+        """Client half of the at-most-once accounting: shard pushes this
+        client saw acknowledged AND applied. Chaos tests compare this
+        against the union of the shards' applied logs."""
+        return self._pushes_acked
+
+    def stats(self, shard: int) -> dict:
+        kind, *rest = self._shard_rpc(shard, ("stats",),
+                                      "table_shard.stats",
+                                      idempotent=True)
+        if kind != "stats":
+            raise RuntimeError(f"stats: {rest}")
+        return rest[0]
+
+    def stop_servers(self):
+        for c in self._conns:
+            c.stop_server()
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# Program-side marking + the proglint example program
+# ---------------------------------------------------------------------------
+
+SHARDED_ATTR = "__sharded__"
+
+
+def mark_sharded(program, param_name: str, num_shards: int) -> None:
+    """Mark ``param_name``'s var desc ``__sharded__`` in every block.
+    ``core/lowering.py`` reads the mark (plus the runtime pad-slot
+    registry the cache attaches) to lower lookup sites over the marked
+    table to the cache-hit fast path — no model change, no new op."""
+    desc = program.desc if hasattr(program, "desc") else program
+    found = False
+    for block in desc.blocks:
+        v = block.vars.get(param_name)
+        if v is not None:
+            v.attrs[SHARDED_ATTR] = int(num_shards)
+            found = True
+    if not found:
+        raise KeyError(f"no var {param_name!r} in program")
+    desc.bump_version()
+
+
+def lint_program():
+    """A sharded-lookup example program for the proglint gate
+    (tools/test_runner.py): a deepfm-style combined-table lookup whose
+    table is marked ``__sharded__`` — the verifier must stay green on
+    the marked program (the mark is metadata; the lowered fast path
+    changes runtime arrays, not program structure)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    ids = layers.data(name="feat_ids", shape=[4, 1], dtype="int64")
+    label = layers.data(name="label", shape=[1], dtype="float32")
+    emb = layers.embedding(
+        ids, size=[1024, 9],
+        param_attr=fluid.ParamAttr(name="sharded_emb"))
+    pooled = layers.reduce_sum(emb, dim=1)
+    logit = layers.fc(pooled, size=1)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label))
+    fluid.optimizer.Adam(learning_rate=1e-3, lazy_mode=True).minimize(loss)
+    mark_sharded(fluid.framework.default_main_program(), "sharded_emb",
+                 num_shards=2)
+    return loss

@@ -182,6 +182,17 @@ define("peak_flops", float, 0.0,
        "(paddle_mfu_ratio). 0 (default) autodetects from the attached "
        "chip's spec sheet (utils.flops.device_peak_flops) — set this on "
        "CPU runs/tests to get a real MFU instead of none.")
+define("peak_hbm", float, 0.0,
+       "Override the peak HBM bytes/s denominator of the bandwidth "
+       "gauge (bench bw_pct; utils.flops.device_peak_hbm). 0 (default) "
+       "autodetects from the attached chip's spec sheet — set this on "
+       "CPU runs/tests to get a real bw_pct instead of none.")
+define("embed_exchange_codec", str, "none",
+       "Wire codec for the sharded-embedding row exchange "
+       "(distributed/sharded_table.py): 'none' ships fp32 (the "
+       "exact-dense control arm), 'bf16' truncates to 2 bytes/elem, "
+       "'int8' ships int8 codes + one fp32 scale per row "
+       "(EQuARX-style). Applies to pull_rows AND push_rows payloads.")
 
 
 def _main():

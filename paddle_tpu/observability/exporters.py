@@ -236,7 +236,12 @@ def _preregister_catalog():
                 # latency/outcomes, queue depth, batch occupancy, the
                 # zero-steady-state compile counter, and the predictor's
                 # AOT-fallback counter — import-light (docs/serving.md)
-                "paddle_tpu.serving.metrics"):
+                "paddle_tpu.serving.metrics",
+                # sharded embedding tables: hot-rows cache hit/miss/
+                # eviction/occupancy and per-shard wire bytes
+                # (docs/performance.md 'Sharded embedding tables')
+                "paddle_tpu.ops.embed_cache",
+                "paddle_tpu.distributed.sharded_table"):
         try:
             importlib.import_module(mod)
         except Exception:     # a broken optional module must not kill

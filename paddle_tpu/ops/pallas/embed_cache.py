@@ -1,0 +1,141 @@
+"""Row gather/scatter kernels for the hot-rows embedding cache
+(Pallas TPU; ISSUE 14 tentpole — the TPP argument, arXiv:2104.05755:
+keep the cache maintenance hot loop a small set of reusable TPU-native
+primitives instead of bespoke per-model code).
+
+Same construction as ``embed_pool.py``: row indices ride in SMEM via
+scalar prefetch, the cache table stays in HBM (``pltpu.ANY``), and each
+row moves HBM<->VMEM with ``make_async_copy`` on a 2-slot rotation so
+the next row's DMA overlaps the current one. The fp32 sublane tile
+(``_BB = 8``) sets the grid granularity.
+
+- :func:`gather_rows` — ``cache[slots] -> [K, D]`` (the writeback read:
+  dirty param/moment rows lifted off-device before a push to the owning
+  shard).
+- :func:`scatter_rows` — ``cache.at[slots].set(rows)`` with the cache
+  buffer aliased in-place (the miss install: cold rows pulled from the
+  shard land in their assigned slots without copying the [C, D] cache).
+  Slots ``>= capacity`` are DROPPED, which is what makes the pow2
+  bucket padding of ``ops/embed_cache.py`` free: padding slots point
+  one past the pad row and simply never write.
+
+Both run under ``interpret=True`` on the CPU test backend
+(tests/test_pallas_kernels.py discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BB = 8             # rows per grid step (fp32 sublane tile)
+
+
+def _gather_kernel(slots_ref, cache_hbm, o_ref, row_ref, sem_ref):
+    """slots_ref [Kp] in SMEM; cache_hbm [C, D] in HBM; o_ref [BB, D]
+    output tile in VMEM; row_ref [2, 1, D] double buffer."""
+    i = pl.program_id(0)
+    cap = cache_hbm.shape[0]
+
+    def row_dma(slot, j):
+        idx = jnp.minimum(slots_ref[i * _BB + j], cap - 1)
+        return pltpu.make_async_copy(
+            cache_hbm.at[pl.ds(idx, 1), :],
+            row_ref.at[slot], sem_ref.at[slot])
+
+    row_dma(0, 0).start()
+    for j in range(_BB):                        # static sublane unroll
+        if j + 1 < _BB:
+            row_dma((j + 1) % 2, j + 1).start()
+        row_dma(j % 2, j).wait()
+        o_ref[j] = row_ref[j % 2][0]
+
+
+def gather_rows(cache, slots, interpret: bool = False):
+    """cache [C, D], slots [K] int -> [K, D] = cache[slots] (slots are
+    clamped into range — the caller's pow2 padding may point at the pad
+    row, whose contents are discarded host-side)."""
+    c, d = cache.shape
+    k = slots.shape[0]
+    slots = slots.astype(jnp.int32)
+    kp = -(-k // _BB) * _BB
+    if kp != k:
+        slots = jnp.concatenate(
+            [slots, jnp.zeros((kp - k,), slots.dtype)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,          # slots live in SMEM
+        grid=(kp // _BB,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # cache in HBM
+        out_specs=pl.BlockSpec((_BB, d), lambda i, slots: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, d), cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((kp, d), cache.dtype),
+        interpret=interpret,
+    )(slots, cache)
+    return out[:k]
+
+
+def _scatter_kernel(slots_ref, rows_hbm, cache_hbm, cache_out, sem_ref,
+                    *, rows_total):
+    """slots_ref [Kp] in SMEM; rows_hbm [Kp, D] in HBM; cache_out is the
+    SAME buffer as cache_hbm (input_output_alias) — each grid step DMAs
+    its _BB rows HBM->HBM into their slots; out-of-range slots drop."""
+    del cache_hbm                       # aliased: cache_out IS the cache
+    i = pl.program_id(0)
+    cap = cache_out.shape[0]
+    for j in range(_BB):                # static sublane unroll
+        k = i * _BB + j
+        slot = slots_ref[k]
+
+        @pl.when(jnp.logical_and(k < rows_total, slot < cap))
+        def _():
+            cp = pltpu.make_async_copy(
+                rows_hbm.at[pl.ds(k, 1), :],
+                cache_out.at[pl.ds(jnp.maximum(slot, 0), 1), :],
+                sem_ref.at[j % 2])
+            cp.start()
+            cp.wait()
+
+
+def scatter_rows(cache, slots, rows, interpret: bool = False):
+    """cache [C, D], slots [K] int, rows [K, D] -> cache with
+    ``cache[slots[k]] = rows[k]`` for every in-range slot; slots >= C
+    (or < 0) are dropped. The cache buffer is donated/aliased — the
+    update is in-place in HBM, never a [C, D] copy."""
+    c, d = cache.shape
+    k = slots.shape[0]
+    slots = slots.astype(jnp.int32)
+    rows = rows.astype(cache.dtype)
+    kp = -(-k // _BB) * _BB
+    if kp != k:
+        slots = jnp.concatenate(
+            [slots, jnp.full((kp - k,), c, slots.dtype)])   # dropped
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((kp - k, d), rows.dtype)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(kp // _BB,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),     # rows in HBM
+                  pl.BlockSpec(memory_space=pltpu.ANY)],    # cache in HBM
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+    )
+    return pl.pallas_call(
+        functools.partial(_scatter_kernel, rows_total=k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((c, d), cache.dtype),
+        # inputs are (slots, rows, cache) after scalar prefetch: alias
+        # the cache operand onto the output buffer (in-place install)
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(slots, rows, cache)

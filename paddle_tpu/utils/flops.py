@@ -430,6 +430,13 @@ def device_peak_flops(device=None) -> Optional[float]:
 
 
 def device_peak_hbm(device=None) -> Optional[float]:
+    """Peak HBM bytes/s of the attached chip; FLAGS_peak_hbm overrides
+    (the bandwidth twin of the FLAGS_peak_flops MFU override — set it on
+    CPU runs to get a real bw_pct instead of none)."""
+    from paddle_tpu import flags
+    override = flags.get("peak_hbm")
+    if override and override > 0:
+        return float(override)
     import jax
     if device is None:
         devs = jax.devices()

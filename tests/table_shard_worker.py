@@ -1,0 +1,31 @@
+"""Subprocess host for one TableShardServer — the chaos victim.
+
+Usage: table_shard_worker.py SHARD_ID PORT APPLIED_LOG
+
+Binds 127.0.0.1:PORT with the shared authkey and serves until stopped
+(or SIGKILLed — the point of the chaos test: the applied log survives,
+so a restart with the same arguments refuses replayed push_ids)."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    shard_id, port, applied_log = (int(sys.argv[1]), int(sys.argv[2]),
+                                   sys.argv[3])
+    from multiprocessing.connection import Listener
+
+    from paddle_tpu.distributed.sharded_table import PAD, TableShardServer
+
+    srv = TableShardServer(shard_id, applied_log=applied_log)
+    listener = Listener(("127.0.0.1", port), authkey=PAD)
+    srv.serve(listener=listener)
+    print("READY", flush=True)
+    while not srv._stopping.is_set():
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

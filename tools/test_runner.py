@@ -41,6 +41,13 @@ LINT_SERVING_MODULES = (
     "paddle_tpu.models.transformer:serve_lint_decode_slot",
 )
 
+# a sharded-lookup training program (table marked __sharded__, lazy-adam
+# over the combined embedding) — the verifier must stay green on marked
+# programs (ISSUE 14; docs/performance.md 'Sharded embedding tables')
+LINT_SHARDED_MODULES = (
+    "paddle_tpu.distributed.sharded_table:lint_program",
+)
+
 
 def shard_files(all_files, shards, shard):
     return [f for i, f in enumerate(sorted(all_files))
@@ -78,6 +85,17 @@ def run_lint_gate(root: str, timeout: int) -> int:
         for m in LINT_SERVING_MODULES:
             scmd += ["--module", m]
         r = subprocess.run(scmd, cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # sharded-embedding example program (train mode: the __sharded__
+        # mark is metadata — the lowered fast path swaps runtime arrays,
+        # never program structure, so the verifier must not notice)
+        print(f"test_runner: lint gate — proglint over sharded-table "
+              f"program {list(LINT_SHARDED_MODULES)}")
+        dcmd = [sys.executable, os.path.join(root, "tools", "proglint.py")]
+        for m in LINT_SHARDED_MODULES:
+            dcmd += ["--module", m]
+        r = subprocess.run(dcmd, cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
         # pass-pipeline smoke: apply ALL passes to the example programs
