@@ -96,6 +96,14 @@ def _write_metrics_snapshot(model_name: str, kind: str, nsteps: int,
             "autotune_measurements": _autotune.measurement_count(),
             "registry": obs_metrics.default_registry().snapshot(),
         }
+        # HBM picture at snapshot time (compiled gauges + census live in
+        # the registry dump above; this block adds the structured
+        # top-buffers/watermark view the memdump and /memory route share)
+        try:
+            from paddle_tpu.observability import memory as obs_mem
+            merged[f"{model_name}-{kind}"]["memory"] = obs_mem.dump_section()
+        except Exception:
+            pass
         tmp = METRICS_SNAPSHOT_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(merged, f, indent=1, sort_keys=True)
@@ -358,6 +366,25 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
     bw_pct = (gather_bps / peak_hbm * 100
               if gather_bps and peak_hbm else None)
 
+    # compiled peak-HBM twin to mfu_pct: XLA memory_analysis() on the
+    # exact executable the timing loop dispatched (same compile-cache
+    # key), as a fraction of the chip's HBM CAPACITY — None off-TPU
+    # unless FLAGS_hbm_bytes pins a capacity
+    peak_hbm_bytes = hbm_pct = None
+    try:
+        main.desc._obs_name = model_name
+        cb = exe._compiled(run_target, sorted(feeds), [loss.name], False)
+        mem = cb.analyzed_memory(
+            fluid.global_scope(), feeds, iterations=chunk,
+            stacked=sorted(set(int_names)) if int_names else False)
+        if mem:
+            peak_hbm_bytes = int(mem["peak_bytes"])
+            cap = flops_mod.device_hbm_bytes(exe.device)
+            if cap:
+                hbm_pct = peak_hbm_bytes / cap * 100
+    except Exception:
+        pass
+
     _write_metrics_snapshot(
         model_name, "train", nsteps, dt, batch_size,
         per_step if unit in ("tokens/sec", "words/sec") else None, mfu,
@@ -372,6 +399,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "unit": unit,
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
+        "peak_hbm_bytes": peak_hbm_bytes,
+        "hbm_pct": round(hbm_pct, 1) if hbm_pct is not None else None,
         "gather_bytes_per_s": (round(gather_bps, 0)
                                if gather_bps is not None else None),
         "bw_pct": round(bw_pct, 1) if bw_pct is not None else None,
@@ -587,6 +616,8 @@ def aggregate_line(rows, head, n_ok):
             c["mfu"] = r["mfu_pct"]
         if r.get("bw_pct") is not None:
             c["bw"] = r["bw_pct"]
+        if r.get("hbm_pct") is not None:
+            c["hbm"] = r["hbm_pct"]
         if r.get("value") is None:
             c["err"] = (r.get("error") or "?")[:40]
         compact.append(c)

@@ -11,11 +11,26 @@ is an UPPER bound on activations (XLA reuses buffers by liveness).
 
 from __future__ import annotations
 
+import re
+
 DTYPE_BYTES = {
     "float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
     "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
     "bool": 1,
 }
+
+
+# optimizer accumulators are named '<param>_<kind>_N' (fluid/optimizer.py
+# _add_accumulator); the kind list mirrors observability.memory's census
+# classifier
+_ACC_RE = re.compile(
+    r"_(velocity|moment1|moment2|beta1_pow_acc|beta2_pow_acc|moment|"
+    r"inf_norm|avg_squared_grad|avg_squared_update|mean_square|momentum|"
+    r"mean_grad|squared|linear)_\d+$")
+
+
+def _is_accumulator(name: str) -> bool:
+    return bool(_ACC_RE.search(name))
 
 
 def _var_bytes(v, batch_size):
@@ -47,6 +62,7 @@ def memory_usage(program, batch_size: int, optimizer_slots: int = 0):
     persistent = 0
     activations = 0
     params = 0
+    has_opt_state = False
     seen = set()
     # every block: while/RNN bodies and Pipeline stages hold their own
     # activation vars (one live iteration under lax.scan/while). A name
@@ -62,9 +78,17 @@ def memory_usage(program, batch_size: int, optimizer_slots: int = 0):
                 persistent += b
                 if getattr(v, "is_parameter", False):
                     params += b
+                elif (getattr(v, "attrs", None) or {}).get(
+                        "optimizer_state") or _is_accumulator(v.name):
+                    has_opt_state = True
             else:
                 activations += b
-    est_opt_state = params * optimizer_slots
+    # a minimized program already holds its accumulators as persistables
+    # (counted above) — a caller-passed optimizer_slots would double-
+    # count them, which the compiled memory_analysis() reconciliation
+    # caught (tools/mem_probe.py); the estimate only adds slots when the
+    # program provably has no optimizer state of its own
+    est_opt_state = 0 if has_opt_state else params * optimizer_slots
     persistent_total = persistent + est_opt_state
     return {
         "parameters": params,

@@ -23,7 +23,9 @@ from paddle_tpu import observability
 from paddle_tpu.core import ir
 from paddle_tpu.core.lowering import CompiledBlock
 from paddle_tpu.core.scope import Scope, global_scope
+from paddle_tpu.observability import memory as _obs_memory
 from paddle_tpu.observability import tracing as _obs_tracing
+from paddle_tpu.utils import faults as _faults
 
 
 class Place:
@@ -403,6 +405,9 @@ class Executor:
         from paddle_tpu import flags
         bench = flags.get("benchmark")
         obs_on = observability.enabled()
+        # HBM telemetry shares the step sampler's contract: this call is
+        # the subsystem's ENTIRE cost when off (one flag lookup)
+        mem_on = _obs_memory.enabled()
         if obs_on:
             # flags asked for telemetry: idempotently bring up the dump
             # thread / scrape endpoint (no-op bool check after the first)
@@ -417,15 +422,29 @@ class Executor:
         span = (_obs_tracing.span("executor.run", iterations=iterations)
                 if (obs_on or _obs_tracing.active())
                 else contextlib.nullcontext())
-        with span:
-            if iterations > 1:
-                seed0 = self._step + 1
-                self._step += iterations
-                outs = cb.run_steps(scope, feeds, seed0, iterations,
-                                    stacked=stacked)
-            else:
-                self._step += 1
-                outs = cb(scope, feeds, self._step)
+        try:
+            with span:
+                # chaos site: the OOM-forensics test arms
+                # 'executor.dispatch:raise@1:exc=MemoryError' here
+                _faults.inject("executor.dispatch")
+                if iterations > 1:
+                    seed0 = self._step + 1
+                    self._step += iterations
+                    outs = cb.run_steps(scope, feeds, seed0, iterations,
+                                        stacked=stacked)
+                else:
+                    self._step += 1
+                    outs = cb(scope, feeds, self._step)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED forensics: write the memdump (top live
+            # buffers + the failing program's compiled breakdown)
+            # through the flight-recorder path, then let the OOM
+            # propagate. oom_dump gates itself and never raises.
+            if _obs_memory.is_oom_error(e):
+                _obs_memory.oom_dump(cb, scope, e, feeds=feeds,
+                                     iterations=iterations,
+                                     stacked=stacked)
+            raise
         if bench:
             # dispatch wall time (async: device completion lands later;
             # reference capability: FLAGS_benchmark per-run executor timing)
@@ -478,7 +497,38 @@ class Executor:
             self._record_telemetry(
                 cb, program, scope, feeds, feed_names, iterations,
                 stacked, time.perf_counter() - t_dispatch)
+        if mem_on:
+            self._record_memory(cb, scope, feeds, iterations, stacked)
         return outs
+
+    def _record_memory(self, cb, scope, feeds, iterations, stacked):
+        """Per-dispatch HBM telemetry (observability.memory): compiled
+        breakdown gauges, live-buffer census + watermark, and a one-time
+        donation audit per compiled block. Every compiled query is
+        cached per jit signature, so steady state is gauge sets plus one
+        scope walk. Never raises."""
+        try:
+            _obs_memory.set_compiled_gauges(
+                cb.obs_label,
+                cb.analyzed_memory(scope, feeds, iterations, stacked))
+        except Exception:
+            pass
+        try:
+            if not getattr(cb, "_mem_params_noted", False):
+                cb._mem_params_noted = True
+                _obs_memory.note_params(
+                    n for n in (tuple(cb.sig.state_names)
+                                + tuple(cb.sig.const_names))
+                    if cb.block.has_var(n)
+                    and cb.block.var(n).is_parameter)
+            _obs_memory.record_census(scope)
+        except Exception:
+            pass
+        if cb._donate:
+            try:
+                cb.donation_audit(scope, feeds)
+            except Exception:
+                pass
 
     def _record_telemetry(self, cb, program, scope, feeds, feed_names,
                           iterations, stacked, elapsed_s):

@@ -300,6 +300,8 @@ class CompiledBlock:
         self.sig = analyze_block(block, feed_names, fetch_names)
         self.block = block
         self.dist = dist
+        self._program_desc = program
+        self._donate = bool(donate)
         # resolve every tunable region's autotune-cache lookup at BUILD
         # time: deterministic (committed table only — zero timing
         # measurements on this path, enforced by autotune.measure_ms's
@@ -487,6 +489,62 @@ class CompiledBlock:
         return obs_runtime.compiled_flops(
             fn, state, consts, feeds, np.uint32(0), cache_key=key,
             per_call_steps=iterations)
+
+    @property
+    def obs_label(self) -> str:
+        """Bounded-cardinality program label for memory metrics: the
+        name a caller pinned on the desc (bench/serving/mem_probe set
+        ``_obs_name``) or this block's build tag."""
+        return (getattr(self._program_desc, "_obs_name", None)
+                or f"block{self._obs_tag}")
+
+    def _feed_sig(self, feeds: Dict[str, Any]):
+        return tuple(sorted(
+            (n, tuple(getattr(v, "shape", ()) or ()))
+            for n, v in feeds.items()))
+
+    def analyzed_memory(self, scope, feeds: Dict[str, Any],
+                        iterations: int = 1, stacked=False):
+        """Compiled memory breakdown of this executable (argument/
+        output/temp/alias/generated_code/peak bytes) from XLA's
+        memory_analysis(), cached per jit signature exactly like
+        :meth:`analyzed_flops`. None when the backend reports nothing."""
+        from paddle_tpu.observability import memory as obs_memory
+        snames = (stacked if isinstance(stacked, bool)
+                  else tuple(sorted(stacked)))
+        key = ("mem", self._obs_tag, iterations, snames,
+               self._feed_sig(feeds))
+        hit, val = obs_memory.memory_cache_peek(key)
+        if hit:
+            return val
+        if iterations > 1:
+            fn = self._multi_fn(iterations, stacked)
+        else:
+            fn = self.fn
+        state, consts = self._gather_state(scope)
+        return obs_memory.compiled_memory(
+            fn, state, consts, feeds, np.uint32(0), cache_key=key)
+
+    def donation_audit(self, scope, feeds: Dict[str, Any]) -> dict:
+        """Verify every mutated state var this block donates actually
+        aliases in the compiled executable's input_output_alias header
+        (jit-pruned vars are skipped, not flagged). Cached per feed
+        signature; counts paddle_donation_violations_total on the first
+        resolution. {program, expected, aliased, violations, skipped}."""
+        from paddle_tpu.observability import memory as obs_memory
+        key = ("audit", self._obs_tag, self._feed_sig(feeds))
+        hit, val = obs_memory.memory_cache_peek(key)
+        if hit:
+            return val
+        state, consts = self._gather_state(scope)
+
+        def lower_text():
+            return self.fn.lower(state, consts, feeds,
+                                 np.uint32(0)).compile().as_text()
+
+        return obs_memory.donation_audit(
+            lower_text, self.sig.state_names, program=self.obs_label,
+            cache_key=key)
 
     def _input_shardings(self):
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -47,6 +47,15 @@ class Scope:
     def local_var_names(self) -> List[str]:
         return list(self._vars)
 
+    def iter_vars(self):
+        """Yield (name, value) for this scope and every descendant —
+        the observability census walk (a shadowed name yields once per
+        holding scope; the census dedups by array identity)."""
+        for item in self._vars.items():
+            yield item
+        for kid in self._kids:
+            yield from kid.iter_vars()
+
     def drop_kids(self) -> None:
         self._kids.clear()
 

@@ -187,6 +187,20 @@ define("peak_hbm", float, 0.0,
        "gauge (bench bw_pct; utils.flops.device_peak_hbm). 0 (default) "
        "autodetects from the attached chip's spec sheet — set this on "
        "CPU runs/tests to get a real bw_pct instead of none.")
+define("memory_stats", bool, False,
+       "HBM memory telemetry (paddle_tpu.observability.memory): per-"
+       "dispatch compiled memory breakdown (paddle_hbm_compiled_bytes), "
+       "live-buffer census gauges (paddle_hbm_live_bytes) with a process "
+       "watermark, and a one-time donation audit per compiled block "
+       "(paddle_donation_violations_total). Off (default) costs one flag "
+       "lookup per executor dispatch; OOM forensics (memdumps) also ride "
+       "FLAGS_flight_recorder_dir independently of this flag.")
+define("hbm_bytes", float, 0.0,
+       "Override the device HBM capacity (bytes) used as the hbm_pct "
+       "denominator in bench rows (utils.flops.device_hbm_bytes). 0 "
+       "(default) autodetects from device.memory_stats()['bytes_limit'] "
+       "or the chip spec sheet — set this on CPU runs/tests to get a "
+       "real hbm_pct instead of none.")
 define("embed_exchange_codec", str, "none",
        "Wire codec for the sharded-embedding row exchange "
        "(distributed/sharded_table.py): 'none' ships fp32 (the "

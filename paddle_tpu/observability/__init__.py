@@ -44,6 +44,7 @@ from paddle_tpu.observability import runtime  # noqa: F401
 from paddle_tpu.observability import exporters  # noqa: F401
 from paddle_tpu.observability import spool  # noqa: F401
 from paddle_tpu.observability import flight_recorder  # noqa: F401
+from paddle_tpu.observability import memory  # noqa: F401
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
     gauge, histogram)

@@ -170,6 +170,23 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if route == "/memory":
+            # on-demand HBM snapshot (observability.memory): census
+            # families, top buffers, watermark history — JSON, so an
+            # operator can jq it without a Prometheus stack
+            try:
+                from paddle_tpu.observability import memory
+                body = json.dumps(memory.snapshot(), default=str,
+                                  sort_keys=True).encode()
+            except Exception as e:
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if route not in ("/metrics", "/"):
             self.send_error(404)
             return
@@ -222,6 +239,10 @@ def _preregister_catalog():
     snapshot of ANY observed run) holds without those paths firing."""
     import importlib
     for mod in ("paddle_tpu.observability.runtime",
+                # HBM memory families (paddle_hbm_*, paddle_donation_*,
+                # paddle_oom_*): compiled breakdowns, census gauges,
+                # donation violations, OOM events
+                "paddle_tpu.observability.memory",
                 # the tracer's ring-overflow counter
                 # (paddle_trace_dropped_spans_total) — silent span loss
                 # is a lying timeline, so it's part of the catalog

@@ -143,6 +143,13 @@ class FlightRecorder:
                "events": ring,
                "metrics": metrics.default_registry().snapshot(),
                "faults": faults.stats()}
+        try:
+            # every crash artifact answers "what was resident": census
+            # families + top buffers + watermark history
+            from paddle_tpu.observability import memory
+            doc["memory"] = memory.dump_section()
+        except Exception:
+            pass
         tmp = self.dump_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f, default=str)

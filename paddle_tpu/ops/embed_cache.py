@@ -406,9 +406,15 @@ def enable_sharded_table(program, scope, param_name: str, client,
     # gather kernels keep cache-hitting (uncommitted zeros here would
     # recompile each bucket once the step fn's outputs take over).
     dev = jax.devices()[0]
+    from paddle_tpu.observability import memory as _obs_memory
     for fam, (name, width) in families.items():
         scope.set_var(name, jax.device_put(
             jnp.zeros((capacity + 1, width), dtype=jnp.float32), dev))
+        # census: the device arrays keep the TABLE/accumulator names
+        # (which would classify as param/optimizer_moment) but are the
+        # hot-rows cache — pin them to the embed_cache family
+        _obs_memory.register_buffer_family(name, "embed_cache")
+    _obs_memory.note_scope(scope)
 
     cache = HotRowsCache(param_name, height, capacity, client, scope,
                          families, padding_idx=padding_idx,

@@ -245,6 +245,13 @@ class GenerativeModel:
         if init:
             exe = fluid.Executor(fluid.TPUPlace())
             exe.run(pre_start, scope=self.scope)
+        # HBM observability: name the programs for the memory gauges
+        # and register the model scope with the census walk
+        from paddle_tpu.observability import memory as obs_memory
+        for p, (m, _s, _f, _o) in pre.items():
+            m.desc._obs_name = f"{name}.prefill@{p}"
+        dec_main.desc._obs_name = f"{name}.decode"
+        obs_memory.note_scope(self.scope)
         self._cb_prefill = {
             p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
                              is_test=True, donate=False)
@@ -278,18 +285,28 @@ class GenerativeModel:
         return state, consts, feeds, np.uint32(0)
 
     def _run(self, cb, aot_key, feeds) -> np.ndarray:
+        from paddle_tpu.observability import memory as obs_memory
+        from paddle_tpu.utils import faults
         args = self._args(cb, feeds)
-        aot = self._aot.get(aot_key)
-        if aot is not None:
-            try:
-                fetches, new_state = aot(*args)
-            except Exception:
-                # backend mis-mapped the deserialized executable: degrade
-                # to the (warmed) compile path for the rest of the run
-                self._aot.pop(aot_key, None)
+        try:
+            # chaos site for the serving OOM-forensics path
+            faults.inject("serving.dispatch")
+            aot = self._aot.get(aot_key)
+            if aot is not None:
+                try:
+                    fetches, new_state = aot(*args)
+                except Exception:
+                    # backend mis-mapped the deserialized executable:
+                    # degrade to the (warmed) compile path for the rest
+                    # of the run
+                    self._aot.pop(aot_key, None)
+                    fetches, new_state = cb.fn(*args)
+            else:
                 fetches, new_state = cb.fn(*args)
-        else:
-            fetches, new_state = cb.fn(*args)
+        except Exception as e:
+            if obs_memory.is_oom_error(e):
+                obs_memory.oom_dump(cb, self.scope, e, feeds=feeds)
+            raise
         for n, v in new_state.items():
             self.scope.set_var(n, v)
         return np.asarray(fetches[0])
@@ -298,8 +315,14 @@ class GenerativeModel:
                   p_len: Optional[int] = None) -> np.ndarray:
         if kind == "prefill":
             p = p_len or self.prompt_len
-            return self._run(self._cb_prefill[p],
-                             ("prefill", bucket, p), feeds)
+            out = self._run(self._cb_prefill[p],
+                            ("prefill", bucket, p), feeds)
+            # the prefill just (re)created the per-layer caches in the
+            # scope — refresh the exact KV-bytes gauge (once per wave,
+            # not per decoded token)
+            from paddle_tpu.observability import memory as obs_memory
+            obs_memory.kv_pool_bytes(self.scope, self.name)
+            return out
         return self._run(self._cb_decode, ("decode", bucket), feeds)
 
     def prompt_bucket_for(self, length: int) -> int:
@@ -565,6 +588,15 @@ class SlotGenerativeModel:
             exe = fluid.Executor(fluid.TPUPlace())
             # any slot startup: params + zero-filled pool caches
             exe.run(dec_start, scope=self.scope)
+        # HBM observability: program labels, census scope, and (the pool
+        # exists right after startup) the exact KV-pool bytes gauge
+        from paddle_tpu.observability import memory as obs_memory
+        for p, (m, _s, _f, _o) in pre.items():
+            m.desc._obs_name = f"{name}.prefill_slot@{p}"
+        dec_main.desc._obs_name = f"{name}.decode_slot"
+        obs_memory.note_scope(self.scope)
+        if init:
+            obs_memory.kv_pool_bytes(self.scope, name)
         self._cb_prefill = {
             p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
                              is_test=True, donate=True)

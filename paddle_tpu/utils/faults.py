@@ -68,6 +68,11 @@ _EXC_BY_NAME = {
     "IOError": IOError,
     "EOFError": EOFError,
     "RuntimeError": RuntimeError,
+    # host-OOM analogue: the executor's forensics path treats an
+    # injected MemoryError like a device RESOURCE_EXHAUSTED
+    # (observability.memory.is_oom_error), so chaos tests can force a
+    # memdump at any dispatch site
+    "MemoryError": MemoryError,
 }
 
 _MODES = ("raise", "delay", "truncate")

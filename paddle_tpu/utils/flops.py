@@ -446,6 +446,46 @@ def device_peak_hbm(device=None) -> Optional[float]:
     return _PEAK_HBM.get(getattr(device, "device_kind", ""), None)
 
 
+# HBM capacity (bytes) by device_kind — spec-sheet fallback when PJRT
+# doesn't report memory_stats (distinct from _PEAK_HBM, which is
+# BANDWIDTH bytes/s)
+_HBM_BYTES = {
+    "TPU v4": 32 << 30,
+    "TPU v5 lite": 16 << 30,
+    "TPU v5": 95 << 30,
+    "TPU v5p": 95 << 30,
+    "TPU v6 lite": 32 << 30,
+    "TPU v6e": 32 << 30,
+}
+
+
+def device_hbm_bytes(device=None) -> Optional[float]:
+    """HBM capacity in bytes of the attached chip — the hbm_pct
+    denominator in bench rows. FLAGS_hbm_bytes overrides; otherwise
+    PJRT's own memory_stats()['bytes_limit'] (the allocator's truth,
+    reflecting XLA_PYTHON_CLIENT_* fractions), then the spec sheet.
+    None on CPU without an override."""
+    from paddle_tpu import flags
+    override = flags.get("hbm_bytes")
+    if override and override > 0:
+        return float(override)
+    import jax
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    if getattr(device, "platform", "") == "cpu":
+        return None
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        return float(stats["bytes_limit"])
+    return _HBM_BYTES.get(getattr(device, "device_kind", ""), None)
+
+
 def mfu(program, batch_size: int, step_seconds: float,
         device=None) -> Optional[float]:
     """Model FLOPs Utilization in [0, 1], or None off-TPU."""

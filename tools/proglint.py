@@ -80,6 +80,67 @@ def _build_module(spec):
             (f"{spec}:startup", startup, [], None)]
 
 
+def _infer_io(desc):
+    """(feed_names, fetch_names) from block-0 dataflow when the target
+    carries none (a --module entry discards them): feeds are
+    non-persistable vars consumed but never produced, fetches the
+    non-persistable graph sinks."""
+    block = desc.blocks[0]
+    produced, consumed = set(), set()
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        consumed.update(op.input_names())
+        produced.update(op.output_names())
+    persist = {n for n, v in block.vars.items() if v.persistable}
+    feeds = sorted((consumed - produced - persist) & set(block.vars))
+    fetches = sorted(n for n in (produced - consumed - persist)
+                     if n in block.vars)
+    return feeds, fetches
+
+
+def _zeros_for(v, batch=4):
+    import numpy as np
+    shape = [batch if d is None or int(d) < 0 else int(d)
+             for d in (getattr(v, "shape", None) or [])]
+    try:
+        np_dt = np.dtype(getattr(v, "dtype", None) or "float32")
+    except TypeError:
+        np_dt = np.dtype("float32")
+    return np.zeros(shape, np_dt)
+
+
+def _memory_audit(label, main, startup, feed_names):
+    """Donation audit (observability.memory) of one main+startup pair:
+    run startup into a fresh scope, zero-fill any state persistable the
+    startup does not materialize (serving cache pools are created by a
+    warmup dispatch), lower the executable with zero feeds shaped from
+    the program's declared vars, and verify every donated state buffer
+    aliases in the compiled input_output_alias header. Nothing is
+    executed beyond startup — the audit is a compile-time check."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.core.lowering import CompiledBlock
+
+    desc = main.desc if hasattr(main, "desc") else main
+    inferred_feeds, fetch_names = _infer_io(desc)
+    feed_names = sorted(feed_names) if feed_names else inferred_feeds
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace())
+    if startup is not None:
+        exe.run(startup, scope=scope)
+    block = desc.blocks[0]
+    for n, v in block.vars.items():
+        if (v.persistable and scope.find_var(n) is None
+                and v.shape is not None and n not in feed_names):
+            scope.set_var(n, _zeros_for(v))
+    desc._obs_name = label
+    cb = CompiledBlock(desc, 0, feed_names, fetch_names,
+                       is_test=bool(getattr(main, "_is_test", False)))
+    feeds = {n: _zeros_for(block.vars[n]) for n in feed_names
+             if n in block.vars}
+    return cb.donation_audit(scope, feeds)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="proglint", description=__doc__,
@@ -111,6 +172,13 @@ def main(argv=None):
                     help="comma-separated rule ids to run (default all)")
     ap.add_argument("--suppress", default="",
                     help="comma-separated rule ids to drop program-wide")
+    ap.add_argument("--memory", action="store_true",
+                    help="donation audit: lower each main program "
+                         "(startup run into a fresh scope, zero feeds) "
+                         "and FAIL if a donated state buffer does not "
+                         "alias in the compiled executable's "
+                         "input_output_alias header "
+                         "(docs/observability.md, Memory observability)")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too")
     ap.add_argument("--json", action="store_true",
@@ -184,7 +252,38 @@ def main(argv=None):
                   f"{len(warns)} warning(s), {len(infos)} info(s)")
             for d in diags:
                 print("    " + d.format())
-    if n_err or (args.strict and n_warn):
+    n_mem = 0
+    if args.memory:
+        for name, program, feeds, _fetches in targets:
+            if name.endswith(":startup"):
+                continue
+            base = name[:-5] if name.endswith(":main") else name
+            startup = next((p for n2, p, _f, _ in targets
+                            if n2 == f"{base}:startup"), None)
+            try:
+                audit = _memory_audit(base, program, startup, feeds)
+            except Exception as e:
+                print(f"[FAIL] {base}: donation audit error: {e}")
+                n_mem += 1
+                continue
+            bad = list(audit.get("violations") or [])
+            if audit.get("error"):
+                print(f"[FAIL] {base}: donation audit error: "
+                      f"{audit['error']}")
+                n_mem += 1
+                continue
+            status = "FAIL" if bad else "ok"
+            line = (f"[{status}] {base}: donation audit — "
+                    f"{len(audit['aliased'])}/{len(audit['expected'])} "
+                    f"state buffers aliased, {len(bad)} violation(s)")
+            if bad:
+                line += f": {sorted(bad)}"
+            if audit.get("skipped"):
+                line += f", {len(audit['skipped'])} jit-pruned"
+            print(line)
+            n_mem += len(bad)
+
+    if n_err or n_mem or (args.strict and n_warn):
         return 1
     return 0
 

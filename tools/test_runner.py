@@ -98,6 +98,27 @@ def run_lint_gate(root: str, timeout: int) -> int:
         r = subprocess.run(dcmd, cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
+        # memory observability gate: mem_probe --smoke (compiled
+        # breakdown + estimator band + donation audit on mnist and the
+        # serving decode program) and proglint --memory on the decode
+        # executable — a donation regression (a state buffer that stops
+        # aliasing in input_output_alias) fails CI here, before any
+        # test runs (docs/observability.md "Memory observability")
+        print("test_runner: lint gate — mem_probe --smoke")
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "mem_probe.py"),
+             "--smoke"], cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        print("test_runner: lint gate — proglint --memory over the "
+              "serving decode program")
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "proglint.py"),
+             "--memory", "--is-test", "--module",
+             "paddle_tpu.models.transformer:serve_lint_decode"],
+            cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
         # pass-pipeline smoke: apply ALL passes to the example programs
         # and lint the post-pass programs, under the autotune
         # measurement-forbidden guard — proves (a) the rewritten zoo
