@@ -45,6 +45,16 @@ _LAZY = {
     "ModelServer": ("paddle_tpu.serving.server", "ModelServer"),
     "Router": ("paddle_tpu.serving.router", "Router"),
     "ROUTER_ENV": ("paddle_tpu.serving.router", "ROUTER_ENV"),
+    "Autoscaler": ("paddle_tpu.serving.autoscaler", "Autoscaler"),
+    "AutoscalePolicy": ("paddle_tpu.serving.autoscaler",
+                        "AutoscalePolicy"),
+    "RouterSource": ("paddle_tpu.serving.autoscaler", "RouterSource"),
+    "PlacementError": ("paddle_tpu.serving.autoscaler",
+                       "PlacementError"),
+    "bin_pack": ("paddle_tpu.serving.autoscaler", "bin_pack"),
+    "plan_placement": ("paddle_tpu.serving.autoscaler",
+                       "plan_placement"),
+    "validate_host": ("paddle_tpu.serving.autoscaler", "validate_host"),
     "RequestShedError": ("paddle_tpu.serving.server", "RequestShedError"),
     "ReplicaDrainingError": ("paddle_tpu.serving.server",
                              "ReplicaDrainingError"),
@@ -68,6 +78,7 @@ _LAZY = {
     "client": ("paddle_tpu.serving.client", None),
     "router": ("paddle_tpu.serving.router", None),
     "replica": ("paddle_tpu.serving.replica", None),
+    "autoscaler": ("paddle_tpu.serving.autoscaler", None),
 }
 
 __all__ = sorted(_LAZY)

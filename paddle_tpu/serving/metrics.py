@@ -125,8 +125,45 @@ ROUTER_DRAIN_DURATION = _metrics.histogram(
 ROUTER_RESTARTS = _metrics.counter(
     "paddle_router_replica_restarts_total",
     "Replica respawns, by cause: crash (supervisor restart-with-"
-    "backoff) | rolling (operator-driven drain+replace)",
+    "backoff) | rolling (operator-driven drain+replace) | oom "
+    "(memdump-witnessed death, replaced with the fallback spec) | "
+    "quarantine_retry (cooldown expired on a FAILED slot)",
     labelnames=("cause",))
+ROUTER_REPLICA_INFLIGHT = _metrics.gauge(
+    "paddle_router_replica_inflight",
+    "Requests the router currently has outstanding against this pool "
+    "slot — the router-side congestion view the autoscaler reads "
+    "instead of object internals", labelnames=("replica",))
+ROUTER_REPLICA_QUEUE_DEPTH = _metrics.gauge(
+    "paddle_router_replica_queue_depth",
+    "Queued requests on the replica (summed over its hosted models, "
+    "polled via the stats RPC by the router's monitor thread)",
+    labelnames=("replica",))
+ROUTER_REPLICA_STATE = _metrics.gauge(
+    "paddle_router_replica_state",
+    "One-hot replica lifecycle state per pool slot: exactly one of "
+    "starting | ready | draining | down | failed is 1",
+    labelnames=("replica", "state"))
+
+# -- autoscaler families (serving/autoscaler.py) ------------------------
+AUTOSCALER_DECISIONS = _metrics.counter(
+    "paddle_autoscaler_decisions_total",
+    "Control-loop verdicts, by action: hold | scale_up | scale_down "
+    "(one per step; scale actions also appear in the fleet-size trace)",
+    labelnames=("action",))
+AUTOSCALER_FLEET_SIZE = _metrics.gauge(
+    "paddle_autoscaler_fleet_size",
+    "Replica counts the reconciler sees, by kind: desired (the "
+    "policy's target) | ready (routable now) | total (pool slots "
+    "incl. starting/draining)", labelnames=("kind",))
+AUTOSCALER_SIGNAL = _metrics.gauge(
+    "paddle_autoscaler_signal",
+    "The scaling signals of the last step: queue_wait_p99_s (windowed "
+    "across the fleet) | queue_depth (summed)", labelnames=("signal",))
+AUTOSCALER_SLO_ATTAINMENT = _metrics.gauge(
+    "paddle_autoscaler_slo_attainment_ratio",
+    "Fraction of windowed queue-wait observations at or under the "
+    "policy SLO (1.0 with an empty window — no evidence of breach)")
 
 
 class CompileForbiddenError(RuntimeError):

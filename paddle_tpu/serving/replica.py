@@ -19,7 +19,14 @@ Spec format (``--spec`` file or ``--spec-json`` inline)::
     {"model": {"kind": "saved", "name": "clf",
                "model_dir": "/path", "buckets": [1, 2, 4],
                "aot_dir": null},
-     "max_queue_depth": 64, "linger_s": 0.002}
+     "max_queue_depth": 64, "linger_s": 0.002,
+     "oom_exit": true,
+     "env": {"FLAGS_fault_plan": "..."}}
+
+(``env`` is consumed by the SUPERVISOR — serving/router.py merges it
+into the child environment at spawn, the chaos harness's per-slot
+fault-plan hook; ``oom_exit`` selects the die-don't-ack OOM behavior
+the router's replace path depends on.)
 
     {"model": {"kind": "decoder_lm", "name": "lm", "slots": true,
                "params": {"prompt_len": 8, "max_new": 8, "vocab": 32,
@@ -106,9 +113,15 @@ def main(argv=None) -> int:
         flags.set("trace_role", "replica")
 
     from paddle_tpu.serving.server import ModelServer
+    # oom_exit (default True): a dispatch OOM kills this process
+    # WITHOUT acking errors — the supervising router finds the memdump,
+    # classifies the death cause="oom", and replaces the replica with
+    # its fallback spec (serving/autoscaler.py). Spec-gated so an
+    # unsupervised replica can keep the settle-with-error behavior.
     server = ModelServer(
         linger_s=float(spec.get("linger_s", 0.002)),
-        max_queue_depth=int(spec.get("max_queue_depth", 64)))
+        max_queue_depth=int(spec.get("max_queue_depth", 64)),
+        oom_exit=bool(spec.get("oom_exit", True)))
 
     # serve FIRST (ready=False): readyz answers "not ready" during the
     # warmup below, and the endpoint file lands before the compiles so

@@ -29,8 +29,26 @@ and settles in-flight work before a clean exit — ``restart_replica`` /
 replace replicas one at a time under live load with zero non-shed
 failures.
 
+The pool is ELASTIC (serving/autoscaler.py closes the loop):
+``scale_up`` appends fresh slots, ``scale_down`` retires one via the
+same graceful drain rolling restarts use, and slot indexes are
+monotonic — never reused — so sticky entries and per-replica metrics
+stay unambiguous across scale events. Replica deaths are classified:
+a ``<role>.<pid>.memdump.json`` in the slot's flight-recorder dir
+(observability/memory.py OOM forensics) marks the death
+``cause="oom"`` and the slot respawns ONCE with the registered
+fallback spec instead of re-entering the restart/quarantine loop (an
+OOM is deterministic under the same config — respawning it can only
+crash-loop). Crash-loop quarantine is no longer terminal: a FAILED
+slot retries after a backed-off cooldown, and a sustained healthy
+period resets the whole restart ledger.
+
 Telemetry: ``paddle_router_replica_up`` (per-slot routing
-eligibility), ``paddle_router_failovers_total{cause}``,
+eligibility), ``paddle_router_replica_state{replica,state}``
+(one-hot lifecycle), ``paddle_router_replica_inflight`` /
+``paddle_router_replica_queue_depth`` (the autoscaler's congestion
+view — polled via the stats RPC, never object internals),
+``paddle_router_failovers_total{cause}``,
 ``paddle_router_drain_duration_seconds``,
 ``paddle_router_replica_restarts_total{cause}``,
 ``paddle_router_requests_total{outcome}``; trace spans ``router.route``
@@ -65,6 +83,7 @@ ROUTER_ENV = "PADDLE_ROUTER"
 # one for NEW request_ids — `draining` still serves sticky retries)
 STARTING, READY, DRAINING, DOWN, FAILED = (
     "starting", "ready", "draining", "down", "failed")
+_STATES = (STARTING, READY, DRAINING, DOWN, FAILED)
 
 
 class _Replica:
@@ -75,23 +94,36 @@ class _Replica:
 
     def __init__(self, index: int, endpoint: Optional[str] = None,
                  breaker_threshold: int = 3,
-                 breaker_reset_s: float = 1.0):
+                 breaker_reset_s: float = 1.0,
+                 spec: Optional[dict] = None):
         self.index = index
         self.endpoint = endpoint
-        self.state = STARTING if endpoint is None else READY
+        self.state = STARTING
+        self.spec = spec                   # per-slot spec override
         self.proc: Optional[subprocess.Popen] = None
         self.endpoint_file: Optional[str] = None
+        self.flight_dir: Optional[str] = None   # child's recorder dir
         self.gen = 0
         self.inflight = 0
+        self.queue_depth = 0               # replica-reported (polled)
         self.lock = threading.Lock()
         self.restart_times: deque = deque(maxlen=16)
         self.restart_at = 0.0              # next supervised respawn time
         self.backoff_s = 0.0
+        self.failed_at = 0.0               # quarantine entry time
+        self.quarantines = 0               # quarantine episodes so far
+        self.ready_since = 0.0             # for the sustained-healthy reset
+        self.oom_replaced = False          # fallback spec already applied
+        self.retiring = False              # scale_down owns this slot
+        self.last_exit: Optional[dict] = None
+        self._stats_at = 0.0               # last stats-poll time
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             reset_timeout_s=breaker_reset_s,
             name=f"router-replica-{index}")
         self._tl = threading.local()       # per-thread socket cache
+        # through set_state so the one-hot state gauge is born correct
+        self.set_state(STARTING if endpoint is None else READY)
 
     # -- wire ------------------------------------------------------------
     def _dial(self, timeout: float):
@@ -140,9 +172,27 @@ class _Replica:
             raise
 
     def set_state(self, state: str):
+        prev = self.state
         self.state = state
+        if state == READY and prev != READY:
+            self.ready_since = time.monotonic()
         smetrics.ROUTER_REPLICA_UP.labels(
             replica=str(self.index)).set(1.0 if state == READY else 0.0)
+        for s in _STATES:
+            smetrics.ROUTER_REPLICA_STATE.labels(
+                replica=str(self.index),
+                state=s).set(1.0 if s == state else 0.0)
+
+    def retire_gauges(self):
+        """Zero every per-replica gauge when the slot leaves the pool —
+        a scraped fleet must not show a ghost replica as up."""
+        lbl = str(self.index)
+        smetrics.ROUTER_REPLICA_UP.labels(replica=lbl).set(0.0)
+        smetrics.ROUTER_REPLICA_INFLIGHT.labels(replica=lbl).set(0.0)
+        smetrics.ROUTER_REPLICA_QUEUE_DEPTH.labels(replica=lbl).set(0.0)
+        for s in _STATES:
+            smetrics.ROUTER_REPLICA_STATE.labels(
+                replica=lbl, state=s).set(0.0)
 
 
 class Router:
@@ -157,11 +207,20 @@ class Router:
     * **attached** — ``Router(endpoints=[...])`` fronts externally
       managed servers: routing, stickiness, breakers, and failover all
       work, but restarts are refused (nothing to respawn).
+
+    ``specs=[...]`` (supervised) gives each initial slot its own spec
+    — heterogeneous pools, and the chaos harness's per-slot fault
+    plans via a spec-level ``"env"`` dict. The pool is elastic:
+    :meth:`scale_up` / :meth:`scale_down` grow and drain-shrink it
+    (serving/autoscaler.py drives them from metrics), and
+    ``oom_fallback`` names the smaller-footprint spec a
+    memdump-witnessed OOM death is replaced with.
     """
 
     def __init__(self, spec: Optional[dict] = None, replicas: int = 0,
                  endpoints: Optional[List[str]] = None,
                  workdir: Optional[str] = None,
+                 specs: Optional[List[dict]] = None,
                  request_timeout_s: float = 120.0,
                  route_deadline_s: float = 30.0,
                  ready_timeout_s: float = 600.0,
@@ -173,11 +232,18 @@ class Router:
                  crash_loop_limit: int = 5,
                  breaker_threshold: int = 3,
                  breaker_reset_s: float = 1.0,
-                 sticky_capacity: int = 4096):
-        if endpoints is None and (spec is None or replicas <= 0):
-            raise ValueError("Router needs either endpoints=[...] or "
-                             "spec=... with replicas>=1")
-        self._spec = spec
+                 sticky_capacity: int = 4096,
+                 quarantine_cooldown_s: float = 30.0,
+                 quarantine_backoff_max: float = 8.0,
+                 healthy_reset_s: float = 30.0,
+                 oom_fallback=None,
+                 stats_poll_interval_s: float = 0.25):
+        if endpoints is None and not specs \
+                and (spec is None or replicas <= 0):
+            raise ValueError("Router needs endpoints=[...], "
+                             "specs=[...], or spec=... with replicas>=1")
+        self._spec = spec if spec is not None \
+            else (specs[0] if specs else None)
         self._workdir = workdir
         self._request_timeout = float(request_timeout_s)
         self._route_deadline = float(route_deadline_s)
@@ -188,13 +254,29 @@ class Router:
         self._backoff_max = float(restart_backoff_max_s)
         self._crash_window = float(crash_loop_window_s)
         self._crash_limit = int(crash_loop_limit)
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset = float(breaker_reset_s)
+        self._quarantine_cooldown = float(quarantine_cooldown_s)
+        self._quarantine_backoff_max = float(quarantine_backoff_max)
+        self._healthy_reset = float(healthy_reset_s)
+        self._oom_fallback = oom_fallback
+        self._stats_poll = float(stats_poll_interval_s)
         self._supervised = endpoints is None
-        n = replicas if self._supervised else len(endpoints)
+        if self._supervised:
+            slot_specs = list(specs) if specs else [spec] * replicas
+            n = len(slot_specs)
+        else:
+            n = len(endpoints)
+            slot_specs = [None] * n
         self._replicas = [
             _Replica(i, None if self._supervised else endpoints[i],
                      breaker_threshold=breaker_threshold,
-                     breaker_reset_s=breaker_reset_s)
+                     breaker_reset_s=breaker_reset_s,
+                     spec=slot_specs[i])
             for i in range(n)]
+        self._by_index = {r.index: r for r in self._replicas}
+        self._next_index = n
+        self._pool_lock = threading.Lock()
         self._sticky: "OrderedDict[str, int]" = OrderedDict()
         self._sticky_capacity = int(sticky_capacity)
         self._sticky_lock = threading.Lock()
@@ -229,6 +311,7 @@ class Router:
 
     def _spawn(self, r: _Replica):
         """Start (or restart) the replica process for slot ``r``."""
+        spec = r.spec if r.spec is not None else self._spec
         r.endpoint_file = os.path.join(
             self._workdir, f"replica{r.index}.endpoint")
         try:
@@ -237,9 +320,17 @@ class Router:
             pass
         env = dict(os.environ)
         env.setdefault("FLAGS_trace_role", "replica")
+        # OOM-forensics rendezvous: every child gets a flight-recorder
+        # dir, so a replica that dies of OOM leaves its
+        # <role>.<pid>.memdump.json where _monitor_one can find it
+        r.flight_dir = env.setdefault(
+            "FLAGS_flight_recorder_dir",
+            os.path.join(self._workdir, f"replica{r.index}-flight"))
+        for k, v in (spec.get("env") or {}).items():
+            env[k] = str(v)                # per-slot spec env wins
         r.proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving.replica",
-             "--spec-json", json.dumps(self._spec),
+             "--spec-json", json.dumps(spec),
              "--endpoint-file", r.endpoint_file,
              "--replica-id", str(r.index)],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
@@ -271,40 +362,114 @@ class Router:
 
     def _monitor(self):
         """The supervisor loop: readyz-gate STARTING replicas, detect
-        deaths, restart with capped backoff, declare crash loops."""
+        deaths, restart with capped backoff, declare crash loops (and
+        let them out of quarantine after the cooldown)."""
         while self._running:
-            for r in self._replicas:
+            for r in list(self._replicas):
                 try:
                     self._monitor_one(r)
                 except Exception:
                     pass                   # the supervisor never dies
             time.sleep(0.05)
 
+    def _find_memdump(self, r: _Replica, pid) -> Optional[str]:
+        """The dead replica's ``<role>.<pid>.memdump.json`` (written by
+        observability.memory.oom_dump on its way down), if any — the
+        witness that classifies this death ``cause="oom"``."""
+        if not r.flight_dir or pid is None \
+                or not os.path.isdir(r.flight_dir):
+            return None
+        suffix = f".{pid}.memdump.json"
+        try:
+            names = os.listdir(r.flight_dir)
+        except OSError:
+            return None
+        for n in sorted(names):
+            if n.endswith(suffix):
+                return os.path.join(r.flight_dir, n)
+        return None
+
     def _monitor_one(self, r: _Replica):
         now = time.monotonic()
+        if r.retiring:
+            return                         # scale_down owns this slot
         if self._supervised:
             alive = r.proc is not None and r.proc.poll() is None
             if not alive and r.state not in (DOWN, FAILED):
                 code = r.proc.poll() if r.proc is not None else None
+                pid = r.proc.pid if r.proc is not None else None
                 r.set_state(DOWN)
                 with r.lock:
                     r.gen += 1             # poison cached sockets
+                memdump = self._find_memdump(r, pid)
+                if memdump and not r.oom_replaced:
+                    # memdump-witnessed OOM: replace with the smaller-
+                    # footprint fallback spec instead of re-entering the
+                    # restart/quarantine loop — an OOM is deterministic
+                    # under the same config, so respawning it unchanged
+                    # can only crash-loop. One replacement per slot: a
+                    # second OOM (the fallback itself too big) falls
+                    # through to crash accounting below.
+                    r.last_exit = {"code": code, "cause": "oom",
+                                   "memdump": memdump}
+                    flight_recorder.note("replica_oom", replica=r.index,
+                                         code=code, memdump=memdump)
+                    fb = self._oom_fallback
+                    if fb is not None:
+                        base = (r.spec if r.spec is not None
+                                else self._spec)
+                        r.spec = fb(base) if callable(fb) else dict(fb)
+                    r.oom_replaced = True
+                    r.restart_times.clear()  # not crash-loop evidence
+                    r.backoff_s = 0.0
+                    self._sticky_clear_replica(r.index)
+                    smetrics.ROUTER_RESTARTS.labels(cause="oom").inc()
+                    self._spawn(r)
+                    return
+                cause = "oom" if memdump else "crash"
+                r.last_exit = {"code": code, "cause": cause,
+                               "memdump": memdump}
                 flight_recorder.note("replica_down",
                                      replica=r.index, code=code)
+                if memdump:
+                    smetrics.ROUTER_RESTARTS.labels(cause="oom").inc()
                 # crash-loop detection over the restart window
                 r.restart_times.append(now)
                 recent = [t for t in r.restart_times
                           if now - t <= self._crash_window]
                 if len(recent) >= self._crash_limit:
                     r.set_state(FAILED)
+                    r.failed_at = now
+                    r.quarantines += 1
                     flight_recorder.note("replica_crash_loop",
                                          replica=r.index,
-                                         restarts=len(recent))
+                                         restarts=len(recent),
+                                         quarantines=r.quarantines)
                     return
                 r.backoff_s = min(self._backoff_max,
                                   max(self._backoff_base,
                                       r.backoff_s * 2.0))
                 r.restart_at = now + r.backoff_s
+                return
+            if r.state == FAILED:
+                # quarantine is a COOLDOWN, not a verdict: after a
+                # backed-off wait the slot gets another chance — a
+                # transient cause (bad node, upstream outage) should not
+                # cost the fleet a slot forever. Repeat offenders wait
+                # exponentially longer.
+                if self._quarantine_cooldown > 0:
+                    wait = self._quarantine_cooldown * min(
+                        self._quarantine_backoff_max,
+                        2.0 ** max(0, r.quarantines - 1))
+                    if now - r.failed_at >= wait:
+                        r.restart_times.clear()
+                        r.backoff_s = 0.0
+                        smetrics.ROUTER_RESTARTS.labels(
+                            cause="quarantine_retry").inc()
+                        flight_recorder.note("replica_quarantine_retry",
+                                             replica=r.index,
+                                             quarantines=r.quarantines)
+                        self._spawn(r)
                 return
             if r.state == DOWN:
                 if now >= r.restart_at:
@@ -329,6 +494,10 @@ class Router:
                         flight_recorder.note("replica_ready",
                                              replica=r.index,
                                              endpoint=r.endpoint)
+                return
+            if r.state in (READY, DRAINING):
+                self._healthy_check(r, now)
+                self._poll_replica_stats(r, now)
         else:
             resp = self._probe(r)
             if resp is None:
@@ -339,6 +508,54 @@ class Router:
                 r.set_state(READY)
             elif resp.get("draining") and r.state == READY:
                 r.set_state(DRAINING)
+            if r.state in (READY, DRAINING):
+                self._poll_replica_stats(r, now)
+
+    def _healthy_check(self, r: _Replica, now: float):
+        """A sustained healthy period wipes the restart ledger: old
+        crashes stop counting toward the next crash-loop verdict and
+        the quarantine backoff resets."""
+        if self._healthy_reset <= 0 or r.state != READY \
+                or not r.ready_since:
+            return
+        if now - r.ready_since < self._healthy_reset:
+            return
+        if r.restart_times or r.quarantines or r.backoff_s:
+            r.restart_times.clear()
+            r.backoff_s = 0.0
+            r.quarantines = 0
+            flight_recorder.note("replica_healthy_reset",
+                                 replica=r.index)
+
+    def _poll_replica_stats(self, r: _Replica, now: float):
+        """Throttled ``stats`` RPC on a short-lived connection: the
+        per-replica queue-depth/inflight gauges the autoscaler (and a
+        scrape) reads — metrics snapshots, never object internals."""
+        if self._stats_poll <= 0 or now - r._stats_at < self._stats_poll:
+            return
+        r._stats_at = now
+        if not r.endpoint:
+            return
+        try:
+            host, port = r.endpoint.rsplit(":", 1)
+            with socket_module.create_connection(
+                    (host, int(port)), timeout=1.0) as s:
+                s.sendall(b'{"method": "stats"}\n')
+                line = s.makefile("rb").readline()
+            resp = json.loads(line) if line else None
+        except (ConnectionError, OSError, json.JSONDecodeError,
+                ValueError):
+            return
+        if not (resp and resp.get("ok")):
+            return
+        depth = sum(int(m.get("queue_depth", 0))
+                    for m in (resp.get("stats") or {}).values())
+        r.queue_depth = depth
+        lbl = str(r.index)
+        smetrics.ROUTER_REPLICA_QUEUE_DEPTH.labels(
+            replica=lbl).set(float(depth))
+        smetrics.ROUTER_REPLICA_INFLIGHT.labels(
+            replica=lbl).set(float(r.inflight))
 
     def wait_ready(self, min_ready: Optional[int] = None,
                    timeout_s: Optional[float] = None) -> bool:
@@ -347,7 +564,7 @@ class Router:
         deadline = time.monotonic() + (
             self._ready_timeout if timeout_s is None else timeout_s)
         while time.monotonic() < deadline:
-            states = [r.state for r in self._replicas]
+            states = [r.state for r in list(self._replicas)]
             need = (len([s for s in states if s != FAILED])
                     if min_ready is None else min_ready)
             if need > 0 and \
@@ -394,19 +611,20 @@ class Router:
         assignment."""
         idx = self._sticky_get(req_id)
         if idx is not None and idx not in exclude:
-            r = self._replicas[idx]
-            if r.state in (READY, DRAINING):
+            r = self._by_index.get(idx)
+            if r is not None and r.state in (READY, DRAINING):
                 return r
             smetrics.ROUTER_FAILOVERS.labels(cause="dead_sticky").inc()
             flight_recorder.note("failover", request_id=req_id,
                                  cause="dead_sticky", replica=idx)
-        candidates = [r for r in self._replicas
+        pool = list(self._replicas)
+        candidates = [r for r in pool
                       if r.state == READY and r.index not in exclude
                       and r.breaker.allow()]
         if not candidates:
             # half-open probes excluded above; allow a breaker-gated
             # READY replica as last resort so the probe can happen
-            candidates = [r for r in self._replicas
+            candidates = [r for r in pool
                           if r.state == READY
                           and r.index not in exclude]
         if not candidates:
@@ -486,16 +704,22 @@ class Router:
                 del self._sticky[req_id]
 
     # -- drain / rolling restart -----------------------------------------
-    def restart_replica(self, index: int, cause: str = "rolling") -> dict:
+    def restart_replica(self, index: int, cause: str = "rolling",
+                        spec: Optional[dict] = None) -> dict:
         """Drain + replace ONE replica: refuse unless another replica is
         READY (zero-downtime invariant), drain RPC (SIGTERM fallback),
         wait for a clean exit (SIGKILL after the grace window), respawn,
-        wait for readyz. Returns a summary dict."""
+        wait for readyz. ``spec`` swaps the slot's config on the way
+        back up (the autoscaler's proactive-replace path). Returns a
+        summary dict."""
         if not self._supervised:
             return {"ok": False, "kind": "bad_request",
                     "error": "attached mode: the router does not own "
                              "these processes"}
-        r = self._replicas[index]
+        r = self._by_index.get(int(index))
+        if r is None:
+            return {"ok": False, "kind": "bad_request",
+                    "error": f"no replica {index} in the pool"}
         with self._restart_lock:
             others_ready = any(o.state == READY for o in self._replicas
                                if o.index != index)
@@ -533,6 +757,9 @@ class Router:
                 r.gen += 1
             r.restart_times.clear()        # an ORDERED restart is not
             r.backoff_s = 0.0              # crash-loop evidence
+            if spec is not None:
+                r.spec = spec
+                r.oom_replaced = False     # fresh config, fresh budget
             smetrics.ROUTER_RESTARTS.labels(cause=cause).inc()
             flight_recorder.note("replica_restart", replica=index,
                                  cause=cause, drained=drained)
@@ -567,23 +794,149 @@ class Router:
                                  f"{r.index}"}
         return {"ok": True, "results": results}
 
+    # -- elastic pool (serving/autoscaler.py drives these) ---------------
+    def set_oom_fallback(self, spec):
+        """Register the smaller-footprint spec (or ``callable(old_spec)
+        -> new_spec``) a memdump-witnessed OOM death is replaced with."""
+        self._oom_fallback = spec
+
+    def scale_up(self, count: int = 1, spec: Optional[dict] = None,
+                 endpoints: Optional[List[str]] = None) -> dict:
+        """Grow the pool. Supervised: spawn ``count`` fresh replicas
+        (``spec`` overrides the slot template). Attached: adopt the
+        given ``endpoints``. Slot indexes are monotonic — never reused
+        — so sticky entries and per-replica metric labels stay
+        unambiguous across scale events."""
+        added = []
+        with self._pool_lock:
+            if self._supervised:
+                for _ in range(max(1, int(count))):
+                    r = _Replica(
+                        self._next_index,
+                        breaker_threshold=self._breaker_threshold,
+                        breaker_reset_s=self._breaker_reset,
+                        spec=spec if spec is not None else self._spec)
+                    self._next_index += 1
+                    self._by_index[r.index] = r
+                    self._replicas.append(r)
+                    if self._running:
+                        self._spawn(r)
+                    added.append(r.index)
+            else:
+                if not endpoints:
+                    return {"ok": False, "kind": "bad_request",
+                            "error": "attached mode: scale_up needs "
+                                     "endpoints=[...] to adopt"}
+                for ep in endpoints:
+                    r = _Replica(
+                        self._next_index, endpoint=ep,
+                        breaker_threshold=self._breaker_threshold,
+                        breaker_reset_s=self._breaker_reset)
+                    self._next_index += 1
+                    self._by_index[r.index] = r
+                    self._replicas.append(r)
+                    added.append(r.index)
+        flight_recorder.note("fleet_scale_up", replicas=added,
+                             size=len(self._replicas))
+        return {"ok": True, "added": added,
+                "size": len(self._replicas)}
+
+    def scale_down(self, index: Optional[int] = None) -> dict:
+        """Shrink the pool by ONE replica via graceful drain — the
+        rolling-restart-proven path. Victim: ``index``, else the
+        highest-index READY replica (LIFO, so the static floor keeps
+        its original slots). Refuses to remove the last READY replica.
+        Sticky entries pointing at the victim are cleared AFTER the
+        drain settles, so admitted request_ids keep deduping on it
+        until the end. Works in attached mode too (the external server
+        is drained but not exited — decommission, not kill)."""
+        with self._restart_lock:
+            with self._pool_lock:
+                if index is None:
+                    ready = [r for r in self._replicas
+                             if r.state == READY]
+                    victim = (max(ready, key=lambda r: r.index)
+                              if ready else None)
+                    if victim is None:
+                        return {"ok": False, "kind": "unavailable",
+                                "error": "no ready replica to remove"}
+                else:
+                    victim = self._by_index.get(int(index))
+                    if victim is None:
+                        return {"ok": False, "kind": "bad_request",
+                                "error": f"no replica {index} in "
+                                         f"the pool"}
+                others_ready = any(
+                    o.state == READY for o in self._replicas
+                    if o.index != victim.index)
+                if not others_ready:
+                    return {"ok": False, "kind": "unavailable",
+                            "error": f"refusing to remove replica "
+                                     f"{victim.index}: no other "
+                                     f"replica is ready"}
+                victim.retiring = True     # the monitor hands it over
+            t0 = time.monotonic()
+            victim.set_state(DRAINING)
+            drained = False
+            duration = 0.0
+            try:
+                resp = victim.exchange(
+                    {"method": "drain",
+                     "timeout_s": self._drain_timeout,
+                     "exit": self._supervised},
+                    timeout=self._drain_timeout + 5.0)
+                drained = bool(resp.get("drained"))
+                duration = float(resp.get("duration_s", 0.0))
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                if self._supervised and victim.proc is not None \
+                        and victim.proc.poll() is None:
+                    victim.proc.terminate()
+            smetrics.ROUTER_DRAIN_DURATION.observe(
+                duration if duration > 0 else time.monotonic() - t0)
+            if self._supervised and victim.proc is not None:
+                try:
+                    victim.proc.wait(timeout=self._grace)
+                except subprocess.TimeoutExpired:
+                    victim.proc.kill()
+                    try:
+                        victim.proc.wait(timeout=self._grace)
+                    except subprocess.TimeoutExpired:
+                        pass
+            self._sticky_clear_replica(victim.index)
+            victim.close_cached()
+            with self._pool_lock:
+                self._replicas = [r for r in self._replicas
+                                  if r.index != victim.index]
+                self._by_index.pop(victim.index, None)
+            victim.retire_gauges()
+            flight_recorder.note("fleet_scale_down",
+                                 replica=victim.index, drained=drained,
+                                 size=len(self._replicas))
+            return {"ok": True, "removed": victim.index,
+                    "drained": drained, "drain_duration_s": duration,
+                    "size": len(self._replicas)}
+
     # -- introspection ---------------------------------------------------
     def stats(self) -> dict:
+        pool = list(self._replicas)
         reps = []
-        for r in self._replicas:
+        for r in pool:
             reps.append({
                 "index": r.index, "state": r.state,
                 "endpoint": r.endpoint, "inflight": r.inflight,
+                "queue_depth": r.queue_depth,
                 "breaker": r.breaker.state,
                 "pid": (r.proc.pid if r.proc is not None
                         and r.proc.poll() is None else None),
-                "restarts": len(r.restart_times)})
+                "restarts": len(r.restart_times),
+                "quarantines": r.quarantines,
+                "last_exit": r.last_exit})
         with self._sticky_lock:
             sticky = len(self._sticky)
         return {"supervised": self._supervised, "replicas": reps,
                 "sticky_entries": sticky,
-                "ready": sum(1 for r in self._replicas
-                             if r.state == READY)}
+                "size": len(pool),
+                "ready": sum(1 for r in pool if r.state == READY)}
 
     @property
     def ready(self) -> bool:
@@ -622,11 +975,11 @@ class Router:
             self._monitor_thread.join(timeout=5)
             self._monitor_thread = None
         if self._supervised and terminate_replicas:
-            for r in self._replicas:
+            for r in list(self._replicas):
                 if r.proc is not None and r.proc.poll() is None:
                     r.proc.terminate()
             deadline = time.monotonic() + self._grace
-            for r in self._replicas:
+            for r in list(self._replicas):
                 if r.proc is None:
                     continue
                 remaining = max(0.1, deadline - time.monotonic())
@@ -683,13 +1036,27 @@ class _RouterRpcHandler(socketserver.StreamRequestHandler):
         if method == "readyz":
             return {"ok": True, "ready": router.ready,
                     "role": "router", "pid": os.getpid(),
-                    "replicas": [r.state for r in router._replicas]}
+                    "replicas": [r.state
+                                 for r in list(router._replicas)]}
         if method == "router_stats":
             return {"ok": True, "stats": router.stats()}
         if method == "router_restart":
             return router.restart_replica(int(req["replica"]))
         if method == "router_rolling_restart":
             return router.rolling_restart()
+        if method == "router_scale_up":
+            return router.scale_up(count=int(req.get("count", 1)),
+                                   spec=req.get("spec"),
+                                   endpoints=req.get("endpoints"))
+        if method == "router_scale_down":
+            idx = req.get("replica")
+            return router.scale_down(
+                index=None if idx is None else int(idx))
+        if method == "router_replace":
+            return router.restart_replica(
+                int(req["replica"]),
+                cause=str(req.get("cause", "replace")),
+                spec=req.get("spec"))
         return router.route(req)
 
 

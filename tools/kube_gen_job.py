@@ -105,7 +105,33 @@ def gen_job(jobname: str, image: str, trainers: int, entry: str,
     }
 
 
+def gen_serving_fleet(args) -> List[dict]:
+    """Serving-fleet mode (``--serving``): render a replica fleet the
+    way ``serving.autoscaler`` renders its desired state — a headless
+    Service + an Indexed Job of ``--replicas`` pods each running
+    ``python -m paddle_tpu.serving.replica`` with ``--spec`` /
+    ``--spec-json``. One renderer: the in-process reconciler and this
+    CLI emit the SAME specs, so an operator can freeze an autoscaled
+    fleet into yaml at its current size."""
+    import json as _json
+    from paddle_tpu.serving.autoscaler import render_kube
+    if args.spec_json:
+        spec = _json.loads(args.spec_json)
+    elif args.spec:
+        with open(args.spec) as f:
+            spec = _json.load(f)
+    else:
+        raise SystemExit("kube_gen_job: --serving needs --spec or "
+                         "--spec-json (the replica spec)")
+    return render_kube(
+        {"replicas": args.replicas, "spec": spec},
+        jobname=args.jobname, image=args.image, port=args.port,
+        cpu=args.cpu, memory_gi=args.memory, tpu=args.tpu)
+
+
 def gen_all(args) -> List[dict]:
+    if getattr(args, "serving", False):
+        return gen_serving_fleet(args)
     for kv in (args.env or []):
         if "=" not in kv:
             raise SystemExit(
@@ -137,6 +163,15 @@ def parse_args(argv=None):
                    help="gke-tpu-topology node selector, e.g. 2x4")
     p.add_argument("--env", action="append", metavar="K=V",
                    help="extra container env (repeatable)")
+    p.add_argument("--serving", action="store_true",
+                   help="render a SERVING fleet (replica pods) instead "
+                        "of a training job")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="serving mode: replica pod count")
+    p.add_argument("--spec", default=None,
+                   help="serving mode: replica spec JSON file")
+    p.add_argument("--spec-json", default=None,
+                   help="serving mode: the spec inline")
     return p.parse_args(argv)
 
 

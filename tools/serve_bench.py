@@ -36,9 +36,18 @@ Three phases, two JSON rows:
    respawned replica's readyz rejoin time, and the client error count
    (expected ZERO — the router re-dispatches to the survivor).
 
+5. **Autoscaled fleet** (the ISSUE 16 robustness arm,
+   ``SERVE_r04.json``, opt-in via ``--autoscale``): the same
+   low -> spike -> low offered-load schedule replayed against static-2,
+   static-4, and an SLO-driven autoscaled fleet; each arm records
+   per-phase SLO attainment, queue-wait p99, and the fleet-size trace —
+   the autoscaled arm's trace must show the breach-driven scale-up AND
+   the drain-based scale-down in one run.
+
     python tools/serve_bench.py                  # defaults (T=64)
     python tools/serve_bench.py --prompt-len 64 --max-new 64 --out SERVE_r01.json
     python tools/serve_bench.py --skip-decode --skip-gen --replicas 2
+    python tools/serve_bench.py --skip-decode --skip-gen --autoscale
 """
 
 from __future__ import annotations
@@ -407,6 +416,170 @@ def bench_router(args) -> dict:
     }
 
 
+def bench_autoscaled(args) -> dict:
+    """ISSUE 16 (``SERVE_r04.json``, opt-in via ``--autoscale``): SLO
+    attainment vs offered load through three fleet arms — static-2,
+    static-4, and the autoscaled fleet — over the SAME low -> spike ->
+    low schedule of closed-loop generate clients. Every arm runs the
+    same control loop (the static arms with ``min == max``, so it can
+    only observe); the autoscaled arm's fleet-size trace must show the
+    breach-driven scale-up AND the drain-based scale-down in one run."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.autoscaler import (Autoscaler,
+                                               AutoscalePolicy)
+    from paddle_tpu.serving.router import Router
+    from paddle_tpu.serving.server import RequestShedError
+
+    # the tiny wave-path decoder LM: service time is tens of ms on CPU,
+    # so a handful of closed-loop clients genuinely saturates a replica
+    # (the clf model serves too fast to ever breach a queue-wait SLO)
+    lm = {"model": {"kind": "decoder_lm", "name": "lm", "slots": False,
+                    "buckets": [1, 2],
+                    "params": {"prompt_len": 8, "max_new": 8,
+                               "vocab": 32, "d_model": 16, "d_inner": 32,
+                               "n_head": 2, "n_layer": 2}},
+          "max_queue_depth": 512}
+    slo = args.autoscale_slo
+    low_s = args.autoscale_phase_s / 2.0
+    phases = [("low", 1, low_s),
+              ("spike", args.autoscale_clients, args.autoscale_phase_s),
+              ("low", 1, low_s)]
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)), 4) if vals else None
+
+    def run_arm(name: str, replicas: int, max_replicas: int) -> dict:
+        router = Router(spec=lm, replicas=replicas, breaker_reset_s=0.5)
+        t0 = time.perf_counter()
+        router.start()
+        router.wait_ready(timeout_s=600)
+        ready_s = time.perf_counter() - t0
+        endpoint = router.serve()
+        policy = AutoscalePolicy(
+            slo_queue_wait_p99_s=slo, min_replicas=replicas,
+            max_replicas=max_replicas, breach_window_s=0.5,
+            clear_window_s=1.5, cooldown_s=3.0, window_s=4.0,
+            poll_interval_s=0.25, scale_spec=lm)
+        asc = Autoscaler(router=router, policy=policy)
+        recs: list = []
+        stop_ctl = threading.Event()
+
+        def control():                 # step by hand: keep every obs
+            while not stop_ctl.is_set():
+                rec = asc.step()
+                rec["wall"] = time.perf_counter()
+                recs.append(rec)
+                time.sleep(policy.poll_interval_s)
+
+        ctl = threading.Thread(target=control, daemon=True)
+        ctl.start()
+
+        phase_rows = []
+        for pname, clients, dur in phases:
+            stop = threading.Event()
+            lats: list = []
+            sheds = [0]
+            errors: list = []
+            lock = threading.Lock()
+
+            def client_loop(seed: int):
+                cl = serving.ServingClient(endpoint)
+                r = np.random.RandomState(seed)
+                try:
+                    while not stop.is_set():
+                        prompt = tuple(
+                            int(x) for x in r.randint(1, 32, (3,)))
+                        ta = time.perf_counter()
+                        try:
+                            cl.generate("lm", [prompt], max_new=4)
+                        except RequestShedError:
+                            with lock:
+                                sheds[0] += 1
+                            continue
+                        with lock:
+                            lats.append(time.perf_counter() - ta)
+                except Exception as e:  # pragma: no cover - bench only
+                    errors.append(repr(e))
+                finally:
+                    cl.close()
+
+            t_start = time.perf_counter()
+            threads = [threading.Thread(target=client_loop,
+                                        args=(300 + i,), daemon=True)
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            time.sleep(dur)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            t_end = time.perf_counter()
+            win = [r for r in recs if t_start <= r["wall"] <= t_end]
+            phase_rows.append({
+                "phase": pname, "offered_clients": clients,
+                "duration_s": round(t_end - t_start, 2),
+                "requests_ok": len(lats),
+                "requests_per_s": round(len(lats) / (t_end - t_start),
+                                        2),
+                "shed": sheds[0], "errors": errors[:3],
+                "client_p99_s": pct(lats, 99),
+                "queue_wait_p99_s_max": (
+                    round(max(r["p99"] for r in win), 4) if win
+                    else None),
+                "slo_attainment_min": (
+                    round(min(r["attainment"] for r in win), 4) if win
+                    else None),
+                "fleet_sizes": sorted({r["size"] for r in win}),
+            })
+
+        # after the schedule: give the loop time to drain back down
+        deadline = time.monotonic() + 30.0
+        while max_replicas > replicas \
+                and router.stats()["size"] > replicas \
+                and time.monotonic() < deadline:
+            time.sleep(0.25)
+        stop_ctl.set()
+        ctl.join(timeout=5)
+        decisions = list(asc.decisions)
+        wall0 = recs[0]["wall"] if recs else 0.0
+        trace = []                     # fleet-size series, change points
+        for r in recs:
+            if not trace or trace[-1]["size"] != r["size"] \
+                    or trace[-1]["ready"] != r["ready"]:
+                trace.append({"t": round(r["wall"] - wall0, 2),
+                              "size": r["size"], "ready": r["ready"]})
+        router.stop()
+        return {
+            "arm": name, "replicas": replicas,
+            "max_replicas": max_replicas,
+            "pool_ready_s": round(ready_s, 3),
+            "phases": phase_rows,
+            "fleet_trace": trace,
+            "scaled_up": any(d["action"] == "scale_up"
+                             for d in decisions),
+            "scaled_down_drained": any(
+                d["action"] == "scale_down" and d.get("drained")
+                for d in decisions),
+            "decisions": [{k: (round(v, 4)
+                               if isinstance(v, float) else v)
+                           for k, v in d.items()} for d in decisions],
+        }
+
+    arms = [run_arm("static-2", 2, 2),
+            run_arm("static-4", 4, 4),
+            run_arm("autoscaled", 2, args.autoscale_max)]
+    spike = {a["arm"]: next(p for p in a["phases"]
+                            if p["phase"] == "spike") for a in arms}
+    return {
+        "slo_queue_wait_p99_s": slo,
+        "offered_clients": {"low": 1, "spike": args.autoscale_clients},
+        "phase_s": args.autoscale_phase_s,
+        "arms": arms,
+        "spike_attainment": {
+            name: p["slo_attainment_min"] for name, p in spike.items()},
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--prompt-len", type=int, default=64)
@@ -436,6 +609,18 @@ def main(argv=None):
     ap.add_argument("--router-steady-s", type=float, default=5.0,
                     help="seconds of steady load before (and after) "
                          "the mid-load replica SIGKILL")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the autoscaled-fleet arm: static-2 vs "
+                         "static-4 vs autoscaled over the same "
+                         "low/spike/low load schedule (ISSUE 16)")
+    ap.add_argument("--autoscale-clients", type=int, default=8,
+                    help="closed-loop clients during the spike phase")
+    ap.add_argument("--autoscale-phase-s", type=float, default=15.0,
+                    help="spike-phase seconds (low phases run half)")
+    ap.add_argument("--autoscale-slo", type=float, default=0.02,
+                    help="queue-wait p99 SLO (seconds)")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="autoscaled arm's max_replicas")
     ap.add_argument("--skip-load", action="store_true")
     ap.add_argument("--skip-gen", action="store_true")
     ap.add_argument("--skip-decode", action="store_true",
@@ -444,6 +629,7 @@ def main(argv=None):
     ap.add_argument("--out", default="SERVE_r01.json")
     ap.add_argument("--gen-out", default="SERVE_r02.json")
     ap.add_argument("--router-out", default="SERVE_r03.json")
+    ap.add_argument("--autoscale-out", default="SERVE_r04.json")
     args = ap.parse_args(argv)
 
     def _resolve(path):
@@ -493,6 +679,23 @@ def main(argv=None):
               f"{r['failover_blip_p99_s']}s, rejoin "
               f"{r['replica_rejoin_s']}s, "
               f"{r['requests_failed']} client error(s)")
+
+    if args.autoscale:
+        arow = {"bench": "serving_autoscaler",
+                "device": os.environ.get("JAX_PLATFORMS", "auto"),
+                "autoscaler": bench_autoscaled(args)}
+        with open(_resolve(args.autoscale_out), "w") as f:
+            json.dump(arow, f, indent=2)
+            f.write("\n")
+        print(json.dumps(arow, indent=2))
+        a = arow["autoscaler"]
+        scaled = next(x for x in a["arms"] if x["arm"] == "autoscaled")
+        print(f"serve_bench: autoscaled arm — spike attainment "
+              f"{a['spike_attainment']} at SLO "
+              f"{a['slo_queue_wait_p99_s']}s; scale-up="
+              f"{scaled['scaled_up']}, drained scale-down="
+              f"{scaled['scaled_down_drained']}, fleet trace "
+              f"{[t['size'] for t in scaled['fleet_trace']]}")
     return 0
 
 

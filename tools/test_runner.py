@@ -172,6 +172,29 @@ def run_lint_gate(root: str, timeout: int) -> int:
                  os.path.join(root, "tools", "trace_collect.py"),
                  d, "--check", "--chain", "client,router,replica"],
                 cwd=root, timeout=timeout, env=env)
+            if r.returncode:
+                return r.returncode
+        # autoscaler smoke: a supervised router + 1 replica, a
+        # SYNTHETIC SLO breach driving one real reconcile cycle —
+        # scale up to 2 (spawn + readyz), clear, drain back down to 1
+        # — with traced client calls before and after, so the merged
+        # spool must still stitch the full span chain (ISSUE 16)
+        print("test_runner: lint gate — autoscaler smoke + "
+              "trace_collect --check --chain client,router,replica")
+        with tempfile.TemporaryDirectory(prefix="autoscaler_smoke_") as d:
+            smoke_env = dict(env)
+            smoke_env.pop("FLAGS_trace_role", None)
+            smoke_env["FLAGS_trace_spool_dir"] = d
+            r = subprocess.run(
+                [sys.executable, "-c", _AUTOSCALER_SMOKE, d],
+                cwd=root, timeout=timeout, env=smoke_env)
+            if r.returncode:
+                return r.returncode
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "trace_collect.py"),
+                 d, "--check", "--chain", "client,router,replica"],
+                cwd=root, timeout=timeout, env=env)
         return r.returncode
     except subprocess.TimeoutExpired:
         sys.exit(f"test_runner: lint gate exceeded {timeout}s")
@@ -275,6 +298,136 @@ finally:
         proc.kill()
 spool.shutdown()
 print("router duo smoke ok")
+"""
+
+
+# the autoscaler smoke: the router runs as a subprocess (its own spool
+# role) supervising ONE replica; this process is the client AND hosts
+# the control loop, driving the router's scale RPCs against a synthetic
+# SLO breach — a real scale-up (spawn + readyz) and a real drain-based
+# scale-down in one run, with traced generate calls at sizes 1, 2, 1.
+_AUTOSCALER_SMOKE = """
+import json, os, socket, subprocess, sys, time
+d = sys.argv[1]
+from paddle_tpu import flags
+flags.set("trace_role", "client")
+from paddle_tpu.observability import spool
+from paddle_tpu.observability import trace_context as tctx
+from paddle_tpu.serving.autoscaler import Autoscaler, AutoscalePolicy
+
+SPEC = {"model": {"kind": "decoder_lm", "name": "lm", "params": {
+    "prompt_len": 8, "max_new": 8, "vocab": 32, "d_model": 16,
+    "d_inner": 32, "n_head": 2, "n_layer": 2}}}
+
+def call(endpoint, req, timeout=60.0):
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall((json.dumps(req) + "\\n").encode())
+        line = s.makefile("rb").readline()
+    assert line, "router closed the connection"
+    return json.loads(line)
+
+class RpcRouter:
+    # the reconciler's actuator arm over the router's admin RPCs —
+    # the smoke proves the loop closes ACROSS the process boundary
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+    def scale_up(self, count=1, spec=None, endpoints=None):
+        req = {"method": "router_scale_up", "count": count}
+        if spec is not None:
+            req["spec"] = spec
+        return call(self.endpoint, req, 120.0)
+    def scale_down(self, index=None):
+        req = {"method": "router_scale_down"}
+        if index is not None:
+            req["replica"] = index
+        return call(self.endpoint, req, 120.0)
+    def stats(self):
+        return call(self.endpoint, {"method": "router_stats"})["stats"]
+
+class SyntheticSource:
+    # fleet shape is REAL (router_stats); the SLO signal is scripted
+    def __init__(self, router):
+        self.router = router
+        self.p99 = 0.0
+    def poll(self, now=None, slo_s=0.0):
+        st = self.router.stats()
+        return {"fleet": st, "size": st["size"], "ready": st["ready"],
+                "queue_depth": 0, "p99": self.p99,
+                "attainment": 1.0 if self.p99 <= slo_s else 0.0}
+
+ef = os.path.join(d, "router.endpoint")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "paddle_tpu.serving.router",
+     "--spec-json", json.dumps(SPEC), "--replicas", "1",
+     "--endpoint-file", ef],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+try:
+    deadline = time.monotonic() + 300
+    while not os.path.exists(ef):
+        assert time.monotonic() < deadline, "router endpoint never appeared"
+        assert proc.poll() is None, "router died during startup"
+        time.sleep(0.1)
+    endpoint = open(ef).read().strip()
+    def ready_count():
+        try:
+            rz = call(endpoint, {"method": "readyz"}, 5.0)
+        except (ConnectionError, OSError):
+            return -1
+        return rz["replicas"].count("ready") if rz.get("ready") else 0
+    while ready_count() < 1:
+        assert time.monotonic() < deadline, "replica never ready"
+        time.sleep(0.2)
+
+    def gen(req_id):
+        req = {"method": "generate", "model": "lm", "req_id": req_id,
+               "prompts": [[1, 2, 3]], "max_new": 4,
+               "temperature": 0.0, "top_k": 0}
+        with tctx.client_span("serving.generate"):
+            tctx.inject(req)
+            return call(endpoint, req)
+
+    r1 = gen("asc-smoke-1")
+    assert r1.get("ok"), r1
+
+    router = RpcRouter(endpoint)
+    src = SyntheticSource(router)
+    asc = Autoscaler(router=router, policy=AutoscalePolicy(
+        slo_queue_wait_p99_s=0.05, min_replicas=1, max_replicas=2,
+        breach_window_s=0.2, clear_window_s=0.2, cooldown_s=0.3,
+        window_s=5.0, scale_spec=SPEC), source=src)
+
+    src.p99 = 1.0                       # synthetic sustained breach
+    t = 0.0
+    while router.stats()["size"] < 2:
+        assert t < 10.0, "breach never produced a scale-up"
+        asc.step(now=t)
+        t += 0.25
+    while ready_count() < 2:
+        assert time.monotonic() < deadline, "scale-up replica not ready"
+        time.sleep(0.2)
+    r2 = gen("asc-smoke-2")
+    assert r2.get("ok"), r2
+
+    src.p99 = 0.0                       # clear: drain back down
+    while router.stats()["size"] > 1:
+        assert t < 20.0, "clear never produced a scale-down"
+        asc.step(now=t)
+        t += 0.25
+    down = [x for x in asc.decisions if x["action"] == "scale_down"]
+    assert down and down[0].get("drained") is True, asc.decisions
+    assert ready_count() == 1
+    r3 = gen("asc-smoke-3")
+    assert r3.get("ok"), r3
+    assert r3["tokens"] == r1["tokens"], (r1, r3)   # greedy: same stream
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+spool.shutdown()
+print("autoscaler smoke ok")
 """
 
 
