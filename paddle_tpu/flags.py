@@ -207,6 +207,21 @@ define("embed_exchange_codec", str, "none",
        "exact-dense control arm), 'bf16' truncates to 2 bytes/elem, "
        "'int8' ships int8 codes + one fp32 scale per row "
        "(EQuARX-style). Applies to pull_rows AND push_rows payloads.")
+define("kv_cache_layout", str, "contiguous",
+       "Decode KV-cache layout for the slot-pool serving engine "
+       "(serving/engine.py): 'contiguous' reserves one worst-case "
+       "[n_slots, S, H, D] region per layer; 'paged' breaks the cache "
+       "into fixed-size pages behind a per-slot page table "
+       "(serving/kv_pool.py) with prompt-prefix sharing — admission is "
+       "by free-PAGE count, so short requests stop paying the "
+       "worst-case reservation (docs/serving.md 'Paged KV cache').")
+define("kv_cache_codec", str, "none",
+       "Storage codec for the PAGED KV pool (kv_cache_layout=paged): "
+       "'none' stores fp32 (bit-exact vs the contiguous pool), 'bf16' "
+       "truncates to 2 bytes/elem, 'int8' stores int8 codes + one fp32 "
+       "scale per (position, head) row — the per-row-scale discipline "
+       "of FLAGS_embed_exchange_codec applied at rest. Quantize on "
+       "page write, dequantize in the attention gather.")
 
 
 def _main():

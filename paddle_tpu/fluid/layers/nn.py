@@ -732,6 +732,70 @@ def kv_attention_decode(x, pos, seq_len, gen_start, active, d_model,
     return out
 
 
+def kv_attention_prefill_paged(x, rows, d_model, n_head, page_k, page_v,
+                               page_ks=None, page_vs=None, codec="none",
+                               param_attr=None, name=None):
+    """Paged-pool prefill (ISSUE 17): causal self-attention over the
+    prompt whose K/V rows scatter into the PAGED pool caches
+    (``page_k``/``page_v``, persistable [n_pages, page_size, H, D] vars
+    read and written under the same names — donated state) at the
+    per-position flat row indices ``rows`` [B*T, 1] from the slot's
+    page-table lease. Sentinel rows (>= n_pages*page_size) DROP — how
+    prefix-SHARED pages are skipped (already resident, bit-identical:
+    K/V at position j depends only on token j) and how copy-on-write
+    stays a recompute, never a device copy. ``codec='int8'`` quantizes
+    on write into ``page_ks``/``page_vs`` scale planes
+    (ops/kv_attention.py; docs/serving.md 'Paged KV cache')."""
+    helper = LayerHelper("kv_attention_prefill_paged", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+              "Wv": [ws[2]], "Wo": [ws[3]],
+              "PageK": [page_k], "PageV": [page_v], "Rows": [rows]}
+    outputs = {"Out": [out], "PageKOut": [page_k],
+               "PageVOut": [page_v]}
+    if codec == "int8":
+        inputs["PageKS"], inputs["PageVS"] = [page_ks], [page_vs]
+        outputs["PageKSOut"], outputs["PageVSOut"] = [page_ks], [page_vs]
+    helper.append_op("kv_attention_prefill_paged",
+                     inputs=inputs, outputs=outputs,
+                     attrs={"n_head": int(n_head), "codec": str(codec)})
+    return out
+
+
+def kv_attention_decode_paged(x, page_table, pos, seq_len, gen_start,
+                              active, d_model, n_head, page_k, page_v,
+                              page_ks=None, page_vs=None, codec="none",
+                              param_attr=None, name=None):
+    """One-token decode over the PAGED KV pool: per-row geometry
+    identical to ``kv_attention_decode``, but the cache row for logical
+    position j of slot b resolves through the page-table feed
+    (``page_table`` [B, max_pages] int — a STATIC-shape feed, so every
+    join/leave/page mix dispatches the same executable, zero
+    steady-state compiles). The gather runs the scalar-prefetch Pallas
+    kernel on TPU (ops/pallas/paged_attention.py) and dequantizes
+    in-gather under ``codec='int8'``. x [B, 1, M] -> [B, 1, M]
+    (ops/kv_attention.py; docs/serving.md 'Paged KV cache')."""
+    helper = LayerHelper("kv_attention_decode_paged", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+              "Wv": [ws[2]], "Wo": [ws[3]],
+              "PageK": [page_k], "PageV": [page_v],
+              "PageTable": [page_table], "Pos": [pos],
+              "SeqLen": [seq_len], "GenStart": [gen_start],
+              "Active": [active]}
+    outputs = {"Out": [out], "PageKOut": [page_k],
+               "PageVOut": [page_v]}
+    if codec == "int8":
+        inputs["PageKS"], inputs["PageVS"] = [page_ks], [page_vs]
+        outputs["PageKSOut"], outputs["PageVSOut"] = [page_ks], [page_vs]
+    helper.append_op("kv_attention_decode_paged",
+                     inputs=inputs, outputs=outputs,
+                     attrs={"n_head": int(n_head), "codec": str(codec)})
+    return out
+
+
 def token_sample(logits, temperature, top_k, seed, step_idx, name=None):
     """On-device next-token selection (ops/kv_attention.py): greedy
     argmax when ``temperature <= 0`` or ``top_k == 1`` (bit-identical to

@@ -204,6 +204,15 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
 #                    masked) that samples each row's next token
 #                    on-device. This is the executable the in-flight
 #                    scheduler re-dispatches forever (ISSUE 9).
+#   "prefill_paged" / "decode_paged" — the slot pair over a PAGED pool
+#                    (ISSUE 17): [n_pages, page_size, H, D] page pools
+#                    replace the worst-case [n_slots, S, H, D] region;
+#                    prefill writes through per-position flat row
+#                    indices (sentinel = shared-prefix skip), decode
+#                    resolves reads/writes through a [n_slots,
+#                    max_pages] page-table feed. Same numerics — fp32
+#                    paged greedy output is bit-identical to the slot
+#                    views; FLAGS_kv_cache_codec stores bf16/int8.
 # Every parameter is explicitly named (LayerHelper's auto names are
 # globally unique, so cross-program sharing REQUIRES explicit names).
 # ---------------------------------------------------------------------------
@@ -211,23 +220,53 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
 def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                vocab: int = 64, d_model: int = 32, d_inner: int = 64,
                n_head: int = 2, n_layer: int = 2, name: str = "lm",
-               cache_len=None, n_slots=None):
+               cache_len=None, n_slots=None, page_size=None,
+               n_pages=None, kv_codec=None):
     """Emit the `mode` view ("full" | "prefill" | "decode" |
-    "prefill_slot" | "decode_slot") of the decoder-only LM into the
-    current default programs. ``cache_len`` decouples the cache size
-    from this view's prompt bucket (ladder prefills at P < P_max still
-    write full-size caches); slot modes need ``n_slots``. Returns
+    "prefill_slot" | "decode_slot" | "prefill_paged" | "decode_paged")
+    of the decoder-only LM into the current default programs.
+    ``cache_len`` decouples the cache size from this view's prompt
+    bucket (ladder prefills at P < P_max still write full-size caches);
+    slot AND paged modes need ``n_slots``. The paged views (ISSUE 17)
+    swap the [n_slots, S, H, D] pool for [n_pages, page_size, H, D]
+    page pools behind a per-slot page-table feed — ``page_size`` must
+    divide cache_len (the decode gather then covers exactly cache_len
+    logical rows: fp32 paged decode is bit-identical to the slot op);
+    ``n_pages`` defaults to the contiguous pool's capacity
+    (n_slots * cache_len / page_size); ``kv_codec`` defaults to
+    FLAGS_kv_cache_codec ('none' | 'bf16' | 'int8' storage). Returns
     (output_var, feed_specs) — logits for full/prefill/decode, the
-    on-device-sampled next token for the slot views."""
-    _MODES = ("full", "prefill", "decode", "prefill_slot", "decode_slot")
+    on-device-sampled next token for the slot/paged views."""
+    _MODES = ("full", "prefill", "decode", "prefill_slot", "decode_slot",
+              "prefill_paged", "decode_paged")
     if mode not in _MODES:
         raise ValueError(f"decoder_lm mode {mode!r} not in {_MODES}")
-    if mode.endswith("_slot") and not n_slots:
+    if (mode.endswith("_slot") or mode.endswith("_paged")) \
+            and not n_slots:
         raise ValueError(f"mode {mode!r} needs n_slots")
     cache_len = int(cache_len) if cache_len else prompt_len + max_new
     if prompt_len > cache_len:
         raise ValueError(f"prompt_len {prompt_len} > cache_len "
                          f"{cache_len}")
+    if mode.endswith("_paged"):
+        from paddle_tpu import flags as _flags
+        page_size = int(page_size) if page_size else 4
+        if cache_len % page_size:
+            raise ValueError(f"page_size {page_size} must divide "
+                             f"cache_len {cache_len}")
+        max_pages = cache_len // page_size
+        n_pages = int(n_pages) if n_pages \
+            else int(n_slots) * max_pages
+        if n_pages < max_pages:
+            raise ValueError(f"n_pages {n_pages} < one slot's span "
+                             f"{max_pages} — no request could admit")
+        kv_codec = (kv_codec if kv_codec is not None
+                    else _flags.get("kv_cache_codec")) or "none"
+        if kv_codec not in ("none", "bf16", "int8"):
+            raise ValueError(f"kv_codec {kv_codec!r} not in "
+                             f"('none', 'bf16', 'int8')")
+        store_dt = {"none": "float32", "bf16": "bfloat16",
+                    "int8": "int8"}[kv_codec]
     d_k = d_model // n_head
     main = fluid.default_main_program()
     startup = fluid.default_startup_program()
@@ -247,12 +286,12 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     # indices in every mode's startup for the views to share weights.
     _pool_fills = []
 
-    def pool_var(pname):
-        shape = [int(n_slots), cache_len, n_head, d_k]
+    def pool_var(pname, shape=None, dtype="float32"):
+        shape = shape or [int(n_slots), cache_len, n_head, d_k]
         v = main.global_block().create_var(
-            name=pname, shape=shape, dtype="float32",
+            name=pname, shape=shape, dtype=dtype,
             persistable=True, stop_gradient=True)
-        _pool_fills.append((pname, shape))
+        _pool_fills.append((pname, shape, dtype))
         return v
 
     if mode == "decode":
@@ -268,7 +307,7 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                       "gen_start": ([-1, 1], "int64"),
                       "active": ([-1, 1], "int64")}
         x_ids, t = tok, 1
-    elif mode == "decode_slot":
+    elif mode in ("decode_slot", "decode_paged"):
         S = int(n_slots)
 
         def sdata(nm, shape, dtype="int64"):
@@ -292,8 +331,14 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                       "sample_step": ([S, 1], "int64"),
                       "temperature": ([S, 1], "float32"),
                       "top_k": ([S, 1], "int64")}
+        if mode == "decode_paged":
+            # the slot -> page indirection rides in as a STATIC-shape
+            # feed: any admission/release/page mix dispatches the same
+            # executable (sentinel entries point one past the pool)
+            page_table = sdata("page_table", [S, max_pages])
+            feed_specs["page_table"] = ([S, max_pages], "int64")
         x_ids, t = tok, 1
-    elif mode == "prefill_slot":
+    elif mode in ("prefill_slot", "prefill_paged"):
         # one request at a time joins the pool (batch 1, static)
         t = prompt_len
 
@@ -301,17 +346,23 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
             return layers.data(name=nm, shape=shape, dtype=dtype,
                                append_batch_size=False)
         ids = sdata("ids", [1, t, 1])
-        slot = sdata("slot", [1, 1])
         seq_len = sdata("seq_len", [1, 1])
         seed_in = sdata("seed", [1, 1])
         temp = sdata("temperature", [1, 1], "float32")
         top_k = sdata("top_k", [1, 1])
         feed_specs = {"ids": ([1, t, 1], "int64"),
-                      "slot": ([1, 1], "int64"),
                       "seq_len": ([1, 1], "int64"),
                       "seed": ([1, 1], "int64"),
                       "temperature": ([1, 1], "float32"),
                       "top_k": ([1, 1], "int64")}
+        if mode == "prefill_slot":
+            slot = sdata("slot", [1, 1])
+            feed_specs["slot"] = ([1, 1], "int64")
+        else:
+            # flat pool row per prompt position from the page lease —
+            # sentinel rows skip prefix-shared pages (already resident)
+            page_rows = sdata("page_rows", [t, 1])
+            feed_specs["page_rows"] = ([t, 1], "int64")
         x_ids = ids
     else:
         t = prompt_len if mode == "prefill" else cache_len
@@ -322,7 +373,7 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     emb = layers.embedding(x_ids, size=[vocab, d_model],
                            param_attr=pa("emb"))
     x = layers.scale(emb, scale=d_model ** 0.5)
-    if mode in ("decode", "decode_slot"):
+    if mode in ("decode", "decode_slot", "decode_paged"):
         # semantic position of this token for row b is
         # seq_len[b] + generated-so-far = seq_len + (pos - gen_start)
         # (prompts are right-padded to their bucket; the cache SLOT is
@@ -357,6 +408,24 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                 attn = layers.kv_attention_decode(
                     attn_in, pos, seq_len, gen_start, active, d_model,
                     n_head, pk, pv, param_attr=attn_pa(i))
+        elif mode.endswith("_paged"):
+            pshape = [n_pages, page_size, n_head, d_k]
+            pk = pool_var(f"{name}_page_k_{i}", pshape, store_dt)
+            pv = pool_var(f"{name}_page_v_{i}", pshape, store_dt)
+            pks = pvs = None
+            if kv_codec == "int8":
+                sshape = [n_pages, page_size, n_head]
+                pks = pool_var(f"{name}_page_ks_{i}", sshape)
+                pvs = pool_var(f"{name}_page_vs_{i}", sshape)
+            if mode == "prefill_paged":
+                attn = layers.kv_attention_prefill_paged(
+                    attn_in, page_rows, d_model, n_head, pk, pv,
+                    pks, pvs, codec=kv_codec, param_attr=attn_pa(i))
+            else:
+                attn = layers.kv_attention_decode_paged(
+                    attn_in, page_table, pos, seq_len, gen_start,
+                    active, d_model, n_head, pk, pv, pks, pvs,
+                    codec=kv_codec, param_attr=attn_pa(i))
         else:
             ck = main.global_block().create_var(
                 name=f"{name}_cache_k_{i}",
@@ -395,12 +464,12 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     # startup pool fills go AFTER every param initializer (rng-salt
     # stability across modes — see pool_var above)
     from paddle_tpu.fluid.initializer import ConstantInitializer
-    for pname, shape in _pool_fills:
+    for pname, shape, fdt in _pool_fills:
         sv = startup.global_block().create_var(
-            name=pname, shape=shape, dtype="float32", persistable=True)
+            name=pname, shape=shape, dtype=fdt, persistable=True)
         ConstantInitializer(0.0)(sv, startup.global_block())
 
-    if mode == "prefill_slot":
+    if mode in ("prefill_slot", "prefill_paged"):
         # first generated token, sampled on-device from the logits row
         # at the prompt's true end (batch 1: flatten [1,P,V] -> [P,V])
         flat = layers.reshape(logits, shape=[-1, vocab])
@@ -410,7 +479,7 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
         zero = layers.fill_constant([1, 1], "int64", 0)
         tok_out = layers.token_sample(last, temp, top_k, seed_in, zero)
         return tok_out, feed_specs
-    if mode == "decode_slot":
+    if mode in ("decode_slot", "decode_paged"):
         flat = layers.reshape(logits, shape=[-1, vocab])   # [S, V]
         tok_out = layers.token_sample(flat, temp, top_k, seed_in,
                                       sample_step)
@@ -424,7 +493,9 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
                               n_layer: int = 2, name: str = "lm",
                               seed: int = 7, modes=("prefill", "decode",
                                                     "full"),
-                              prompt_buckets=None, n_slots=None):
+                              prompt_buckets=None, n_slots=None,
+                              page_size=None, n_pages=None,
+                              kv_codec=None):
     """The serving program family: {key: (main, startup, feed_specs,
     fetch_name)}. All mains share every parameter name — run ONE startup
     (any of them; their parameter initializers are identical) into a
@@ -432,10 +503,11 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
 
     ``prompt_buckets`` (ascending lengths, largest == prompt_len) emits
     one prefill view PER bucket — keys ``prefill@P`` (and
-    ``prefill_slot@P`` when slot modes are requested), with the bare
-    mode name aliased to the largest bucket. ``n_slots`` sizes the
-    decode slot pool for the "prefill_slot"/"decode_slot" views
-    (in-flight batching, ISSUE 9)."""
+    ``prefill_slot@P`` / ``prefill_paged@P`` when slot/paged modes are
+    requested), with the bare mode name aliased to the largest bucket.
+    ``n_slots`` sizes the decode slot pool for the slot AND paged
+    views; ``page_size``/``n_pages``/``kv_codec`` shape the paged pool
+    (ISSUE 17 — see decoder_lm)."""
     cache_len = prompt_len + max_new
     buckets = tuple(sorted(set(int(b)
                                for b in (prompt_buckets or (prompt_len,)))))
@@ -444,7 +516,8 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
                          f"equal prompt_len {prompt_len}")
     cfg = dict(max_new=max_new, vocab=vocab, d_model=d_model,
                d_inner=d_inner, n_head=n_head, n_layer=n_layer,
-               name=name, cache_len=cache_len, n_slots=n_slots)
+               name=name, cache_len=cache_len, n_slots=n_slots,
+               page_size=page_size, n_pages=n_pages, kv_codec=kv_codec)
     out = {}
 
     def emit(key, mode, p_len):
@@ -457,13 +530,29 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
         out[key] = (main, startup, feed_specs, outv.name)
 
     for mode in modes:
-        if mode in ("prefill", "prefill_slot"):
+        if mode in ("prefill", "prefill_slot", "prefill_paged"):
             for p in buckets:
                 emit(f"{mode}@{p}", mode, p)
             out[mode] = out[f"{mode}@{buckets[-1]}"]
         else:
             emit(mode, mode, prompt_len)
     return out
+
+
+def slot_modes(layout=None):
+    """The slot-engine program modes for a KV-cache layout
+    (FLAGS_kv_cache_layout by default) — the one switch a serving
+    stack flips to go paged: pass the result as ``modes=`` to
+    :func:`build_decoder_lm_programs` and hand the programs to
+    :func:`paddle_tpu.serving.engine.make_slot_model`."""
+    from paddle_tpu import flags as _flags
+    layout = layout or _flags.get("kv_cache_layout")
+    if layout not in ("contiguous", "paged"):
+        raise ValueError(f"FLAGS_kv_cache_layout {layout!r} not in "
+                         f"('contiguous', 'paged')")
+    if layout == "paged":
+        return ("prefill_paged", "decode_paged")
+    return ("prefill_slot", "decode_slot")
 
 
 def serve_lint_prefill():
@@ -488,6 +577,20 @@ def serve_lint_decode_slot():
     """proglint --module entry: the slot-pool decode step with on-device
     token sampling (the in-flight scheduler's executable)."""
     decoder_lm("decode_slot", n_slots=4)
+
+
+def serve_lint_prefill_paged():
+    """proglint --module entry: the paged-pool prefill that scatters one
+    request's K/V through its page-table lease (shared-prefix rows
+    dropped via sentinel — ISSUE 17)."""
+    decoder_lm("prefill_paged", n_slots=4)
+
+
+def serve_lint_decode_paged():
+    """proglint --module entry: the paged-pool decode step — page-table
+    feed indirection, donated page pools (the proglint --memory target
+    for the paged layout)."""
+    decoder_lm("decode_paged", n_slots=4)
 
 
 def build(is_train: bool = True, src_vocab: int = 32000,

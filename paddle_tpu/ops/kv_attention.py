@@ -199,6 +199,190 @@ def _kv_attention_decode(ctx, ins, attrs):
     return {"Out": [out], "CacheKOut": [cache_k], "CacheVOut": [cache_v]}
 
 
+def _kv_quant(rows):
+    """rows [..., H, D] fp32 -> (int8 codes, fp32 scales [..., H]):
+    symmetric per-(position, head) scaling — the per-row-scale wire
+    discipline of FLAGS_embed_exchange_codec applied at rest
+    (FLAGS_kv_cache_codec=int8)."""
+    amax = jnp.max(jnp.abs(rows), axis=-1)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(rows / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _paged_gather(flat, scales, rows, h, dt):
+    """Gather K/V rows through page-table row indices: flat [R, H, D]
+    storage (fp32 | bf16 | int8 codes), scales [R, H] fp32 or None,
+    rows [N] int32 (sentinel rows >= R clamp to the last pool row —
+    their contribution is exactly zeroed by the attention mask).
+    Returns [N, H, D] in the compute dtype. Tier selection per
+    ops/pallas: the scalar-prefetch DMA kernel on aligned TPU shapes
+    (ops/pallas/paged_attention.py), the jnp refer path otherwise."""
+    r, _, dk = flat.shape
+    idx = jnp.minimum(rows, r - 1)
+    from paddle_tpu.ops import pallas as _plk
+    if _plk.kernel_enabled(128, h * dk):
+        from paddle_tpu.ops.pallas import paged_attention as _pk
+        interp = _plk.interpret_mode()
+        if scales is not None:
+            out = _pk.gather_rows_dequant(
+                flat.reshape(r, h * dk), scales, idx, h,
+                interpret=interp)
+        else:
+            out = _pk.gather_rows(flat.reshape(r, h * dk), idx,
+                                  interpret=interp)
+        return out.reshape(-1, h, dk).astype(dt)
+    out = jnp.take(flat, idx, axis=0)
+    if scales is not None:
+        out = out.astype(jnp.float32) * jnp.take(scales, idx,
+                                                 axis=0)[..., None]
+    return out.astype(dt)
+
+
+def _paged_pools(ins, codec, h):
+    """The paged pool operands as flat [R, H, D] views (+ flat [R, H]
+    scale views for int8). Reshaping [n_pages, ps, H, D] -> [R, H, D]
+    is a bitcast — XLA keeps the donated input/output aliasing through
+    it (proglint --memory witnesses this)."""
+    page_k, page_v = first(ins, "PageK"), first(ins, "PageV")
+    n_pages, ps = int(page_k.shape[0]), int(page_k.shape[1])
+    dk = int(page_k.shape[3])
+    rtot = n_pages * ps
+    flat_k = page_k.reshape(rtot, h, dk)
+    flat_v = page_v.reshape(rtot, h, dk)
+    fks = fvs = None
+    if codec == "int8":
+        fks = first(ins, "PageKS").reshape(rtot, h)
+        fvs = first(ins, "PageVS").reshape(rtot, h)
+    return flat_k, flat_v, fks, fvs, n_pages, ps, rtot
+
+
+def _paged_write(flat, fscale, rows, vals, codec):
+    """Scatter K/V rows (and int8 scales) at flat ``rows``; sentinel
+    rows (>= R: skipped shared-prefix positions, inactive slots) DROP —
+    the copy-on-write contract: a shared page is never written, the
+    divergent request's rows land in its own private page."""
+    if codec == "int8":
+        codes, scale = _kv_quant(vals.astype(jnp.float32))
+        flat = flat.at[rows].set(codes, mode="drop")
+        fscale = fscale.at[rows].set(scale, mode="drop")
+        return flat, fscale
+    return flat.at[rows].set(vals.astype(flat.dtype), mode="drop"), None
+
+
+@register_op("kv_attention_prefill_paged", no_grad=True,
+             ref="TPU-native serving op: causal prefill whose K/V rows "
+                 "scatter into the PAGED pool at per-position flat row "
+                 "indices from the slot's page table — sentinel rows "
+                 "skip prefix-SHARED pages (already resident, "
+                 "bit-identical by construction: K/V at position j "
+                 "depends only on token j)")
+def _kv_attention_prefill_paged(ctx, ins, attrs):
+    """X [B,T,M], Wq..Wo [M,M], PageK/PageV [n_pages, ps, H, Dk]
+    (+ PageKS/PageVS [n_pages, ps, H] fp32 when codec=int8),
+    Rows [B*T, 1] int: flat pool row per prompt position, sentinel
+    (>= n_pages*ps) for shared-prefix and skipped positions -> Out
+    [B,T,M] + the pools with this prompt's K/V written through the
+    page table. attrs: n_head, codec."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    h = int(attrs["n_head"])
+    codec = str(attrs.get("codec", "none"))
+    rows = jnp.asarray(first(ins, "Rows")).reshape(-1).astype(jnp.int32)
+    flat_k, flat_v, fks, fvs, n_pages, ps, _ = _paged_pools(ins, codec, h)
+    out, k, v = _causal_prefill(x, wq, wk, wv, wo, h)
+    dk = flat_k.shape[2]
+    flat_k, fks = _paged_write(flat_k, fks, rows,
+                               k.reshape(-1, h, dk), codec)
+    flat_v, fvs = _paged_write(flat_v, fvs, rows,
+                               v.reshape(-1, h, dk), codec)
+    shape4 = (n_pages, ps, h, dk)
+    res = {"Out": [out],
+           "PageKOut": [flat_k.reshape(shape4)],
+           "PageVOut": [flat_v.reshape(shape4)]}
+    if codec == "int8":
+        res["PageKSOut"] = [fks.reshape(n_pages, ps, h)]
+        res["PageVSOut"] = [fvs.reshape(n_pages, ps, h)]
+    return res
+
+
+@register_op("kv_attention_decode_paged", no_grad=True,
+             ref="TPU-native serving op: one-token decode over the "
+                 "PAGED KV pool — write row and gather rows resolved "
+                 "through the per-slot page table feed (static shapes: "
+                 "zero steady-state compiles; Pallas scalar-prefetch "
+                 "gather on TPU, ops/pallas/paged_attention.py)")
+def _kv_attention_decode_paged(ctx, ins, attrs):
+    """X [B,1,M], Wq..Wo [M,M], PageK/PageV [n_pages, ps, H, Dk]
+    (+ PageKS/PageVS when codec=int8), PageTable [B, MP] int (flat page
+    id per logical page; sentinel n_pages past the slot's span),
+    Pos/SeqLen/GenStart/Active [B,1] int — geometry identical to
+    kv_attention_decode; the cache row for logical position j lives at
+    flat row table[b, j//ps]*ps + j%ps. attrs: n_head, codec. The mask
+    {j < seq_len} ∪ {gen_start <= j <= pos} zeroes sentinel/garbage
+    rows EXACTLY, so fp32 paged decode is bit-identical to the
+    contiguous op."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    h = int(attrs["n_head"])
+    codec = str(attrs.get("codec", "none"))
+    b, _, m = x.shape
+    d = m // h
+    dt = x.dtype
+    flat_k, flat_v, fks, fvs, n_pages, ps, rtot = \
+        _paged_pools(ins, codec, h)
+    table = jnp.asarray(first(ins, "PageTable")).astype(jnp.int32)
+    mp = table.shape[1]
+    s_len = mp * ps
+
+    pos = jnp.asarray(first(ins, "Pos")).reshape(-1).astype(jnp.int32)
+    lens = jnp.asarray(first(ins, "SeqLen")).reshape(-1).astype(jnp.int32)
+    gen0 = jnp.asarray(first(ins, "GenStart")).reshape(-1)\
+        .astype(jnp.int32)
+    active = jnp.asarray(first(ins, "Active")).reshape(-1) > 0
+
+    q = _ab._proj(x, wq, h)                     # [B,1,H,D]
+    k_t = _ab._proj(x, wk, h)
+    v_t = _ab._proj(x, wv, h)
+
+    # this step's write row through the page table, sentinel (dropped)
+    # for inactive slots — a free slot's pages are bit-identical before
+    # and after the step, same contract as the contiguous one-hot write
+    wpage = jnp.take_along_axis(table, (pos // ps)[:, None],
+                                axis=1)[:, 0]
+    wrow = jnp.where(active, wpage * ps + pos % ps, rtot)
+    flat_k, fks = _paged_write(flat_k, fks, wrow, k_t[:, 0], codec)
+    flat_v, fvs = _paged_write(flat_v, fvs, wrow, v_t[:, 0], codec)
+
+    # gather every slot's logical cache through its table row
+    rows = (table[:, :, None] * ps
+            + jnp.arange(ps, dtype=jnp.int32)[None, None, :]).reshape(-1)
+    kk = _paged_gather(flat_k, fks, rows, h, dt).reshape(b, s_len, h, d)
+    vv = _paged_gather(flat_v, fvs, rows, h, dt).reshape(b, s_len, h, d)
+
+    s = jax.lax.dot_general(q, kk, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,1,S]
+    j = jnp.arange(s_len, dtype=jnp.int32)
+    valid = (j[None, :] < lens[:, None]) | \
+            ((j[None, :] >= gen0[:, None]) &
+             (j[None, :] <= pos[:, None]))           # [B,S]
+    p = _scores_to_probs(s, valid[:, None, None, :], dt)
+    c = jax.lax.dot_general(p, vv, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+    shape4 = (n_pages, ps, h, d)
+    res = {"Out": [out],
+           "PageKOut": [flat_k.reshape(shape4)],
+           "PageVOut": [flat_v.reshape(shape4)]}
+    if codec == "int8":
+        res["PageKSOut"] = [fks.reshape(n_pages, ps, h)]
+        res["PageVSOut"] = [fvs.reshape(n_pages, ps, h)]
+    return res
+
+
 @register_op("token_sample", no_grad=True,
              ref="TPU-native serving op: on-device next-token selection "
                  "— greedy argmax or temperature/top-k Gumbel sampling "

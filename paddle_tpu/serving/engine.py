@@ -28,6 +28,17 @@ steady-state serving performs zero XLA compilations
   scatters their cache rows in) and LEAVE on EOS/max-tokens/cancel, so
   the device stays saturated with whatever work exists right now — no
   wave barrier, with on-device temperature/top-k sampling per slot.
+
+- :class:`PagedSlotGenerativeModel` (ISSUE 17) — the slot engine over a
+  PAGED KV pool: slots address their cache through a per-slot page
+  table into one shared ``[n_pages, page_size, H, D]`` pool, admission
+  is gated by FREE PAGES for the request's span (prompt bucket + token
+  budget) instead of a whole worst-case row, and requests with a
+  common prompt prefix physically share full prefix pages through a
+  refcounted radix tree (``serving/kv_pool.py``). Same zero-
+  steady-state-compile contract: the page table is a fixed-shape
+  ``[n_slots, max_pages]`` feed, so join/leave churn never re-lowers.
+  ``make_slot_model`` picks the engine class off the program keys.
 """
 
 from __future__ import annotations
@@ -564,22 +575,29 @@ class SlotGenerativeModel:
     discipline: one dispatcher at a time (the server's scheduler
     thread); ``admit``/``step``/``release`` are not internally locked."""
 
+    # the program-key pair this engine dispatches; the paged subclass
+    # swaps in its views and everything keyed on these (warmup, AOT
+    # tags, compile-counter kinds) follows
+    PREFILL = "prefill_slot"
+    DECODE = "decode_slot"
+
     def __init__(self, name: str, programs: Dict, scope=None,
                  init: bool = True):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.core.lowering import CompiledBlock
         self.name = name
+        pk, dk = self.PREFILL, self.DECODE
         pre = {}
         for key, val in programs.items():
-            if key == "prefill_slot" or key.startswith("prefill_slot@"):
+            if key == pk or key.startswith(pk + "@"):
                 pre[int(val[2]["ids"][0][1])] = val
-        if not pre or "decode_slot" not in programs:
-            raise ValueError("programs must contain 'prefill_slot' and "
-                             "'decode_slot' views (build_decoder_lm_"
-                             "programs(..., n_slots=...))")
+        if not pre or dk not in programs:
+            raise ValueError(f"programs must contain {pk!r} and {dk!r} "
+                             f"views (build_decoder_lm_programs(..., "
+                             f"n_slots=...))")
         self.prompt_buckets = tuple(sorted(pre))
         self.prompt_len = self.prompt_buckets[-1]
-        dec_main, dec_start, dec_feeds, dec_fetch = programs["decode_slot"]
+        dec_main, dec_start, dec_feeds, dec_fetch = programs[dk]
         self.n_slots = int(dec_feeds["tok"][0][0])
         # server compatibility: max prompts one request may carry
         self.policy = bucketing.BucketPolicy((self.n_slots,))
@@ -592,8 +610,8 @@ class SlotGenerativeModel:
         # exists right after startup) the exact KV-pool bytes gauge
         from paddle_tpu.observability import memory as obs_memory
         for p, (m, _s, _f, _o) in pre.items():
-            m.desc._obs_name = f"{name}.prefill_slot@{p}"
-        dec_main.desc._obs_name = f"{name}.decode_slot"
+            m.desc._obs_name = f"{name}.{pk}@{p}"
+        dec_main.desc._obs_name = f"{name}.{dk}"
         obs_memory.note_scope(self.scope)
         if init:
             obs_memory.kv_pool_bytes(self.scope, name)
@@ -604,10 +622,7 @@ class SlotGenerativeModel:
         self._cb_decode = CompiledBlock(
             dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
             is_test=True, donate=True)
-        pool_vars = [v for n, v in dec_main.desc.global_block.vars.items()
-                     if n.endswith("_slot_k_0")]
-        self.cache_len = int(pool_vars[0].shape[1]) if pool_vars else 0
-        self.max_new = self.cache_len - self.prompt_len
+        self._discover_pool(dec_main, dec_feeds)
         self._warmed: set = set()
         self._aot: Dict[Tuple, object] = {}
         self._fingerprint = hashlib.sha256(json.dumps(
@@ -626,6 +641,15 @@ class SlotGenerativeModel:
         self._topk = np.zeros(s, np.int64)
         self._budget = np.zeros(s, np.int64)
         self._eos: List[Optional[int]] = [None] * s
+
+    def _discover_pool(self, dec_main, dec_feeds):
+        """Read the KV capacity off the decode program's pool vars.
+        Contiguous layout: ``*_slot_k_0`` is ``[n_slots, cache_len, H,
+        D]``. The paged subclass overrides this to size its page pool."""
+        pool_vars = [v for n, v in dec_main.desc.global_block.vars.items()
+                     if n.endswith("_slot_k_0")]
+        self.cache_len = int(pool_vars[0].shape[1]) if pool_vars else 0
+        self.max_new = self.cache_len - self.prompt_len
 
     # -- plumbing (same dispatch/AOT discipline as GenerativeModel) ------
     _args = GenerativeModel._args
@@ -654,11 +678,33 @@ class SlotGenerativeModel:
 
     def _prefill_feeds(self, p_len: int):
         return {"ids": np.zeros((1, p_len, 1), np.int64),
-                "slot": np.zeros((1, 1), np.int64),
+                **self._admit_feeds(0, p_len),
                 "seq_len": np.ones((1, 1), np.int64),
                 "seed": np.zeros((1, 1), np.int64),
                 "temperature": np.zeros((1, 1), np.float32),
                 "top_k": np.zeros((1, 1), np.int64)}
+
+    def _admit_feeds(self, slot: int, p_len: int):
+        """The layout-specific prefill feed: WHERE the prompt's KV rows
+        land. Contiguous: the slot index (its whole cache row)."""
+        return {"slot": np.asarray([[slot]], np.int64)}
+
+    def _reserve_capacity(self, slot: int, prompt, p_len: int,
+                          budget: int):
+        """Admission-time capacity hook. Contiguous layout reserves
+        nothing beyond the slot itself; the paged subclass acquires
+        pages here (and raises SlotExhaustedError when the pool can't
+        cover the request's span)."""
+
+    def _release_capacity(self, slot: int):
+        """Failure twin of :meth:`_reserve_capacity`: undo the
+        admission-time reservation when the prefill dispatch raises
+        before the slot goes live. ``release`` won't run for such a
+        slot (it never became active), so without this hook the paged
+        pool would keep the lease forever — and since ``admit`` always
+        picks the lowest free slot, every later admission would retry
+        the same slot and trip its already-holds-a-lease guard.
+        Contiguous layout reserved nothing."""
 
     # -- warmup / AOT ----------------------------------------------------
     def warmup(self, aot_dir: Optional[str] = None,
@@ -669,24 +715,25 @@ class SlotGenerativeModel:
         loaded = compiled = 0
         if aot_dir:
             loaded += self.load_compiled(aot_dir)
+        pk, dk = self.PREFILL, self.DECODE
         for p in self.prompt_buckets:
-            if ("prefill_slot", p) in self._warmed:
+            if (pk, p) in self._warmed:
                 continue
-            smetrics.count_compile(self.name, "prefill_slot")
+            smetrics.count_compile(self.name, pk)
             compiled += 1
-            self._run(self._cb_prefill[p], ("prefill_slot", p),
+            self._run(self._cb_prefill[p], (pk, p),
                       self._prefill_feeds(p))
-            self._warmed.add(("prefill_slot", p))
+            self._warmed.add((pk, p))
             if aot_dir and persist:
-                self._persist_one(aot_dir, "prefill_slot", p)
-        if ("decode_slot",) not in self._warmed:
-            smetrics.count_compile(self.name, "decode_slot")
+                self._persist_one(aot_dir, pk, p)
+        if (dk,) not in self._warmed:
+            smetrics.count_compile(self.name, dk)
             compiled += 1
-            self._run(self._cb_decode, ("decode_slot",),
+            self._run(self._cb_decode, (dk,),
                       self._decode_feeds())
-            self._warmed.add(("decode_slot",))
+            self._warmed.add((dk,))
             if aot_dir and persist:
-                self._persist_one(aot_dir, "decode_slot")
+                self._persist_one(aot_dir, dk)
         # warmup dispatches touched slot 0's cache rows; no request was
         # live, so just make sure the host mirror says so
         self.reset()
@@ -701,7 +748,7 @@ class SlotGenerativeModel:
 
     def _persist_one(self, dirname: str, kind: str,
                      p_len: Optional[int] = None):
-        if kind == "prefill_slot":
+        if kind == self.PREFILL:
             cb, feeds = self._cb_prefill[p_len], self._prefill_feeds(p_len)
         else:
             cb, feeds = self._cb_decode, self._decode_feeds()
@@ -713,17 +760,17 @@ class SlotGenerativeModel:
 
     def load_compiled(self, dirname: str) -> int:
         n = 0
+        pk, dk = self.PREFILL, self.DECODE
         for p in self.prompt_buckets:
-            exe = load_executable(
-                self._aot_path(dirname, "prefill_slot", p))
+            exe = load_executable(self._aot_path(dirname, pk, p))
             if exe is not None:
-                self._aot[("prefill_slot", p)] = exe
-                self._warmed.add(("prefill_slot", p))
+                self._aot[(pk, p)] = exe
+                self._warmed.add((pk, p))
                 n += 1
-        exe = load_executable(self._aot_path(dirname, "decode_slot"))
+        exe = load_executable(self._aot_path(dirname, dk))
         if exe is not None:
-            self._aot[("decode_slot",)] = exe
-            self._warmed.add(("decode_slot",))
+            self._aot[(dk,)] = exe
+            self._warmed.add((dk,))
             n += 1
         return n
 
@@ -749,7 +796,8 @@ class SlotGenerativeModel:
         if free.size == 0:
             raise SlotExhaustedError(
                 f"model {self.name!r}: all {self.n_slots} decode slots "
-                f"are in flight")
+                f"are in flight (free_slots=0, "
+                f"active_slots={self.n_slots})")
         slot = int(free[0])
         p_len = self.prompt_bucket_for(length)
         budget = self.max_new if max_new is None else int(max_new)
@@ -763,24 +811,29 @@ class SlotGenerativeModel:
                 f"max_new {budget} outside the cache budget "
                 f"(1..{self.cache_len - p_len} for a prompt padded to "
                 f"bucket {p_len})")
-        key = ("prefill_slot", p_len)
+        self._reserve_capacity(slot, prompt, p_len, budget)
+        key = (self.PREFILL, p_len)
         if key not in self._warmed:
-            smetrics.count_compile(self.name, "steady_prefill_slot")
+            smetrics.count_compile(self.name, f"steady_{self.PREFILL}")
             self._warmed.add(key)
         ids = np.zeros((1, p_len, 1), np.int64)
         ids[0, :length, 0] = prompt
         # span named by the PROMPT BUCKET the admission landed on, under
         # the admitting request's trace (the scheduler activates it)
-        with tctx.span(f"serving.prefill@{p_len}", model=self.name,
-                       slot=slot):
-            tok = self._run(self._cb_prefill[p_len], key, {
-                "ids": ids,
-                "slot": np.asarray([[slot]], np.int64),
-                "seq_len": np.asarray([[length]], np.int64),
-                "seed": np.asarray([[int(seed)]], np.int64),
-                "temperature": np.asarray([[float(temperature)]],
-                                          np.float32),
-                "top_k": np.asarray([[int(top_k)]], np.int64)})
+        try:
+            with tctx.span(f"serving.prefill@{p_len}", model=self.name,
+                           slot=slot):
+                tok = self._run(self._cb_prefill[p_len], key, {
+                    "ids": ids,
+                    **self._admit_feeds(slot, p_len),
+                    "seq_len": np.asarray([[length]], np.int64),
+                    "seed": np.asarray([[int(seed)]], np.int64),
+                    "temperature": np.asarray([[float(temperature)]],
+                                              np.float32),
+                    "top_k": np.asarray([[int(top_k)]], np.int64)})
+        except BaseException:
+            self._release_capacity(slot)
+            raise
         smetrics.PREFILLS.labels(model=self.name).inc()
         smetrics.SLOT_ADMISSIONS.labels(model=self.name).inc()
         smetrics.TOKENS_GENERATED.labels(model=self.name).inc()
@@ -815,10 +868,10 @@ class SlotGenerativeModel:
         live = np.flatnonzero(self._active)
         if live.size == 0:
             return []
-        if ("decode_slot",) not in self._warmed:
-            smetrics.count_compile(self.name, "steady_decode_slot")
-            self._warmed.add(("decode_slot",))
-        out = self._run(self._cb_decode, ("decode_slot",),
+        if (self.DECODE,) not in self._warmed:
+            smetrics.count_compile(self.name, f"steady_{self.DECODE}")
+            self._warmed.add((self.DECODE,))
+        out = self._run(self._cb_decode, (self.DECODE,),
                         self._decode_feeds())
         out = np.asarray(out).reshape(-1)
         smetrics.DECODE_STEPS.labels(model=self.name).inc()
@@ -895,3 +948,146 @@ class SlotGenerativeModel:
                     del slot2idx[slot]
         return [np.asarray(collected[i], np.int64)
                 for i in range(len(prompts))]
+
+
+class PagedSlotGenerativeModel(SlotGenerativeModel):
+    """Slot engine over a PAGED KV pool (ISSUE 17): the decode program
+    reads each slot's K/V through a ``[n_slots, max_pages]`` page-table
+    feed into one shared ``[n_pages, page_size, H, D]`` pool per layer,
+    so HBM holds pages for the requests actually in flight instead of
+    ``n_slots`` worst-case rows. Admission acquires
+    ``ceil((prompt_bucket + budget) / page_size)`` pages from
+    :class:`~paddle_tpu.serving.kv_pool.PagePool`; full pages of the
+    TRUE prompt are shared with earlier requests carrying the same
+    token prefix (radix tree, refcounted — prefill skips recomputed
+    writes into shared pages via sentinel row ids, the copy-on-write
+    boundary page is always private). ``FLAGS_kv_cache_codec`` may
+    store the pool as bf16 or int8+per-(position, head) scale planes;
+    the dequantizing gather lives in ``ops/pallas/paged_attention.py``.
+
+    Drop-in for :class:`SlotGenerativeModel` everywhere the server
+    cares: same ``admit``/``step``/``release``/``generate`` surface,
+    same zero-steady-state-compile warmup contract (the page table is a
+    fixed-shape feed — join/leave churn re-dispatches, never
+    re-lowers). Built from ``build_decoder_lm_programs(..., modes=
+    ("prefill_paged", "decode_paged"), n_slots=..., n_pages=...,
+    page_size=...)``."""
+
+    PREFILL = "prefill_paged"
+    DECODE = "decode_paged"
+
+    def _discover_pool(self, dec_main, dec_feeds):
+        from paddle_tpu.serving import kv_pool
+        pool_vars = [v for n, v in dec_main.desc.global_block.vars.items()
+                     if n.endswith("_page_k_0")]
+        if not pool_vars:
+            raise ValueError(
+                f"model {self.name!r}: decode_paged program has no "
+                f"*_page_k_* pool vars")
+        self.n_pages = int(pool_vars[0].shape[0])
+        self.page_size = int(pool_vars[0].shape[1])
+        self.max_pages = int(dec_feeds["page_table"][0][1])
+        self.cache_len = self.max_pages * self.page_size
+        self.max_new = self.cache_len - self.prompt_len
+        if self.n_pages < self.max_pages:
+            raise ValueError(
+                f"model {self.name!r}: pool of {self.n_pages} pages "
+                f"cannot hold one worst-case request ({self.max_pages} "
+                f"pages) — admission could never succeed")
+        self.pool = kv_pool.PagePool(self.n_pages, self.page_size,
+                                     model=self.name)
+        # row-write sentinel: one past the flat pool -> scatter drops it
+        self._row_sentinel = self.n_pages * self.page_size
+        # host page-table mirror; n_pages is the TABLE sentinel (gather
+        # rows land past the pool and are clamped+masked on device)
+        self._table = np.full((self.n_slots, self.max_pages),
+                              self.n_pages, np.int64)
+        self._pending_rows: Optional[np.ndarray] = None
+
+    def free_pages(self) -> int:
+        return self.pool.free_count()
+
+    def _decode_feeds(self):
+        feeds = SlotGenerativeModel._decode_feeds(self)
+        feeds["page_table"] = self._table.copy()
+        return feeds
+
+    def _admit_feeds(self, slot: int, p_len: int):
+        """Prefill feed: the flat pool row for each prompt position —
+        or the drop sentinel for positions whose pages are SHARED with
+        the radix tree (their K/V is already resident and bit-identical
+        by construction; rewriting would race other readers only in
+        spirit, but skipping also keeps the write volume proportional
+        to the non-shared suffix). Warmup (no reservation pending)
+        feeds all sentinels: compile the shapes, write nothing."""
+        rows = self._pending_rows
+        self._pending_rows = None
+        if rows is None:
+            rows = np.full((p_len, 1), self._row_sentinel, np.int64)
+        return {"page_rows": rows}
+
+    def _reserve_capacity(self, slot, prompt, p_len, budget):
+        from paddle_tpu.serving import kv_pool
+        span = self.pool.span_for(p_len + budget)
+        try:
+            pages, n_shared = self.pool.acquire(
+                slot, [int(t) for t in prompt], span)
+        except kv_pool.PagesExhaustedError as e:
+            raise SlotExhaustedError(
+                f"model {self.name!r}: page pool cannot cover a "
+                f"{span}-page admission (free_pages="
+                f"{self.pool.free_count()}, evictable_cached="
+                f"{self.pool.cached_count()}, pages_total="
+                f"{self.n_pages}, free_slots={self.free_count()}, "
+                f"active_slots={self.active_count()})") from e
+        ps = self.page_size
+        idx = np.arange(p_len)
+        rows = np.asarray(pages, np.int64)[idx // ps] * ps + idx % ps
+        rows[idx < n_shared * ps] = self._row_sentinel
+        self._pending_rows = rows[:, None]
+        self._table[slot, :] = self.n_pages
+        self._table[slot, :span] = pages
+
+    def _release_capacity(self, slot):
+        """A prefill dispatch died after acquire: abort the lease (the
+        pages it inserted into the prefix tree were never written, so
+        they must not survive as cache), scrub the slot's table row,
+        and drop any not-yet-consumed write rows so the next unrelated
+        admission can't inherit them."""
+        self.pool.abort(slot)
+        self._table[slot, :] = self.n_pages
+        self._pending_rows = None
+
+    def release(self, slot: int, cause: str = "cancelled"):
+        if self._active[slot]:
+            self.pool.release(slot)
+            self._table[slot, :] = self.n_pages
+        SlotGenerativeModel.release(self, slot, cause=cause)
+
+    def reset(self):
+        self.pool.reset()
+        self._table[:] = self.n_pages
+        self._pending_rows = None
+        SlotGenerativeModel.reset(self)
+
+    def _aot_path(self, dirname: str, kind: str,
+                  p_len: Optional[int] = None) -> str:
+        tag = kind + (f"_p{p_len}" if p_len else "")
+        return os.path.join(
+            dirname,
+            f"__paged_{tag}_s{self.n_slots}_pg{self.n_pages}"
+            f"x{self.page_size}.{self._fingerprint[:12]}.pax")
+
+
+def make_slot_model(name: str, programs: Dict, scope=None,
+                    init: bool = True) -> SlotGenerativeModel:
+    """Build the slot engine matching ``programs``' layout: paged views
+    (``prefill_paged``/``decode_paged``, from ``FLAGS_kv_cache_layout=
+    paged`` via ``transformer.slot_modes()``) get
+    :class:`PagedSlotGenerativeModel`; the contiguous slot views get
+    :class:`SlotGenerativeModel`."""
+    if any(k == "decode_paged" or k == "prefill_paged"
+           or k.startswith("prefill_paged@") for k in programs):
+        return PagedSlotGenerativeModel(name, programs, scope=scope,
+                                        init=init)
+    return SlotGenerativeModel(name, programs, scope=scope, init=init)

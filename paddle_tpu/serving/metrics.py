@@ -101,6 +101,34 @@ SLOT_EVICTIONS = _metrics.counter(
     "Slots freed, by cause: eos | max_new | cancelled | error",
     labelnames=("model", "cause"))
 
+# -- paged KV pool families (serving/kv_pool.py) ------------------------
+# The paged layout replaces the single worst-case reservation the
+# paddle_hbm_kv_pool_bytes gauge reports with a page economy; these
+# three gauges + the eviction counter ARE its accounting (total is
+# static per model, free moves with admissions/releases, shared counts
+# pages referenced by MORE THAN ONE in-flight slot — the prefix-sharing
+# witness the tests refcount against).
+KV_PAGES_TOTAL = _metrics.gauge(
+    "paddle_kv_pages_total",
+    "Pages in the model's KV page pool (static: n_pages per layer "
+    "group — the capacity side of the admission rule)",
+    labelnames=("model",))
+KV_PAGES_FREE = _metrics.gauge(
+    "paddle_kv_pages_free",
+    "Pages on the free list right now (admission takes "
+    "span - shared_prefix_pages of these; cached prefix pages are NOT "
+    "free — they evict on demand)", labelnames=("model",))
+KV_PREFIX_SHARED_PAGES = _metrics.gauge(
+    "paddle_kv_prefix_shared_pages",
+    "Pages physically referenced by >= 2 in-flight slots via the "
+    "prompt-prefix radix tree (each counted once)",
+    labelnames=("model",))
+KV_PAGE_EVICTIONS = _metrics.counter(
+    "paddle_kv_page_evictions_total",
+    "Cached prefix pages dropped from the radix tree, by cause: "
+    "capacity (LRU reclaim to satisfy an admission) | reset (engine "
+    "reset/warmup scrub)", labelnames=("model", "cause"))
+
 # -- router families (serving/router.py) -------------------------------
 # ``replica`` is the router-assigned slot index ("0".."N-1") — bounded
 # by the pool size, stable across restarts of the replica in that slot.

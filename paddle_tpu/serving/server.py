@@ -61,7 +61,8 @@ from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.serving import bucketing
 from paddle_tpu.serving import metrics as smetrics
 from paddle_tpu.serving.engine import (GenerativeModel, PromptTooLongError,
-                                       ServedModel, SlotGenerativeModel)
+                                       ServedModel, SlotExhaustedError,
+                                       SlotGenerativeModel)
 from paddle_tpu.utils import faults
 
 SERVING_ENV = "PADDLE_SERVING"
@@ -532,6 +533,12 @@ class _SlotHostedModel(_HostedModel):
                         prompt, seed=seed, temperature=req.temperature,
                         top_k=req.top_k, max_new=req.max_new,
                         eos_id=req.eos_id)
+            except SlotExhaustedError:
+                # paged engines can run out of PAGES while slots remain
+                # free (free_count() gates only slots); the request is
+                # fine — put the prompt back and retry after a leave
+                stream.pending.appendleft((pi, prompt))
+                return
             except BaseException as e:
                 if self._is_fatal_oom(e):
                     self._fatal_oom(e)     # never returns
@@ -876,6 +883,11 @@ class _RpcServer(socketserver.ThreadingTCPServer):
 _ERROR_KINDS = {
     ReplicaDrainingError: "draining",
     RequestShedError: "shed",
+    # CAPACITY shed (no free slot / not enough free KV pages — the
+    # message carries the counts), distinct from the queue-depth shed
+    # above: a router should retry it on a less-loaded replica rather
+    # than back off the whole fleet
+    SlotExhaustedError: "exhausted",
     ModelNotFoundError: "not_found",
     RequestCancelledError: "cancelled",
     PromptTooLongError: "bad_request",

@@ -143,6 +143,44 @@ def probe_serving_decode():
     }
 
 
+def probe_serving_decode_paged():
+    """Donation audit + census classification of the PAGED decode
+    executable (ISSUE 17): the shared ``*_page_k/v_*`` pools must keep
+    aliasing across the page-table gather/scatter rewrite, and the
+    memory census must classify them as ``kv_cache``."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.transformer import build_decoder_lm_programs
+    from paddle_tpu.observability import memory as obs_memory
+    import proglint
+
+    progs = build_decoder_lm_programs(
+        prompt_len=8, max_new=8, vocab=64, d_model=32, d_inner=64,
+        n_head=2, n_layer=2, modes=("decode_paged",), n_slots=4,
+        page_size=4)
+    main, startup, feed_specs, _fetch = progs["decode_paged"]
+    audit = proglint._memory_audit("decoder_lm.decode_paged", main,
+                                   startup, sorted(feed_specs))
+    # census: run startup and make sure every page-pool buffer lands in
+    # the kv_cache family (docs/observability.md; _KV_RE covers *_page_*)
+    scope = fluid.Scope()
+    fluid.Executor(fluid.TPUPlace()).run(startup, scope=scope)
+    cen = obs_memory.census([scope])
+    kv_bufs = [b for b in cen["buffers"] if b["family"] == "kv_cache"]
+    misclassified = [b["name"] for b in cen["buffers"]
+                     if "_page_" in b["name"]
+                     and b["family"] != "kv_cache"]
+    return {
+        "program": "decoder_lm.decode_paged",
+        "expected": len(audit.get("expected") or []),
+        "aliased": len(audit.get("aliased") or []),
+        "violations": (audit.get("violations") or []) + misclassified,
+        "skipped": audit.get("skipped") or [],
+        "kv_cache_bytes": cen["families"].get("kv_cache", 0),
+        "kv_cache_buffers": len(kv_bufs),
+        **({"error": audit["error"]} if audit.get("error") else {}),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mem_probe", description=__doc__,
@@ -165,7 +203,8 @@ def main(argv=None):
     failures = 0
     doc = {"metric": "compiled peak-HBM vs static estimator (zoo, "
                      "default configs)",
-           "batch_size": args.batch_size, "models": {}, "serving": None}
+           "batch_size": args.batch_size, "models": {}, "serving": None,
+           "serving_paged": None}
     probed = {}
     for name in names:
         base = MODEL_ALIASES.get(name, name)
@@ -204,6 +243,23 @@ def main(argv=None):
         doc["serving"] = {"error": str(e)[:200]}
         failures += 1
         print(f"[FAIL] decoder_lm.decode: {e}")
+
+    try:
+        doc["serving_paged"] = probe_serving_decode_paged()
+        pbad = (doc["serving_paged"]["violations"]
+                or doc["serving_paged"].get("error"))
+        if pbad:
+            failures += 1
+        print(f"[{'FAIL' if pbad else 'ok'}] decoder_lm.decode_paged: "
+              f"{doc['serving_paged']['aliased']}/"
+              f"{doc['serving_paged']['expected']} state buffers aliased, "
+              f"{len(doc['serving_paged']['violations'])} violation(s), "
+              f"{doc['serving_paged']['kv_cache_buffers']} kv_cache "
+              f"buffer(s) ({doc['serving_paged']['kv_cache_bytes']} B)")
+    except Exception as e:
+        doc["serving_paged"] = {"error": str(e)[:200]}
+        failures += 1
+        print(f"[FAIL] decoder_lm.decode_paged: {e}")
 
     if not args.smoke:
         out = args.out or os.path.join(

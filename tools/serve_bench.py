@@ -44,10 +44,19 @@ Three phases, two JSON rows:
    the autoscaled arm's trace must show the breach-driven scale-up AND
    the drain-based scale-down in one run.
 
+6. **Paged KV cache** (the ISSUE 17 capacity arm, ``SERVE_r05.json``,
+   opt-in via ``--kv paged`` or ``--kv paged:int8``): the SERVE_r02
+   Poisson schedule replayed against the contiguous slot pool and the
+   paged pool holding the SAME KV HBM bytes; records concurrent decode
+   slots admitted from idle (and per GB of pool), occupancy, tokens/s,
+   and TTFT/ITL deltas. Acceptance: the paged pool admits >=4x the
+   concurrent slots on the mixed-length schedule.
+
     python tools/serve_bench.py                  # defaults (T=64)
     python tools/serve_bench.py --prompt-len 64 --max-new 64 --out SERVE_r01.json
     python tools/serve_bench.py --skip-decode --skip-gen --replicas 2
     python tools/serve_bench.py --skip-decode --skip-gen --autoscale
+    python tools/serve_bench.py --skip-decode --skip-gen --kv paged:int8
 """
 
 from __future__ import annotations
@@ -311,6 +320,186 @@ def bench_generation(args) -> dict:
         "ttft_p99_ratio": round(
             wave["ttft_p99_s"] / slot["ttft_p99_s"], 2)
         if slot["ttft_p99_s"] else None,
+        "steady_state_compiles": compiles1 - compiles0,
+    }
+
+
+def bench_paged(args) -> dict:
+    """ISSUE 17 (``SERVE_r05.json``, opt-in via ``--kv paged[:int8]``):
+    the SERVE_r02 Poisson schedule replayed against the contiguous slot
+    pool and the PAGED pool holding the SAME KV HBM bytes. Reports the
+    admission-capacity headline (concurrent decode slots admitted from
+    idle on the schedule's mixed-length request stream, and
+    slots-admitted-per-GB of pool), plus throughput / occupancy /
+    TTFT / ITL deltas from the live replay. The paged pool admits by
+    span (prompt bucket + token budget, in pages) instead of one
+    worst-case row per slot, so the mostly-short budget mix packs
+    several requests into the HBM one contiguous slot pins;
+    ``paged:int8`` shrinks page bytes ~4x again (per-(position, head)
+    scales ride in fp32 planes)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import engine as seng
+    from paddle_tpu.serving import metrics as smetrics
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.observability import memory as obs_memory
+
+    codec = "int8" if args.kv.endswith(":int8") else "none"
+    p_max = args.gen_prompt_len
+    n_max = args.gen_max_new
+    n_slots = args.gen_slots
+    ps = args.kv_page_size
+    cache_len = p_max + n_max
+    if cache_len % ps:
+        raise SystemExit(f"--kv-page-size {ps} must divide "
+                         f"prompt_len+max_new = {cache_len}")
+    max_pages = cache_len // ps
+    # the HBM budget: exactly the contiguous pool's fp32 page count;
+    # int8 pages cost (d_model + 4*n_head) bytes/row vs d_model*4, so
+    # the same bytes hold proportionally more pages
+    n_pages = n_slots * max_pages
+    paged_slots = 4 * n_slots
+    if codec == "int8":
+        f32_row = args.gen_d_model * 4
+        i8_row = args.gen_d_model + 4 * args.n_head
+        n_pages = n_pages * f32_row // i8_row
+        paged_slots = 8 * n_slots
+    buckets = tuple(sorted({max(1, p_max // 4), max(1, p_max // 2),
+                            p_max}))
+    cfg = dict(prompt_len=p_max, max_new=n_max, vocab=args.vocab,
+               d_model=args.gen_d_model, d_inner=4 * args.gen_d_model,
+               n_head=args.n_head, n_layer=args.gen_n_layer)
+    ctg = seng.make_slot_model(
+        "lm_ctg",
+        T.build_decoder_lm_programs(**cfg, prompt_buckets=buckets,
+                                    modes=("prefill_slot",
+                                           "decode_slot"),
+                                    n_slots=n_slots))
+    paged = seng.make_slot_model(
+        "lm_paged",
+        T.build_decoder_lm_programs(**cfg, prompt_buckets=buckets,
+                                    modes=("prefill_paged",
+                                           "decode_paged"),
+                                    n_slots=paged_slots, n_pages=n_pages,
+                                    page_size=ps, kv_codec=codec))
+    t0 = time.perf_counter()
+    ctg.warmup()
+    paged.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    # the SERVE_r02 schedule, verbatim (same seed, same mixed prompt
+    # lengths, same bimodal mostly-short budget mix)
+    rng = np.random.RandomState(0)
+    n_req = args.gen_requests
+    arrivals = np.cumsum(rng.exponential(
+        args.gen_interarrival_ms / 1000.0, n_req))
+    plens = rng.randint(3, p_max + 1, n_req)
+    short_hi = max(3, n_max // 8)
+    budgets = np.where(
+        rng.rand(n_req) < 0.75,
+        rng.randint(2, short_hi + 1, n_req),
+        rng.randint(3 * n_max // 4, n_max + 1, n_req))
+    prompts = [rng.randint(1, args.vocab, (int(l),)) for l in plens]
+
+    # -- admission capacity: admit the schedule's request stream from
+    # an idle engine WITHOUT stepping, until the engine sheds — the
+    # "concurrent decode slots inside the same HBM" witness
+    def capacity(engine) -> int:
+        engine.reset()
+        admitted = 0
+        for i in range(n_req):
+            try:
+                engine.admit(prompts[i], max_new=int(budgets[i]))
+            except seng.SlotExhaustedError:
+                break
+            admitted += 1
+        engine.reset()
+        return admitted
+
+    cap_ctg = capacity(ctg)
+    cap_paged = capacity(paged)
+    bytes_ctg = obs_memory.kv_pool_bytes(ctg.scope)
+    bytes_paged = obs_memory.kv_pool_bytes(paged.scope)
+
+    server = serving.ModelServer(linger_s=0.001, max_queue_depth=4096)
+    server.add_model(ctg)
+    server.add_model(paged)
+
+    def run_arm(model: str) -> dict:
+        futs = [None] * n_req
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            wait = arrivals[i] - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            futs[i] = server.submit_generate(
+                model, [prompts[i]], max_new=int(budgets[i]))
+        outs = [f.result(600) for f in futs]
+        elapsed = time.perf_counter() - t0
+        tokens = sum(len(o[0]) for o in outs)
+        hosted = server.model(model)
+        # ITL proxy: each scheduler step emits one token per live slot,
+        # so the mean gap between a request's tokens is the mean pool
+        # step time
+        steps = max(1, hosted.sched_steps)
+        return {
+            "requests": n_req,
+            "tokens": int(tokens),
+            "elapsed_s": round(elapsed, 3),
+            "tokens_per_s": round(tokens / elapsed, 1),
+            "ttft_p50_s": smetrics.histogram_percentile(
+                smetrics.TTFT, 0.5, model=model),
+            "ttft_p99_s": smetrics.histogram_percentile(
+                smetrics.TTFT, 0.99, model=model),
+            "itl_mean_s": round(elapsed / steps, 5),
+            "mean_slot_occupancy": round(hosted.mean_occupancy(), 3),
+            "sched_steps": hosted.sched_steps,
+        }
+
+    compiles0 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    with serving.forbid_compiles():
+        ctg_row = run_arm("lm_ctg")
+        paged_row = run_arm("lm_paged")
+    compiles1 = sum(c.value for c in
+                    smetrics.COMPILATIONS.children().values())
+    pool_stats = paged.pool.stats()
+    server.stop()
+
+    gb = 1024.0 ** 3
+    ctg_row.update({
+        "n_slots": n_slots, "kv_pool_bytes": bytes_ctg,
+        "concurrent_slots_admitted": cap_ctg,
+        "slots_admitted_per_gb": round(cap_ctg / (bytes_ctg / gb), 1)})
+    paged_row.update({
+        "n_slots": paged_slots, "n_pages": n_pages, "page_size": ps,
+        "codec": codec, "kv_pool_bytes": bytes_paged,
+        "concurrent_slots_admitted": cap_paged,
+        "slots_admitted_per_gb": round(cap_paged / (bytes_paged / gb),
+                                       1),
+        "pool_stats_after": pool_stats})
+    return {
+        "config": {"prompt_len": p_max, "max_new": n_max,
+                   "cache_len": cache_len,
+                   "prompt_buckets": list(buckets), "requests": n_req,
+                   "interarrival_ms": args.gen_interarrival_ms,
+                   "vocab": args.vocab, "d_model": args.gen_d_model,
+                   "n_head": args.n_head, "n_layer": args.gen_n_layer,
+                   "kv": args.kv},
+        "warmup_s": round(warmup_s, 3),
+        "contiguous": ctg_row,
+        "paged": paged_row,
+        "concurrent_slots_ratio": round(cap_paged / max(1, cap_ctg), 2),
+        "slots_per_gb_ratio": round(
+            paged_row["slots_admitted_per_gb"]
+            / max(1e-9, ctg_row["slots_admitted_per_gb"]), 2),
+        "tokens_per_s_ratio": round(
+            paged_row["tokens_per_s"] / ctg_row["tokens_per_s"], 2),
+        "ttft_p99_delta_s": (
+            round(paged_row["ttft_p99_s"] - ctg_row["ttft_p99_s"], 4)
+            if paged_row["ttft_p99_s"] and ctg_row["ttft_p99_s"]
+            else None),
+        "itl_mean_delta_s": round(
+            paged_row["itl_mean_s"] - ctg_row["itl_mean_s"], 5),
         "steady_state_compiles": compiles1 - compiles0,
     }
 
@@ -603,6 +792,15 @@ def main(argv=None):
     ap.add_argument("--gen-requests", type=int, default=96)
     ap.add_argument("--gen-interarrival-ms", type=float, default=2.0,
                     help="mean Poisson inter-arrival time")
+    ap.add_argument("--kv", default="",
+                    choices=["", "paged", "paged:int8"],
+                    help="run the paged-KV arm (ISSUE 17): the "
+                         "SERVE_r02 Poisson schedule against contiguous "
+                         "vs paged pools at the SAME KV HBM bytes -> "
+                         "SERVE_r05.json ('' = skip)")
+    ap.add_argument("--kv-page-size", type=int, default=4,
+                    help="KV page size (tokens) for the paged arm; must "
+                         "divide prompt_len+max_new")
     ap.add_argument("--replicas", type=int, default=0,
                     help="run the replicated-router arm with N replica "
                          "processes (0 = skip; ISSUE 13)")
@@ -630,6 +828,7 @@ def main(argv=None):
     ap.add_argument("--gen-out", default="SERVE_r02.json")
     ap.add_argument("--router-out", default="SERVE_r03.json")
     ap.add_argument("--autoscale-out", default="SERVE_r04.json")
+    ap.add_argument("--kv-out", default="SERVE_r05.json")
     args = ap.parse_args(argv)
 
     def _resolve(path):
@@ -664,6 +863,24 @@ def main(argv=None):
         print(f"serve_bench: slot scheduler {ratio}x aggregate tokens/s "
               f"vs wave-per-batch under Poisson load "
               f"({'>=2x OK' if ratio >= 2 else 'BELOW the 2x target'})")
+
+    if args.kv:
+        krow = {"bench": "serving_paged_kv",
+                "device": os.environ.get("JAX_PLATFORMS", "auto"),
+                "paged_kv": bench_paged(args)}
+        with open(_resolve(args.kv_out), "w") as f:
+            json.dump(krow, f, indent=2)
+            f.write("\n")
+        print(json.dumps(krow, indent=2))
+        k = krow["paged_kv"]
+        ratio = k["concurrent_slots_ratio"]
+        print(f"serve_bench: paged KV ({args.kv}) — "
+              f"{k['paged']['concurrent_slots_admitted']} concurrent "
+              f"slots vs {k['contiguous']['concurrent_slots_admitted']} "
+              f"contiguous in the same KV HBM ({ratio}x, "
+              f"{'>=4x OK' if ratio >= 4 else 'BELOW the 4x target'}); "
+              f"slots/GB ratio {k['slots_per_gb_ratio']}x, "
+              f"{k['steady_state_compiles']} steady-state compile(s)")
 
     if args.replicas:
         rrow = {"bench": "serving_router",

@@ -39,6 +39,8 @@ LINT_SERVING_MODULES = (
     "paddle_tpu.models.transformer:serve_lint_decode",
     "paddle_tpu.models.transformer:serve_lint_prefill_slot",
     "paddle_tpu.models.transformer:serve_lint_decode_slot",
+    "paddle_tpu.models.transformer:serve_lint_prefill_paged",
+    "paddle_tpu.models.transformer:serve_lint_decode_paged",
 )
 
 # a sharded-lookup training program (table marked __sharded__, lazy-adam
@@ -116,6 +118,20 @@ def run_lint_gate(root: str, timeout: int) -> int:
             [sys.executable, os.path.join(root, "tools", "proglint.py"),
              "--memory", "--is-test", "--module",
              "paddle_tpu.models.transformer:serve_lint_decode"],
+            cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # same donation audit over the PAGED decode program — the shared
+        # page pool (and the int8 scale planes, when configured) must
+        # keep aliasing in input_output_alias across the page-table
+        # gather/scatter rewrite (ISSUE 17; docs/serving.md "Paged KV
+        # cache")
+        print("test_runner: lint gate — proglint --memory over the "
+              "paged decode program")
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "proglint.py"),
+             "--memory", "--is-test", "--module",
+             "paddle_tpu.models.transformer:serve_lint_decode_paged"],
             cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
