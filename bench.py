@@ -348,6 +348,36 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
 
     assert np.all(np.isfinite(losses)), "loss went non-finite"
 
+    # DP scaling: the mesh arm's per-chip throughput over a single-chip
+    # reference arm at the per-chip batch (the v5e-64 ≥90% headline,
+    # ROADMAP item 1; tools/spmd_bench.py sweeps the full curve).
+    # Single-chip rows report None — the column only means something
+    # when a mesh actually ran.
+    dp_scaling_pct = None
+    if mesh is not None and n_chips > 1:
+        try:
+            from paddle_tpu.core.scope import Scope as _Scope
+            ref_bs = max(batch_size // n_chips, 1)
+            exe1 = fluid.Executor(fluid.TPUPlace())
+            scope1 = _Scope()
+            exe1.run(startup, scope=scope1)
+            feeds1 = _device_batch(exe1, feed_specs, ref_bs,
+                                   int_ranges=int_ranges, stack_int=chunk)
+
+            def run_ref():
+                return exe1.run(main, feed=feeds1, fetch_list=[loss],
+                                iterations=chunk, stacked_feed=int_names,
+                                return_numpy=False, scope=scope1)[0]
+
+            n1, dt1, _ = _time_chunks(run_ref, fence, min_seconds=1.5,
+                                      warmup=2)
+            ref_rate = ref_bs * n1 * chunk / dt1     # examples/s, 1 chip
+            mesh_rate = batch_size * nsteps / dt     # examples/s, n chips
+            if ref_rate > 0:
+                dp_scaling_pct = mesh_rate / (n_chips * ref_rate) * 100
+        except Exception:
+            dp_scaling_pct = None
+
     # MFU: analytic model FLOPs (2 FLOPs/MAC, backward = 2x forward —
     # paddle_tpu.utils.flops docstring spells out the convention; XLA's own
     # compiled-executable cost analysis agrees within ~3% on ResNet-50)
@@ -399,6 +429,8 @@ def run_bench(model_name: str, batch_size: int, steps: int, warmup: int = 5,
         "unit": unit,
         "vs_baseline": round(float(value / baseline), 2) if baseline else None,
         "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
+        "dp_scaling_pct": (round(dp_scaling_pct, 1)
+                           if dp_scaling_pct is not None else None),
         "peak_hbm_bytes": peak_hbm_bytes,
         "hbm_pct": round(hbm_pct, 1) if hbm_pct is not None else None,
         "gather_bytes_per_s": (round(gather_bps, 0)
@@ -614,6 +646,8 @@ def aggregate_line(rows, head, n_ok):
              "u": r.get("unit")}
         if r.get("mfu_pct") is not None:
             c["mfu"] = r["mfu_pct"]
+        if r.get("dp_scaling_pct") is not None:
+            c["dp"] = r["dp_scaling_pct"]
         if r.get("bw_pct") is not None:
             c["bw"] = r["bw_pct"]
         if r.get("hbm_pct") is not None:
@@ -690,6 +724,12 @@ def main():
                     help="allowed fractional shortfall per row before "
                          "--check fails (default 0.08 — run-to-run "
                          "variance on the tunnel is ~±5%%)")
+    ap.add_argument("--chips", type=int, default=0,
+                    help="train over a dp mesh of this many chips (one "
+                         "SPMD dispatch, docs/performance.md 'SPMD "
+                         "execution'); the row gains dp_scaling_pct vs "
+                         "an inline single-chip reference arm. 0 "
+                         "(default) keeps the single-chip row")
     args = ap.parse_args()
 
     def run_one_subprocess(m, infer=False, coldstart=False):
@@ -848,9 +888,15 @@ def main():
                                  nhwc=args.nhwc, passes_spec=args.passes)
     else:
         bs = args.batch_size or DEFAULT_BATCH_SIZES[args.model]
+        mesh = None
+        if args.chips and args.chips > 1:
+            import jax
+            from paddle_tpu.parallel import make_mesh
+            mesh = make_mesh({"dp": args.chips},
+                             devices=jax.devices()[:args.chips])
         result = run_bench(args.model, bs, args.steps, amp=args.amp,
                            nhwc=args.nhwc, batch_merge=args.batch_merge,
-                           passes_spec=args.passes)
+                           passes_spec=args.passes, mesh=mesh)
     print(json.dumps(result))
 
 

@@ -99,8 +99,13 @@ class Executor:
     def _compiled(self, program, feed_names, fetch_names, is_test: bool):
         desc = program.desc if hasattr(program, "desc") else program
         dist = getattr(program, "dist_config", None)
+        # the HBM budget participates in sharding selection (the
+        # dp->ZeRO->tp ladder runs at CompiledBlock build), so a changed
+        # budget must recompile, not replay a plan chosen under the old one
+        from paddle_tpu import flags as _flags
+        budget = _flags.get("hbm_bytes") if dist is not None else None
         key = (desc.version_token, tuple(feed_names), tuple(fetch_names),
-               is_test, self._dist_key(dist))
+               is_test, self._dist_key(dist), budget)
         cb = self._cache.get(key)
         if cb is None:
             cb = CompiledBlock(desc, 0, feed_names, fetch_names,
@@ -379,6 +384,22 @@ class Executor:
                     sh = (stacked_sharding(name) if is_stacked(name)
                           else cb.feed_sharding(name))
                 if sh is not None:
+                    try:
+                        same = val.sharding.is_equivalent_to(sh, val.ndim)
+                    except Exception:
+                        same = val.sharding == sh
+                    if not same:
+                        # committed single-device (or differently-sharded)
+                        # feed moving to the program's layout: a real
+                        # device-to-device reshard, counted
+                        try:
+                            from paddle_tpu.observability import (
+                                spmd as _obs_spmd)
+                            _obs_spmd.note_resharding(
+                                cb.obs_label,
+                                int(getattr(val, "nbytes", 0) or 0))
+                        except Exception:
+                            pass
                     val = jax.device_put(val, sh)
                 feeds[name] = val
                 continue

@@ -17,6 +17,11 @@ class Scope:
         self._parent = parent
         self._vars: Dict[str, Any] = {}
         self._kids: List["Scope"] = []
+        # monotonic mutation counter: every set_var/erase bumps it, so a
+        # compiled step's device-resident state cache (core/lowering.py)
+        # can detect external writes between dispatches without walking
+        # or comparing the var dict
+        self._mutations = 0
 
     # reference: scope.h:56 NewScope
     def new_scope(self) -> "Scope":
@@ -26,7 +31,19 @@ class Scope:
 
     # reference: scope.h Var()
     def set_var(self, name: str, value) -> None:
+        self._mutations += 1
         self._vars[name] = value
+
+    def version(self) -> int:
+        """Mutation clock covering this scope AND its parent chain
+        (find_var resolves through parents, so a parent write must
+        invalidate a child-keyed state cache too)."""
+        v = 0
+        s: Optional[Scope] = self
+        while s is not None:
+            v += s._mutations
+            s = s._parent
+        return v
 
     # reference: scope.h FindVar — walks up the parent chain
     def find_var(self, name: str):
@@ -41,6 +58,7 @@ class Scope:
         return self.find_var(name) is not None
 
     def erase(self, names) -> None:
+        self._mutations += 1
         for n in names:
             self._vars.pop(n, None)
 

@@ -207,6 +207,17 @@ define("embed_exchange_codec", str, "none",
        "exact-dense control arm), 'bf16' truncates to 2 bytes/elem, "
        "'int8' ships int8 codes + one fp32 scale per row "
        "(EQuARX-style). Applies to pull_rows AND push_rows payloads.")
+define("grad_allreduce_codec", str, "none",
+       "Wire codec for the explicit gradient allreduce "
+       "(parallel/collective.py grad_all_reduce — the shard_map-island "
+       "exchange used when the data axis crosses DCN): 'none' reduces "
+       "fp32 (the exact arm; GSPMD's implicit ICI psum is identical), "
+       "'bf16' reduces in bfloat16 (2 bytes/elem on the wire), 'int8' "
+       "ships int8 codes + one fp32 scale per row and dequant-sums "
+       "locally — the per-row-scale discipline of "
+       "FLAGS_embed_exchange_codec applied to gradients (EQuARX, "
+       "arXiv:2506.17615). Parity contract: "
+       "tests/test_spmd_exec.py codec window.")
 define("kv_cache_layout", str, "contiguous",
        "Decode KV-cache layout for the slot-pool serving engine "
        "(serving/engine.py): 'contiguous' reserves one worst-case "

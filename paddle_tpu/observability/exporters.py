@@ -247,6 +247,10 @@ def _preregister_catalog():
                 # (paddle_trace_dropped_spans_total) — silent span loss
                 # is a lying timeline, so it's part of the catalog
                 "paddle_tpu.observability.tracing",
+                # SPMD families (paddle_spmd_*): mesh size and the
+                # entry-reshard byte counter that witnesses
+                # device-resident state (docs/performance.md)
+                "paddle_tpu.observability.spmd",
                 "paddle_tpu.distributed.resilience",
                 "paddle_tpu.distributed.async_pserver",
                 "paddle_tpu.data.master_service",

@@ -1,0 +1,39 @@
+"""SPMD execution telemetry (docs/performance.md "SPMD execution").
+
+Two families witness the multi-chip execution contract:
+
+- ``paddle_spmd_mesh_devices`` — devices of the mesh the most recently
+  built CompiledBlock compiled over (0 until a sharded program builds);
+- ``paddle_spmd_resharding_bytes_total{program}`` — bytes of dispatch
+  inputs that arrived in a different layout than the program's
+  NamedSharding and were resharded on entry by jit. The startup->
+  training-layout move on the FIRST dispatch is expected here; a
+  counter that keeps advancing means state is bouncing layouts every
+  step — the device-resident state cache (core/lowering.py) is being
+  defeated by external scope writes.
+
+Import-light on purpose: the exporter catalog preregisters this module
+so both families appear at zero in any scrape.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.observability import metrics as _metrics
+
+MESH_DEVICES = _metrics.gauge(
+    "paddle_spmd_mesh_devices",
+    "devices in the mesh of the most recently compiled sharded program")
+
+RESHARD_BYTES = _metrics.counter(
+    "paddle_spmd_resharding_bytes_total",
+    "bytes of dispatch inputs resharded on entry because they arrived "
+    "in a different layout than the program's NamedSharding, per "
+    "program", ("program",))
+
+
+def note_mesh(n_devices: int) -> None:
+    MESH_DEVICES.set(int(n_devices))
+
+
+def note_resharding(program: str, nbytes: int) -> None:
+    RESHARD_BYTES.labels(program=program).inc(int(nbytes))

@@ -68,3 +68,49 @@ def broadcast(x, axis_name: str, root: int = 0):
     idx = lax.axis_index(axis_name)
     masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
     return lax.psum(masked, axis_name)
+
+
+def grad_all_reduce(x, axis_name: str, codec: str = None):
+    """Gradient allreduce with a flagged wire codec — the DCN-bound
+    option for shard_map-partitioned training steps (inside the plain
+    jit/NamedSharding path GSPMD inserts the gradient psum itself and
+    this helper is not on the path; docs/performance.md "SPMD
+    execution" > "Quantized gradient allreduce").
+
+    codec (default: FLAGS_grad_allreduce_codec):
+    - ``none``  — fp32 ``psum``, bit-identical to the implicit exchange;
+    - ``bf16``  — reduce in bfloat16: 2 bytes/elem on the wire, result
+      cast back to the input dtype;
+    - ``int8``  — EQuARX-style block quantization with block = row
+      (the ``FLAGS_embed_exchange_codec`` discipline, PR 14): each rank
+      ships int8 codes plus one fp32 max-abs/127 scale per row of its
+      addend, every rank dequant-sums the gathered codes locally. The
+      sum itself stays fp32, so codec error is bounded per addend, not
+      compounded by the reduction.
+
+    Returns the SUM over `axis_name` (callers scale by 1/n for the
+    mean, matching the reference's 1/nranks gradient scaling)."""
+    import jax.numpy as jnp
+    if codec is None:
+        from paddle_tpu import flags
+        codec = flags.get("grad_allreduce_codec")
+    if codec in (None, "", "none"):
+        return lax.psum(x, axis_name)
+    if codec == "bf16":
+        return lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    if codec == "int8":
+        orig_dtype = x.dtype
+        shape = x.shape
+        x2d = x.reshape((shape[0], -1)) if x.ndim >= 2 \
+            else x.reshape((1, -1))
+        scale = jnp.max(jnp.abs(x2d), axis=1, keepdims=True) / 127.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(x2d / safe), -127, 127).astype(jnp.int8)
+        # wire: [n, rows, cols] int8 codes + [n, rows, 1] fp32 scales
+        qg = lax.all_gather(x=q, axis_name=axis_name, axis=0, tiled=False)
+        sg = lax.all_gather(x=scale, axis_name=axis_name, axis=0,
+                            tiled=False)
+        total = jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+        return total.reshape(shape).astype(orig_dtype)
+    raise ValueError(f"unknown grad allreduce codec {codec!r} "
+                     f"(expected none|bf16|int8)")

@@ -235,10 +235,16 @@ class GenerativeModel:
 
     def __init__(self, name: str, programs: Dict,
                  policy: Optional[bucketing.BucketPolicy] = None,
-                 scope=None, init: bool = True):
+                 scope=None, init: bool = True, dist=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.core.lowering import CompiledBlock
         self.name = name
+        # optional SPMD serving: a DistributeConfig lowers every view
+        # through the one-dispatch mesh path of core/lowering.py — the
+        # params and KV caches live sharded over the mesh and each
+        # prefill/decode is a single jit call (docs/serving.md "Serving
+        # over a mesh"). None (default) keeps single-device serving.
+        self.dist = dist
         self.policy = policy or bucketing.BucketPolicy()
         self.scope = scope or fluid.Scope()
         # prompt-length bucket ladder: every "prefill@P" view (the bare
@@ -265,11 +271,11 @@ class GenerativeModel:
         obs_memory.note_scope(self.scope)
         self._cb_prefill = {
             p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
-                             is_test=True, donate=False)
+                             is_test=True, donate=False, dist=dist)
             for p, (m, _s, feeds, fetch) in pre.items()}
         self._cb_decode = CompiledBlock(
             dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
-            is_test=True, donate=True)
+            is_test=True, donate=True, dist=dist)
         # max_new from the cache length the decode block declares
         cache_vars = [v for n, v in dec_main.desc.global_block.vars.items()
                       if n.endswith("_cache_k_0")]
@@ -281,7 +287,7 @@ class GenerativeModel:
             full_main, _, full_feeds, full_fetch = programs["full"]
             self._full = CompiledBlock(
                 full_main.desc, 0, sorted(full_feeds), [full_fetch],
-                is_test=True, donate=False)
+                is_test=True, donate=False, dist=dist)
         self._warmed: set = set()   # ("prefill", bucket, P) | ("decode", bucket)
         self._aot: Dict[Tuple, object] = {}
         self._fingerprint = hashlib.sha256(json.dumps(
@@ -298,6 +304,19 @@ class GenerativeModel:
     def _run(self, cb, aot_key, feeds) -> np.ndarray:
         from paddle_tpu.observability import memory as obs_memory
         from paddle_tpu.utils import faults
+        plan = None
+        dist = getattr(self, "dist", None)
+        if dist is not None and getattr(dist, "mesh", None) is not None:
+            ax = dist.data_axis
+            if ax and ax in dist.mesh.axis_names:
+                # a wave batch not divisible by the data axis pads to
+                # the next multiple and slices the padded rows back off
+                # the fetch — the executor's pad-and-slice discipline
+                # (utils/padding.py). Slot engines have a fixed
+                # [n_slots] geometry: size n_slots divisible by the
+                # data axis and this is a no-op.
+                feeds, plan = _padding.pad_feeds_to_multiple(
+                    feeds, int(dist.mesh.shape[ax]))
         args = self._args(cb, feeds)
         try:
             # chaos site for the serving OOM-forensics path
@@ -320,7 +339,10 @@ class GenerativeModel:
             raise
         for n, v in new_state.items():
             self.scope.set_var(n, v)
-        return np.asarray(fetches[0])
+        out = np.asarray(fetches[0])
+        if plan is not None:
+            out = plan.slice_fetch(out)
+        return out
 
     def _dispatch(self, kind: str, bucket: int, feeds,
                   p_len: Optional[int] = None) -> np.ndarray:
@@ -582,10 +604,11 @@ class SlotGenerativeModel:
     DECODE = "decode_slot"
 
     def __init__(self, name: str, programs: Dict, scope=None,
-                 init: bool = True):
+                 init: bool = True, dist=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.core.lowering import CompiledBlock
         self.name = name
+        self.dist = dist          # same contract as GenerativeModel.dist
         pk, dk = self.PREFILL, self.DECODE
         pre = {}
         for key, val in programs.items():
@@ -617,11 +640,11 @@ class SlotGenerativeModel:
             obs_memory.kv_pool_bytes(self.scope, name)
         self._cb_prefill = {
             p: CompiledBlock(m.desc, 0, sorted(feeds), [fetch],
-                             is_test=True, donate=True)
+                             is_test=True, donate=True, dist=dist)
             for p, (m, _s, feeds, fetch) in pre.items()}
         self._cb_decode = CompiledBlock(
             dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
-            is_test=True, donate=True)
+            is_test=True, donate=True, dist=dist)
         self._discover_pool(dec_main, dec_feeds)
         self._warmed: set = set()
         self._aot: Dict[Tuple, object] = {}
@@ -1080,14 +1103,16 @@ class PagedSlotGenerativeModel(SlotGenerativeModel):
 
 
 def make_slot_model(name: str, programs: Dict, scope=None,
-                    init: bool = True) -> SlotGenerativeModel:
+                    init: bool = True, dist=None) -> SlotGenerativeModel:
     """Build the slot engine matching ``programs``' layout: paged views
     (``prefill_paged``/``decode_paged``, from ``FLAGS_kv_cache_layout=
     paged`` via ``transformer.slot_modes()``) get
     :class:`PagedSlotGenerativeModel`; the contiguous slot views get
-    :class:`SlotGenerativeModel`."""
+    :class:`SlotGenerativeModel`. ``dist`` (a ``DistributeConfig``)
+    lowers every view over its mesh — see docs/serving.md."""
     if any(k == "decode_paged" or k == "prefill_paged"
            or k.startswith("prefill_paged@") for k in programs):
         return PagedSlotGenerativeModel(name, programs, scope=scope,
-                                        init=init)
-    return SlotGenerativeModel(name, programs, scope=scope, init=init)
+                                        init=init, dist=dist)
+    return SlotGenerativeModel(name, programs, scope=scope, init=init,
+                               dist=dist)

@@ -88,6 +88,11 @@ CONTEXT_OPS = {
     # switch_moe), trained end-to-end over a pp x ep mesh
     "pipeline": "test_parallel_layers.py",
     "moe_ffn": "test_parallel_layers.py",
+    # paged KV attention reads/writes a PagePool-owned page table whose
+    # geometry (page rows, sentinel clamps, scale planes) only exists in
+    # a full paged engine build; driven end-to-end vs the wave oracle
+    "kv_attention_prefill_paged": ("test_kv_pool.py", "prefill_paged"),
+    "kv_attention_decode_paged": ("test_kv_pool.py", "decode_paged"),
 }
 
 

@@ -141,6 +141,65 @@ def _memory_audit(label, main, startup, feed_names):
     return cb.donation_audit(scope, feeds)
 
 
+def _sharding_audit(label, main, startup, feed_names):
+    """SPMD sharding audit of one main+startup pair: lower the main
+    program under a dp mesh spanning every visible device and check that
+
+    - every persistable the compiled step touches (state + consts)
+      resolves to a concrete ``NamedSharding`` carrying a
+      ``PartitionSpec`` — the restore-with-resharding and
+      device-placement contract (core/lowering.py param_sharding);
+    - when ``FLAGS_hbm_bytes`` names a per-device budget, the
+      budget-ladder plan (``cb.hbm_plan``) actually fits, and no var the
+      budget forced off replication (``must_shard``) is still silently
+      replicated.
+
+    Nothing executes — specs are derived at build time, before the jit
+    ever compiles (docs/performance.md "SPMD execution")."""
+    import jax
+    from paddle_tpu.core.lowering import CompiledBlock
+    from paddle_tpu.parallel import DistributeConfig, make_mesh
+
+    desc = main.desc if hasattr(main, "desc") else main
+    inferred_feeds, fetch_names = _infer_io(desc)
+    feed_names = sorted(feed_names) if feed_names else inferred_feeds
+    mesh = make_mesh({"dp": len(jax.devices())})
+    dist = DistributeConfig(mesh=mesh, data_axis="dp")
+    desc._obs_name = label
+    cb = CompiledBlock(desc, 0, feed_names, fetch_names,
+                       is_test=bool(getattr(main, "_is_test", False)),
+                       dist=dist)
+    names = sorted(set(cb.sig.state_names) | set(cb.sig.const_names))
+    unresolved, replicated = [], []
+    for n in names:
+        try:
+            sh = cb.param_sharding(n)
+        except Exception:
+            sh = None
+        if sh is None or getattr(sh, "spec", None) is None:
+            unresolved.append(n)
+        elif not tuple(sh.spec):
+            replicated.append(n)
+    violations = []
+    plan = cb.hbm_plan
+    if plan is not None:
+        if not plan["fits"]:
+            over = [n for n in replicated if n not in unresolved]
+            violations.append(
+                f"no rung fits FLAGS_hbm_bytes={plan['budget_bytes']:.4g} "
+                f"(chosen {plan['chosen']!r} needs "
+                f"{plan['per_device_state_bytes']} state bytes/device); "
+                f"replicated: {over[:8]}")
+        still = [n for n in plan["must_shard"] if n in replicated]
+        if still:
+            violations.append(
+                f"budget says these must shard but they resolved "
+                f"replicated: {still}")
+    return {"n_vars": len(names), "n_devices": mesh.size,
+            "unresolved": unresolved, "n_replicated": len(replicated),
+            "plan": plan, "violations": violations}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="proglint", description=__doc__,
@@ -179,6 +238,14 @@ def main(argv=None):
                          "alias in the compiled executable's "
                          "input_output_alias header "
                          "(docs/observability.md, Memory observability)")
+    ap.add_argument("--sharding", action="store_true",
+                    help="SPMD sharding audit: lower each main program "
+                         "under a dp mesh over every visible device and "
+                         "FAIL if a state/const persistable does not "
+                         "resolve to a PartitionSpec, or if "
+                         "FLAGS_hbm_bytes is set and the budget ladder "
+                         "leaves a must-shard var silently replicated "
+                         "(docs/performance.md, SPMD execution)")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too")
     ap.add_argument("--json", action="store_true",
@@ -283,7 +350,40 @@ def main(argv=None):
             print(line)
             n_mem += len(bad)
 
-    if n_err or n_mem or (args.strict and n_warn):
+    n_shard = 0
+    if args.sharding:
+        for name, program, feeds, _fetches in targets:
+            if name.endswith(":startup"):
+                continue
+            base = name[:-5] if name.endswith(":main") else name
+            startup = next((p for n2, p, _f, _ in targets
+                            if n2 == f"{base}:startup"), None)
+            try:
+                audit = _sharding_audit(base, program, startup, feeds)
+            except Exception as e:
+                print(f"[FAIL] {base}: sharding audit error: {e}")
+                n_shard += 1
+                continue
+            bad = list(audit["unresolved"]) + list(audit["violations"])
+            status = "FAIL" if bad else "ok"
+            n_ok = audit["n_vars"] - len(audit["unresolved"])
+            line = (f"[{status}] {base}: sharding audit — "
+                    f"{n_ok}/{audit['n_vars']} persistables resolve to "
+                    f"a PartitionSpec on {audit['n_devices']} device(s), "
+                    f"{audit['n_replicated']} replicated")
+            plan = audit.get("plan")
+            if plan:
+                line += (f", hbm plan: {plan['chosen']} "
+                         f"({plan['per_device_state_bytes']} B/device, "
+                         f"fits={plan['fits']})")
+            print(line)
+            if audit["unresolved"]:
+                print(f"    unresolved: {sorted(audit['unresolved'])}")
+            for v in audit["violations"]:
+                print(f"    {v}")
+            n_shard += len(bad)
+
+    if n_err or n_mem or n_shard or (args.strict and n_warn):
         return 1
     return 0
 

@@ -135,6 +135,29 @@ def run_lint_gate(root: str, timeout: int) -> int:
             cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
+        # SPMD gates, on 8 virtual CPU devices (the same harness the
+        # multi-chip tests use — tests/conftest.py): proglint --sharding
+        # proves every persistable of the example programs resolves to a
+        # PartitionSpec under a dp mesh, then the smoke trains mnist one
+        # step over dp=8 and demands bit-parity with the single-device
+        # oracle plus zero steady-state recompiles under forbid_compiles
+        # (docs/performance.md "SPMD execution")
+        spmd_env = dict(env)
+        spmd_env["XLA_FLAGS"] = (
+            spmd_env.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+        print(f"test_runner: lint gate — proglint --sharding over "
+              f"{list(LINT_MODELS)} (8 virtual devices)")
+        r = subprocess.run(cmd + ["--sharding"], cwd=root,
+                           timeout=timeout, env=spmd_env)
+        if r.returncode:
+            return r.returncode
+        print("test_runner: lint gate — SPMD smoke (dp=8 mnist parity "
+              "+ zero steady-state recompiles)")
+        r = subprocess.run([sys.executable, "-c", _SPMD_SMOKE],
+                           cwd=root, timeout=timeout, env=spmd_env)
+        if r.returncode:
+            return r.returncode
         # pass-pipeline smoke: apply ALL passes to the example programs
         # and lint the post-pass programs, under the autotune
         # measurement-forbidden guard — proves (a) the rewritten zoo
@@ -214,6 +237,65 @@ def run_lint_gate(root: str, timeout: int) -> int:
         return r.returncode
     except subprocess.TimeoutExpired:
         sys.exit(f"test_runner: lint gate exceeded {timeout}s")
+
+
+# the SPMD smoke: one jit dispatch under Mesh + NamedSharding is the
+# PRODUCT path (ISSUE 18) — train mnist one step over a dp=8 mesh of
+# virtual CPU devices and demand (a) the loss bit-match (rtol 1e-6
+# ceiling) the single-device oracle, (b) further steps perform ZERO new
+# XLA compiles (embed_cache.compile_count, the backend_compile_duration
+# listener) with the serving forbid_compiles guard held
+_SPMD_SMOKE = """
+import numpy as np
+import jax
+assert len(jax.devices()) == 8, jax.devices()
+import paddle_tpu.fluid as fluid
+from paddle_tpu import models
+from paddle_tpu.parallel import DistributeConfig, make_mesh
+from paddle_tpu.ops.embed_cache import compile_count
+from paddle_tpu.serving.metrics import forbid_compiles
+
+rng = np.random.RandomState(0)
+feeds = {"pixel": rng.rand(32, 1, 28, 28).astype("float32"),
+         "label": rng.randint(0, 10, (32, 1)).astype("int64")}
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        loss, _, _ = models.mnist.build()
+    return main, startup, loss
+
+main, startup, loss = build()
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.TPUPlace())
+exe.run(startup, scope=scope)
+ref = np.asarray(exe.run(main, feed=feeds, fetch_list=[loss],
+                         scope=scope)[0])
+
+main, startup, loss = build()
+mesh = make_mesh({"dp": 8})
+prog = fluid.CompiledProgram(main).with_sharding(
+    DistributeConfig(mesh=mesh, data_axis="dp"))
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.TPUPlace())
+exe.run(startup, scope=scope)
+got = np.asarray(exe.run(prog, feed=feeds, fetch_list=[loss],
+                         scope=scope)[0])
+assert np.all(np.isfinite(got)), got
+np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+base = compile_count()
+with forbid_compiles():
+    for _ in range(3):
+        last = np.asarray(exe.run(prog, feed=feeds, fetch_list=[loss],
+                                  scope=scope)[0])
+delta = compile_count() - base
+assert delta == 0, f"{delta} steady-state recompiles"
+assert np.all(np.isfinite(last)), last
+print("spmd smoke ok: dp=8 one-step parity, 0 steady-state recompiles")
+"""
 
 
 # the trace smoke run: one process plays both roles (two spool files =
