@@ -796,6 +796,71 @@ def kv_attention_decode_paged(x, page_table, pos, seq_len, gen_start,
     return out
 
 
+def kv_attention_verify(x, pos, seq_len, gen_start, active, win_len,
+                        d_model, n_head, cache_k, cache_v,
+                        param_attr=None, name=None):
+    """Speculative-decode verify step (ISSUE 19) over the contiguous KV
+    cache: score a [B, K+1] token window — position 0 the row's last
+    committed token, positions 1..K the drafts — in ONE causal dispatch,
+    writing window position i's k/v at cache row ``pos + i`` where
+    ``active`` and ``i < win_len``. Position i attends over
+    {j < seq_len} ∪ {gen_start <= j <= pos + i}, so its output is
+    bit-identical to i sequential ``kv_attention_decode`` steps over the
+    same tokens — the losslessness guarantee the engine's accept rule
+    rests on. Rollback of rejected positions is overwrite-in-place: they
+    sit above the committed frontier and the mask never admits them.
+    x [B, K+1, M], pos/seq_len/gen_start/active/win_len [B, 1] int ->
+    [B, K+1, M] (ops/kv_attention.py; docs/serving.md 'Speculative
+    decoding')."""
+    helper = LayerHelper("kv_attention_verify", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kv_attention_verify",
+                     inputs={"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+                             "Wv": [ws[2]], "Wo": [ws[3]],
+                             "CacheK": [cache_k], "CacheV": [cache_v],
+                             "Pos": [pos], "SeqLen": [seq_len],
+                             "GenStart": [gen_start],
+                             "Active": [active], "WinLen": [win_len]},
+                     outputs={"Out": [out], "CacheKOut": [cache_k],
+                              "CacheVOut": [cache_v]},
+                     attrs={"n_head": int(n_head)})
+    return out
+
+
+def kv_attention_verify_paged(x, page_table, pos, seq_len, gen_start,
+                              active, win_len, d_model, n_head, page_k,
+                              page_v, page_ks=None, page_vs=None,
+                              codec="none", param_attr=None, name=None):
+    """Speculative-decode verify over the PAGED KV pool: window geometry
+    identical to ``kv_attention_verify``, each window position's write
+    row resolved through the page-table feed. Writes that fall past the
+    slot's leased span resolve to the sentinel page and DROP — a draft
+    window can never write another slot's pages (admission reserves the
+    draft-window overshoot, ``PagePool.span_for(draft_window=K)``).
+    x [B, K+1, M], page_table [B, max_pages] int,
+    pos/seq_len/gen_start/active/win_len [B, 1] int -> [B, K+1, M]
+    (ops/kv_attention.py; docs/serving.md 'Speculative decoding')."""
+    helper = LayerHelper("kv_attention_verify_paged", name=name)
+    ws = _attention_projection_params(helper, d_model, param_attr)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x], "Wq": [ws[0]], "Wk": [ws[1]],
+              "Wv": [ws[2]], "Wo": [ws[3]],
+              "PageK": [page_k], "PageV": [page_v],
+              "PageTable": [page_table], "Pos": [pos],
+              "SeqLen": [seq_len], "GenStart": [gen_start],
+              "Active": [active], "WinLen": [win_len]}
+    outputs = {"Out": [out], "PageKOut": [page_k],
+               "PageVOut": [page_v]}
+    if codec == "int8":
+        inputs["PageKS"], inputs["PageVS"] = [page_ks], [page_vs]
+        outputs["PageKSOut"], outputs["PageVSOut"] = [page_ks], [page_vs]
+    helper.append_op("kv_attention_verify_paged",
+                     inputs=inputs, outputs=outputs,
+                     attrs={"n_head": int(n_head), "codec": str(codec)})
+    return out
+
+
 def token_sample(logits, temperature, top_k, seed, step_idx, name=None):
     """On-device next-token selection (ops/kv_attention.py): greedy
     argmax when ``temperature <= 0`` or ``top_k == 1`` (bit-identical to

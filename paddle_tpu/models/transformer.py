@@ -213,6 +213,14 @@ def transformer(src_ids, tgt_ids, src_vocab, tgt_vocab, max_len,
 #                    max_pages] page-table feed. Same numerics — fp32
 #                    paged greedy output is bit-identical to the slot
 #                    views; FLAGS_kv_cache_codec stores bf16/int8.
+#   "decode_verify" / "decode_verify_paged" — the speculative-decoding
+#                    verify step (ISSUE 19): score a [n_slots, K+1]
+#                    token window (last committed token + K drafts) in
+#                    ONE causal dispatch over the slot/paged pool and
+#                    sample every window position on-device. The
+#                    engine's draft→verify→commit loop re-dispatches
+#                    this executable instead of decode_slot/paged,
+#                    committing up to K+1 tokens per step.
 # Every parameter is explicitly named (LayerHelper's auto names are
 # globally unique, so cross-program sharing REQUIRES explicit names).
 # ---------------------------------------------------------------------------
@@ -221,9 +229,10 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                vocab: int = 64, d_model: int = 32, d_inner: int = 64,
                n_head: int = 2, n_layer: int = 2, name: str = "lm",
                cache_len=None, n_slots=None, page_size=None,
-               n_pages=None, kv_codec=None):
+               n_pages=None, kv_codec=None, spec_k=None):
     """Emit the `mode` view ("full" | "prefill" | "decode" |
-    "prefill_slot" | "decode_slot" | "prefill_paged" | "decode_paged")
+    "prefill_slot" | "decode_slot" | "prefill_paged" | "decode_paged" |
+    "decode_verify" | "decode_verify_paged")
     of the decoder-only LM into the current default programs.
     ``cache_len`` decouples the cache size from this view's prompt
     bucket (ladder prefills at P < P_max still write full-size caches);
@@ -236,18 +245,39 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     (n_slots * cache_len / page_size); ``kv_codec`` defaults to
     FLAGS_kv_cache_codec ('none' | 'bf16' | 'int8' storage). Returns
     (output_var, feed_specs) — logits for full/prefill/decode, the
-    on-device-sampled next token for the slot/paged views."""
+    on-device-sampled next token for the slot/paged views.
+
+    The verify views (ISSUE 19) take ``spec_k`` (default 4): K drafted
+    tokens per step, scored together with the last committed token as a
+    [n_slots, K+1] window — one fixed-shape executable per (n_slots,
+    spec_k), sampling all K+1 window positions on-device so the host's
+    accept rule is a pure comparison."""
     _MODES = ("full", "prefill", "decode", "prefill_slot", "decode_slot",
-              "prefill_paged", "decode_paged")
+              "prefill_paged", "decode_paged", "decode_verify",
+              "decode_verify_paged")
     if mode not in _MODES:
         raise ValueError(f"decoder_lm mode {mode!r} not in {_MODES}")
-    if (mode.endswith("_slot") or mode.endswith("_paged")) \
-            and not n_slots:
+    if (mode.endswith("_slot") or mode.endswith("_paged")
+            or mode.startswith("decode_verify")) and not n_slots:
         raise ValueError(f"mode {mode!r} needs n_slots")
     cache_len = int(cache_len) if cache_len else prompt_len + max_new
     if prompt_len > cache_len:
         raise ValueError(f"prompt_len {prompt_len} > cache_len "
                          f"{cache_len}")
+    if mode.startswith("decode_verify"):
+        # verify-window geometry validation: K >= 1 (K = 0 is plain
+        # decode — use decode_slot/decode_paged), and the K+1 window
+        # must fit the cache (a window can never be larger than the
+        # whole generated region it could commit into)
+        spec_k = int(spec_k) if spec_k else 4
+        if spec_k < 1:
+            raise ValueError(f"spec_k {spec_k} < 1 — the verify view "
+                             f"needs at least one drafted token")
+        if spec_k + 1 > cache_len - prompt_len + 1:
+            raise ValueError(
+                f"spec_k {spec_k}: the K+1={spec_k + 1} verify window "
+                f"exceeds the generated region "
+                f"(cache_len {cache_len} - prompt_len {prompt_len})")
     if mode.endswith("_paged"):
         from paddle_tpu import flags as _flags
         page_size = int(page_size) if page_size else 4
@@ -338,6 +368,43 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
             page_table = sdata("page_table", [S, max_pages])
             feed_specs["page_table"] = ([S, max_pages], "int64")
         x_ids, t = tok, 1
+    elif mode in ("decode_verify", "decode_verify_paged"):
+        S = int(n_slots)
+        k1 = int(spec_k) + 1
+
+        def sdata(nm, shape, dtype="int64"):
+            return layers.data(name=nm, shape=shape, dtype=dtype,
+                               append_batch_size=False)
+        # the window feed: position 0 the row's last committed token,
+        # 1..K the drafts. The sampling feeds are PER WINDOW POSITION
+        # ([S, K+1]): sample_step[b, i] = gen_count[b] + i, so window
+        # position i consumes exactly the (seed, step) noise draw the
+        # sequential engine would at that step — the losslessness
+        # guarantee (docs/serving.md 'Speculative decoding')
+        tok = sdata("tok", [S, k1, 1])
+        pos = sdata("pos", [S, 1])
+        seq_len = sdata("seq_len", [S, 1])
+        gen_start = sdata("gen_start", [S, 1])
+        active = sdata("active", [S, 1])
+        win_len = sdata("win_len", [S, 1])
+        seed_in = sdata("seed", [S, k1])
+        sample_step = sdata("sample_step", [S, k1])
+        temp = sdata("temperature", [S, k1], "float32")
+        top_k = sdata("top_k", [S, k1])
+        feed_specs = {"tok": ([S, k1, 1], "int64"),
+                      "pos": ([S, 1], "int64"),
+                      "seq_len": ([S, 1], "int64"),
+                      "gen_start": ([S, 1], "int64"),
+                      "active": ([S, 1], "int64"),
+                      "win_len": ([S, 1], "int64"),
+                      "seed": ([S, k1], "int64"),
+                      "sample_step": ([S, k1], "int64"),
+                      "temperature": ([S, k1], "float32"),
+                      "top_k": ([S, k1], "int64")}
+        if mode == "decode_verify_paged":
+            page_table = sdata("page_table", [S, max_pages])
+            feed_specs["page_table"] = ([S, max_pages], "int64")
+        x_ids, t = tok, k1
     elif mode in ("prefill_slot", "prefill_paged"):
         # one request at a time joins the pool (batch 1, static)
         t = prompt_len
@@ -383,6 +450,18 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
         pe_t = layers.gather(pe, pos_ids)                  # [B, M]
         pe_t = layers.reshape(pe_t, shape=[-1, 1, d_model])
         x = layers.elementwise_add(x, pe_t)
+    elif mode in ("decode_verify", "decode_verify_paged"):
+        # semantic position of window position i for row b is
+        # seq_len[b] + (pos[b] + i - gen_start[b]) — and since
+        # sample_step[b, i] = (pos - gen_start + 1) + i that is exactly
+        # seq_len + sample_step - 1, computed from the feeds in-program
+        sl = layers.expand(seq_len, expand_times=[1, k1])   # [S, K1]
+        one = layers.fill_constant([S, k1], "int64", 1)
+        off = layers.elementwise_sub(sample_step, one)
+        pos_ids = layers.elementwise_add(sl, off)           # [S, K1]
+        pe_t = layers.gather(pe, pos_ids)                  # [S*K1, M]
+        pe_t = layers.reshape(pe_t, shape=[-1, k1, d_model])
+        x = layers.elementwise_add(x, pe_t)
     elif t != cache_len:
         pe_t = layers.slice(pe, axes=[0], starts=[0], ends=[t])
         x = layers.elementwise_add(x, pe_t, axis=1)
@@ -408,6 +487,15 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                 attn = layers.kv_attention_decode(
                     attn_in, pos, seq_len, gen_start, active, d_model,
                     n_head, pk, pv, param_attr=attn_pa(i))
+        elif mode == "decode_verify":
+            # verify over the CONTIGUOUS slot pool — same persistable
+            # pool vars as prefill_slot/decode_slot, so one scope serves
+            # the whole slot family plus its verify view
+            pk = pool_var(f"{name}_slot_k_{i}")
+            pv = pool_var(f"{name}_slot_v_{i}")
+            attn = layers.kv_attention_verify(
+                attn_in, pos, seq_len, gen_start, active, win_len,
+                d_model, n_head, pk, pv, param_attr=attn_pa(i))
         elif mode.endswith("_paged"):
             pshape = [n_pages, page_size, n_head, d_k]
             pk = pool_var(f"{name}_page_k_{i}", pshape, store_dt)
@@ -421,6 +509,11 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
                 attn = layers.kv_attention_prefill_paged(
                     attn_in, page_rows, d_model, n_head, pk, pv,
                     pks, pvs, codec=kv_codec, param_attr=attn_pa(i))
+            elif mode == "decode_verify_paged":
+                attn = layers.kv_attention_verify_paged(
+                    attn_in, page_table, pos, seq_len, gen_start,
+                    active, win_len, d_model, n_head, pk, pv, pks,
+                    pvs, codec=kv_codec, param_attr=attn_pa(i))
             else:
                 attn = layers.kv_attention_decode_paged(
                     attn_in, page_table, pos, seq_len, gen_start,
@@ -484,6 +577,15 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
         tok_out = layers.token_sample(flat, temp, top_k, seed_in,
                                       sample_step)
         return tok_out, feed_specs
+    if mode in ("decode_verify", "decode_verify_paged"):
+        # sample EVERY window position on-device ([S*K1, V] flat): row
+        # b*K1+i is the token the sequential engine would emit at step
+        # sample_step[b, i] given the window's prefix — the host accept
+        # rule is then a pure token comparison against the drafts
+        flat = layers.reshape(logits, shape=[-1, vocab])   # [S*K1, V]
+        tok_out = layers.token_sample(flat, temp, top_k, seed_in,
+                                      sample_step)
+        return tok_out, feed_specs
     return logits, feed_specs
 
 
@@ -495,7 +597,7 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
                                                     "full"),
                               prompt_buckets=None, n_slots=None,
                               page_size=None, n_pages=None,
-                              kv_codec=None):
+                              kv_codec=None, spec_k=None):
     """The serving program family: {key: (main, startup, feed_specs,
     fetch_name)}. All mains share every parameter name — run ONE startup
     (any of them; their parameter initializers are identical) into a
@@ -507,7 +609,8 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
     requested), with the bare mode name aliased to the largest bucket.
     ``n_slots`` sizes the decode slot pool for the slot AND paged
     views; ``page_size``/``n_pages``/``kv_codec`` shape the paged pool
-    (ISSUE 17 — see decoder_lm)."""
+    (ISSUE 17 — see decoder_lm); ``spec_k`` sizes the verify window of
+    the ``decode_verify``/``decode_verify_paged`` views (ISSUE 19)."""
     cache_len = prompt_len + max_new
     buckets = tuple(sorted(set(int(b)
                                for b in (prompt_buckets or (prompt_len,)))))
@@ -517,7 +620,8 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
     cfg = dict(max_new=max_new, vocab=vocab, d_model=d_model,
                d_inner=d_inner, n_head=n_head, n_layer=n_layer,
                name=name, cache_len=cache_len, n_slots=n_slots,
-               page_size=page_size, n_pages=n_pages, kv_codec=kv_codec)
+               page_size=page_size, n_pages=n_pages, kv_codec=kv_codec,
+               spec_k=spec_k)
     out = {}
 
     def emit(key, mode, p_len):
@@ -539,20 +643,24 @@ def build_decoder_lm_programs(prompt_len: int = 16, max_new: int = 16,
     return out
 
 
-def slot_modes(layout=None):
+def slot_modes(layout=None, spec=False):
     """The slot-engine program modes for a KV-cache layout
     (FLAGS_kv_cache_layout by default) — the one switch a serving
     stack flips to go paged: pass the result as ``modes=`` to
     :func:`build_decoder_lm_programs` and hand the programs to
-    :func:`paddle_tpu.serving.engine.make_slot_model`."""
+    :func:`paddle_tpu.serving.engine.make_slot_model`. ``spec=True``
+    adds the speculative-decode verify view (ISSUE 19) — the engine
+    discovers it by key and switches step() to draft→verify→commit."""
     from paddle_tpu import flags as _flags
     layout = layout or _flags.get("kv_cache_layout")
     if layout not in ("contiguous", "paged"):
         raise ValueError(f"FLAGS_kv_cache_layout {layout!r} not in "
                          f"('contiguous', 'paged')")
     if layout == "paged":
-        return ("prefill_paged", "decode_paged")
-    return ("prefill_slot", "decode_slot")
+        modes = ("prefill_paged", "decode_paged")
+        return modes + ("decode_verify_paged",) if spec else modes
+    modes = ("prefill_slot", "decode_slot")
+    return modes + ("decode_verify",) if spec else modes
 
 
 def serve_lint_prefill():
@@ -591,6 +699,20 @@ def serve_lint_decode_paged():
     feed indirection, donated page pools (the proglint --memory target
     for the paged layout)."""
     decoder_lm("decode_paged", n_slots=4)
+
+
+def serve_lint_verify():
+    """proglint --module entry: the speculative-decode verify step over
+    the contiguous slot pool — [n_slots, K+1] window, on-device
+    sampling of every window position (ISSUE 19)."""
+    decoder_lm("decode_verify", n_slots=4)
+
+
+def serve_lint_verify_paged():
+    """proglint --module entry: the speculative-decode verify step over
+    the PAGED pool — window writes resolved through the page-table
+    feed, beyond-lease rows dropped via sentinel (ISSUE 19)."""
+    decoder_lm("decode_verify_paged", n_slots=4)
 
 
 def build(is_train: bool = True, src_vocab: int = 32000,

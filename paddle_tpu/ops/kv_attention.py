@@ -34,6 +34,20 @@ full-forward graph token for token:
   steady-state compiles. (The wave-per-batch path is the special case
   Pos = GenStart + step, Active = 1.)
 
+- ``kv_attention_verify`` / ``kv_attention_verify_paged`` — the
+  speculative-decoding verify step (ISSUE 19): score a ``[B, K+1]``
+  token window per row in ONE causal dispatch. Window position 0 is the
+  row's last committed token (its KV row is re-written with identical
+  values — the projection depends only on the token and the weights),
+  positions 1..K are the drafted tokens. ``WinLen [B,1]`` bounds how
+  many window positions actually write (1 = plain decode); positions at
+  and beyond ``WinLen`` produce outputs the host ignores. Rollback of
+  rejected positions is free: rejected rows sit ABOVE the committed
+  frontier, the mask ``j <= pos + i`` never admits them once the host
+  rewinds, and the next window overwrites them in place (contiguous) or
+  through still-leased pages (paged — the lease keeps the pages, only
+  the slot's logical length rewinds).
+
 - ``token_sample`` — on-device next-token selection: greedy argmax when
   ``temperature <= 0`` or ``top_k == 1`` (bit-identical to host argmax
   over the same logits), otherwise temperature-scaled top-k sampling via
@@ -368,6 +382,151 @@ def _kv_attention_decode_paged(ctx, ins, attrs):
             ((j[None, :] >= gen0[:, None]) &
              (j[None, :] <= pos[:, None]))           # [B,S]
     p = _scores_to_probs(s, valid[:, None, None, :], dt)
+    c = jax.lax.dot_general(p, vv, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+    shape4 = (n_pages, ps, h, d)
+    res = {"Out": [out],
+           "PageKOut": [flat_k.reshape(shape4)],
+           "PageVOut": [flat_v.reshape(shape4)]}
+    if codec == "int8":
+        res["PageKSOut"] = [fks.reshape(n_pages, ps, h)]
+        res["PageVSOut"] = [fvs.reshape(n_pages, ps, h)]
+    return res
+
+
+@register_op("kv_attention_verify", no_grad=True,
+             ref="TPU-native serving op: speculative-decode verify — "
+                 "score a [B, K+1] draft window against the contiguous "
+                 "KV cache in one causal dispatch, writing the window's "
+                 "rows in place (rollback = overwrite next dispatch)")
+def _kv_attention_verify(ctx, ins, attrs):
+    """X [B,K1,M] (window: last committed token + K drafts), Wq..Wo
+    [M,M], CacheK/CacheV [B,S,H,Dk], Pos [B,1] int (cache row of window
+    position 0 — the row's committed frontier), SeqLen/GenStart/Active
+    [B,1] as in kv_attention_decode, WinLen [B,1] int (valid window
+    positions, 1..K1; 1 degenerates to plain decode). attrs: n_head.
+
+    Writes k/v for window position i at cache row ``pos + i`` where
+    ``active & i < win_len & pos + i < S``; attends position i over
+    {j < seq_len} ∪ {gen_start <= j <= pos + i} — causal INSIDE the
+    window, so Out[:, i] is bit-identical to what i sequential
+    kv_attention_decode steps over the same tokens would produce."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    cache_k, cache_v = first(ins, "CacheK"), first(ins, "CacheV")
+    h = int(attrs["n_head"])
+    b, k1, m = x.shape
+    s_len = cache_k.shape[1]
+    d = m // h
+    dt = x.dtype
+
+    pos = jnp.asarray(first(ins, "Pos")).reshape(-1).astype(jnp.int32)
+    lens = jnp.asarray(first(ins, "SeqLen")).reshape(-1).astype(jnp.int32)
+    gen0 = jnp.asarray(first(ins, "GenStart")).reshape(-1)\
+        .astype(jnp.int32)
+    active = jnp.asarray(first(ins, "Active")).reshape(-1) > 0
+    wlen = jnp.asarray(first(ins, "WinLen")).reshape(-1).astype(jnp.int32)
+
+    q = _ab._proj(x, wq, h)                     # [B,K1,H,D]
+    k_t = _ab._proj(x, wk, h).astype(cache_k.dtype)
+    v_t = _ab._proj(x, wv, h).astype(cache_v.dtype)
+
+    j = jnp.arange(s_len, dtype=jnp.int32)
+    off = j[None, :] - pos[:, None]                         # [B,S]
+    wmask = active[:, None] & (off >= 0) & (off < wlen[:, None])
+    widx = jnp.clip(off, 0, k1 - 1)[:, :, None, None]       # [B,S,1,1]
+    cache_k = jnp.where(wmask[:, :, None, None],
+                        jnp.take_along_axis(k_t, widx, axis=1), cache_k)
+    cache_v = jnp.where(wmask[:, :, None, None],
+                        jnp.take_along_axis(v_t, widx, axis=1), cache_v)
+
+    s = jax.lax.dot_general(q, cache_k, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,K1,S]
+    i = jnp.arange(k1, dtype=jnp.int32)
+    valid = (j[None, None, :] < lens[:, None, None]) | \
+            ((j[None, None, :] >= gen0[:, None, None]) &
+             (j[None, None, :] <= (pos[:, None] + i[None, :])[:, :, None]))
+    p = _scores_to_probs(s, valid[:, None], dt)      # [B,H,K1,S]
+    c = jax.lax.dot_general(p, cache_v, (((3,), (1,)), ((0, 1), (0, 2))),
+                            preferred_element_type=jnp.float32).astype(dt)
+    out = jax.lax.dot_general(c, wo.reshape(h, d, m),
+                              (((1, 3), (0, 1)), ((), ())),
+                              preferred_element_type=jnp.float32).astype(dt)
+    return {"Out": [out], "CacheKOut": [cache_k], "CacheVOut": [cache_v]}
+
+
+@register_op("kv_attention_verify_paged", no_grad=True,
+             ref="TPU-native serving op: speculative-decode verify over "
+                 "the PAGED KV pool — the K+1 window's write rows "
+                 "resolve through the per-slot page table (sentinel "
+                 "rows drop: beyond-lease and inactive writes never "
+                 "land), gather and mask as kv_attention_decode_paged")
+def _kv_attention_verify_paged(ctx, ins, attrs):
+    """X [B,K1,M], Wq..Wo [M,M], PageK/PageV [n_pages, ps, H, Dk]
+    (+ PageKS/PageVS when codec=int8), PageTable [B, MP] int,
+    Pos/SeqLen/GenStart/Active/WinLen [B,1] — geometry identical to
+    kv_attention_verify with the cache row for logical position j at
+    flat row table[b, j//ps]*ps + j%ps. attrs: n_head, codec. Window
+    writes that fall past the slot's leased span hit the table's
+    sentinel page (row >= n_pages*ps) and DROP — a draft window can
+    never corrupt another slot's pages (the admission span reserves
+    the draft-window overshoot, serving/kv_pool.py)."""
+    x = first(ins, "X")
+    wq, wk, wv, wo = (first(ins, n) for n in ("Wq", "Wk", "Wv", "Wo"))
+    h = int(attrs["n_head"])
+    codec = str(attrs.get("codec", "none"))
+    b, k1, m = x.shape
+    d = m // h
+    dt = x.dtype
+    flat_k, flat_v, fks, fvs, n_pages, ps, rtot = \
+        _paged_pools(ins, codec, h)
+    table = jnp.asarray(first(ins, "PageTable")).astype(jnp.int32)
+    mp = table.shape[1]
+    s_len = mp * ps
+
+    pos = jnp.asarray(first(ins, "Pos")).reshape(-1).astype(jnp.int32)
+    lens = jnp.asarray(first(ins, "SeqLen")).reshape(-1).astype(jnp.int32)
+    gen0 = jnp.asarray(first(ins, "GenStart")).reshape(-1)\
+        .astype(jnp.int32)
+    active = jnp.asarray(first(ins, "Active")).reshape(-1) > 0
+    wlen = jnp.asarray(first(ins, "WinLen")).reshape(-1).astype(jnp.int32)
+
+    q = _ab._proj(x, wq, h)                     # [B,K1,H,D]
+    k_t = _ab._proj(x, wk, h)
+    v_t = _ab._proj(x, wv, h)
+
+    # window position i writes logical position pos + i; resolve each
+    # through the page table, sentinel for inactive rows, positions at
+    # or past win_len, and positions past the table span
+    i = jnp.arange(k1, dtype=jnp.int32)
+    wp = pos[:, None] + i[None, :]                          # [B,K1]
+    wpage = jnp.take_along_axis(table, jnp.clip(wp // ps, 0, mp - 1),
+                                axis=1)
+    ok = active[:, None] & (i[None, :] < wlen[:, None]) & (wp < s_len)
+    wrow = jnp.where(ok, wpage * ps + wp % ps, rtot).reshape(-1)
+    dk = flat_k.shape[2]
+    flat_k, fks = _paged_write(flat_k, fks, wrow,
+                               k_t.reshape(-1, h, dk), codec)
+    flat_v, fvs = _paged_write(flat_v, fvs, wrow,
+                               v_t.reshape(-1, h, dk), codec)
+
+    rows = (table[:, :, None] * ps
+            + jnp.arange(ps, dtype=jnp.int32)[None, None, :]).reshape(-1)
+    kk = _paged_gather(flat_k, fks, rows, h, dt).reshape(b, s_len, h, d)
+    vv = _paged_gather(flat_v, fvs, rows, h, dt).reshape(b, s_len, h, d)
+
+    s = jax.lax.dot_general(q, kk, (((3,), (3,)), ((0, 2), (0, 2))),
+                            preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * (float(d) ** -0.5)   # [B,H,K1,S]
+    j = jnp.arange(s_len, dtype=jnp.int32)
+    valid = (j[None, None, :] < lens[:, None, None]) | \
+            ((j[None, None, :] >= gen0[:, None, None]) &
+             (j[None, None, :] <= (pos[:, None] + i[None, :])[:, :, None]))
+    p = _scores_to_probs(s, valid[:, None], dt)      # [B,H,K1,S]
     c = jax.lax.dot_general(p, vv, (((3,), (1,)), ((0, 1), (0, 2))),
                             preferred_element_type=jnp.float32).astype(dt)
     out = jax.lax.dot_general(c, wo.reshape(h, d, m),

@@ -575,6 +575,115 @@ class SlotExhaustedError(RuntimeError):
     shed). Typed so the server can distinguish it from engine errors."""
 
 
+# -- speculative-decoding drafters (ISSUE 19) -----------------------------
+#
+# A drafter proposes up to K next tokens for one slot from its COMMITTED
+# token history (prompt + accepted generations). The verify dispatch then
+# scores the whole window at once and the engine keeps the longest prefix
+# whose drafts match what the model would have emitted sequentially —
+# the accept rule is exact-match against the on-device samples, which is
+# LOSSLESS for greedy and for seeded sampling alike (token_sample's
+# Gumbel noise is a pure function of (seed, step, vocab index), so the
+# sequential stream is a deterministic function of the logits — matching
+# it bit-for-bit is the only way a draft survives).
+
+class NgramDrafter:
+    """Model-free prompt-lookup drafting: match the last n-gram of the
+    slot's committed tokens against earlier positions in the same
+    history and propose the tokens that followed the most recent match.
+    Host-side and zero extra HBM — the profitable regime is output that
+    re-quotes its own context (code, structured text, greedy cycles),
+    where acceptance approaches the full window."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = max(1, int(min_ngram))
+
+    def propose(self, tokens, k: int):
+        n_tok = len(tokens)
+        if k <= 0 or n_tok < self.min_ngram + 1:
+            return []
+        toks = list(tokens)
+        # the drafter runs on the hot serving path once per slot per
+        # verify step — encode the history once and let bytes.rfind do
+        # the suffix search at C speed instead of a python scan
+        lo, hi = min(toks), max(toks)
+        if 0 <= lo and hi < 256:
+            enc, width = (lambda t: bytes(t)), 1
+        elif 0 <= lo and hi < (1 << 16):
+            enc = lambda t: np.asarray(t, np.uint16).tobytes()
+            width = 2
+        else:
+            enc = lambda t: np.asarray(t, np.uint32).tobytes()
+            width = 4
+        buf = enc(toks)
+        # self-extending lookup: when the matched continuation runs out
+        # before filling the window (the match sat near the end of the
+        # history), re-match against history + drafts-so-far — on
+        # repetitive streams this walks the repeating span and fills
+        # the full K instead of stalling at the history frontier
+        drafts: list = []
+        while len(drafts) < k:
+            got = self._lookup(buf, toks, width, k - len(drafts))
+            if not got:
+                break
+            drafts.extend(got)
+            toks.extend(got)
+            buf += enc(got)
+        return drafts
+
+    def _lookup(self, buf, toks, width: int, k: int):
+        n_tok = len(toks)
+        for n in range(min(self.max_ngram, n_tok - 1),
+                       self.min_ngram - 1, -1):
+            tail = buf[(n_tok - n) * width:]
+            # most recent earlier occurrence of the suffix n-gram:
+            # restrict the search window so the match ends before the
+            # tail itself, and re-search on token misalignment
+            j = buf.rfind(tail, 0, (n_tok - 1) * width)
+            while j >= 0 and j % width:
+                j = buf.rfind(tail, 0, j + len(tail) - 1)
+            if j >= 0:
+                cont = toks[j // width + n:j // width + n + k]
+                if cont:
+                    return cont
+        return []
+
+
+class ModelDrafter:
+    """The optional small-draft-model arm: greedy continuations from a
+    SEPARATE (smaller) decoder-LM sharing the engine family's program-
+    view machinery — its ``full`` view is re-dispatched K times per
+    proposal. Pass a :class:`GenerativeModel` built over the draft
+    weights. Useful when histories don't self-repeat (NgramDrafter's
+    blind spot); the acceptance rule upstream is unchanged, so a bad
+    draft model costs only acceptance length, never correctness."""
+
+    def __init__(self, model: "GenerativeModel"):
+        if model._full is None:
+            raise ValueError("ModelDrafter needs a model with a 'full' "
+                             "program view")
+        self.model = model
+
+    def propose(self, tokens, k: int):
+        m = self.model
+        t_total = m.prompt_len + m.max_new
+        # greedy continuation needs room for k drafts after the context
+        ctx = list(tokens)[-(t_total - k):] if k < t_total else []
+        if k <= 0 or not ctx:
+            return []
+        seq = np.zeros((1, t_total), np.int64)
+        seq[0, :len(ctx)] = ctx
+        drafts = []
+        for i in range(k):
+            f, _ = m._full.fn(*m._args(
+                m._full, {"ids": seq[:, :, None]}))
+            tok = int(np.asarray(f[0])[0, len(ctx) - 1 + i].argmax(-1))
+            drafts.append(tok)
+            seq[0, len(ctx) + i] = tok
+        return drafts
+
+
 class SlotGenerativeModel:
     """In-flight batched decoding over a persistent decode-slot pool
     (ISSUE 9): the decode executable is ONE fixed-shape
@@ -599,12 +708,16 @@ class SlotGenerativeModel:
 
     # the program-key pair this engine dispatches; the paged subclass
     # swaps in its views and everything keyed on these (warmup, AOT
-    # tags, compile-counter kinds) follows
+    # tags, compile-counter kinds) follows. VERIFY is the OPTIONAL
+    # speculative-decoding view (ISSUE 19): when the program family
+    # carries it, step() switches from one-token decode to
+    # draft→verify→commit over a [n_slots, K+1] window.
     PREFILL = "prefill_slot"
     DECODE = "decode_slot"
+    VERIFY = "decode_verify"
 
     def __init__(self, name: str, programs: Dict, scope=None,
-                 init: bool = True, dist=None):
+                 init: bool = True, dist=None, drafter=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.core.lowering import CompiledBlock
         self.name = name
@@ -622,6 +735,7 @@ class SlotGenerativeModel:
         self.prompt_len = self.prompt_buckets[-1]
         dec_main, dec_start, dec_feeds, dec_fetch = programs[dk]
         self.n_slots = int(dec_feeds["tok"][0][0])
+        ver = programs.get(self.VERIFY)
         # server compatibility: max prompts one request may carry
         self.policy = bucketing.BucketPolicy((self.n_slots,))
         self.scope = scope or fluid.Scope()
@@ -645,12 +759,26 @@ class SlotGenerativeModel:
         self._cb_decode = CompiledBlock(
             dec_main.desc, 0, sorted(dec_feeds), [dec_fetch],
             is_test=True, donate=True, dist=dist)
+        # the optional verify view: one fixed-shape [n_slots, K+1]
+        # window executable — its presence flips step() to speculative
+        # draft→verify→commit (ISSUE 19)
+        self._cb_verify = None
+        self.spec_k = 0
+        if ver is not None:
+            ver_main, _vs, ver_feeds, ver_fetch = ver
+            ver_main.desc._obs_name = f"{name}.{self.VERIFY}"
+            self._cb_verify = CompiledBlock(
+                ver_main.desc, 0, sorted(ver_feeds), [ver_fetch],
+                is_test=True, donate=True, dist=dist)
+            self.spec_k = int(ver_feeds["tok"][0][1]) - 1
+        self.drafter = drafter if drafter is not None else NgramDrafter()
         self._discover_pool(dec_main, dec_feeds)
         self._warmed: set = set()
         self._aot: Dict[Tuple, object] = {}
         self._fingerprint = hashlib.sha256(json.dumps(
             [pre[p][0].desc.to_dict() for p in self.prompt_buckets]
-            + [dec_main.desc.to_dict()],
+            + [dec_main.desc.to_dict()]
+            + ([ver[0].desc.to_dict()] if ver is not None else []),
             sort_keys=True, default=str).encode()).hexdigest()
         # host mirror of the per-slot device state
         s = self.n_slots
@@ -664,6 +792,9 @@ class SlotGenerativeModel:
         self._topk = np.zeros(s, np.int64)
         self._budget = np.zeros(s, np.int64)
         self._eos: List[Optional[int]] = [None] * s
+        # committed-token history per slot (prompt + accepted tokens):
+        # what the drafter proposes from — host lists, zero extra HBM
+        self._hist: List[List[int]] = [[] for _ in range(s)]
 
     def _discover_pool(self, dec_main, dec_feeds):
         """Read the KV capacity off the decode program's pool vars.
@@ -698,6 +829,32 @@ class SlotGenerativeModel:
                 "sample_step": self._gen_count[:, None],
                 "temperature": self._temp[:, None],
                 "top_k": self._topk[:, None]}
+
+    def _verify_feeds(self, tok_w=None, win_len=None):
+        """The verify dispatch's fixed-shape feeds. The sampling feeds
+        are per WINDOW POSITION: sample_step[b, i] = gen_count[b] + i,
+        so position i consumes exactly the (seed, step) noise draw the
+        sequential engine would at that emission — one draw per
+        COMMITTED token, rejected positions' draws re-derive identically
+        next dispatch (counter-based: no mutable stream state), which is
+        what makes seeded replay restart-reproducible."""
+        s, k1 = self.n_slots, self.spec_k + 1
+        if tok_w is None:
+            tok_w = np.zeros((s, k1, 1), np.int64)
+            tok_w[:, 0, 0] = self._tok
+        if win_len is None:
+            win_len = np.ones((s, 1), np.int64)
+        steps = self._gen_count[:, None] + np.arange(k1, dtype=np.int64)
+        return {"tok": tok_w,
+                "pos": (self._gen0 + self._gen_count - 1)[:, None],
+                "seq_len": self._seq[:, None],
+                "gen_start": self._gen0[:, None],
+                "active": self._active.astype(np.int64)[:, None],
+                "win_len": win_len,
+                "seed": np.tile(self._seed[:, None], (1, k1)),
+                "sample_step": steps,
+                "temperature": np.tile(self._temp[:, None], (1, k1)),
+                "top_k": np.tile(self._topk[:, None], (1, k1))}
 
     def _prefill_feeds(self, p_len: int):
         return {"ids": np.zeros((1, p_len, 1), np.int64),
@@ -757,6 +914,14 @@ class SlotGenerativeModel:
             self._warmed.add((dk,))
             if aot_dir and persist:
                 self._persist_one(aot_dir, dk)
+        vk = self.VERIFY
+        if self._cb_verify is not None and (vk,) not in self._warmed:
+            smetrics.count_compile(self.name, vk)
+            compiled += 1
+            self._run(self._cb_verify, (vk,), self._verify_feeds())
+            self._warmed.add((vk,))
+            if aot_dir and persist:
+                self._persist_one(aot_dir, vk)
         # warmup dispatches touched slot 0's cache rows; no request was
         # live, so just make sure the host mirror says so
         self.reset()
@@ -773,6 +938,8 @@ class SlotGenerativeModel:
                      p_len: Optional[int] = None):
         if kind == self.PREFILL:
             cb, feeds = self._cb_prefill[p_len], self._prefill_feeds(p_len)
+        elif kind == self.VERIFY:
+            cb, feeds = self._cb_verify, self._verify_feeds()
         else:
             cb, feeds = self._cb_decode, self._decode_feeds()
         try:
@@ -795,6 +962,12 @@ class SlotGenerativeModel:
             self._aot[(dk,)] = exe
             self._warmed.add((dk,))
             n += 1
+        if self._cb_verify is not None:
+            exe = load_executable(self._aot_path(dirname, self.VERIFY))
+            if exe is not None:
+                self._aot[(self.VERIFY,)] = exe
+                self._warmed.add((self.VERIFY,))
+                n += 1
         return n
 
     # -- slot lifecycle --------------------------------------------------
@@ -866,6 +1039,7 @@ class SlotGenerativeModel:
         self._seq[slot] = length
         self._gen0[slot] = p_len
         self._gen_count[slot] = 1
+        self._hist[slot] = [int(t) for t in prompt] + [first]
         self._seed[slot] = int(seed)
         self._temp[slot] = float(temperature)
         self._topk[slot] = int(top_k)
@@ -884,13 +1058,24 @@ class SlotGenerativeModel:
         return slot, first, done
 
     def step(self) -> List[Tuple[int, int, Optional[str]]]:
-        """One decode dispatch over the WHOLE pool (free slots ride
-        along masked). Returns (slot, token, done_cause) per active
-        slot; slots that hit EOS or their token budget are released —
-        the LEAVE side of in-flight batching."""
+        """One dispatch over the WHOLE pool (free slots ride along
+        masked). Returns (slot, token, done_cause) events in commit
+        order; slots that hit EOS or their token budget are released —
+        the LEAVE side of in-flight batching.
+
+        Without a verify view this is one decode dispatch = one token
+        per active slot. With one (ISSUE 19) it is draft→verify→commit:
+        the drafter proposes up to K tokens per slot, ONE fixed-shape
+        verify dispatch scores every slot's window, and each slot
+        commits its accepted prefix plus the bonus token — up to K+1
+        events per slot per step, bit-identical to what the sequential
+        path would have emitted (exact-match acceptance against the
+        on-device samples)."""
         live = np.flatnonzero(self._active)
         if live.size == 0:
             return []
+        if self._cb_verify is not None:
+            return self._step_verify(live)
         if (self.DECODE,) not in self._warmed:
             smetrics.count_compile(self.name, f"steady_{self.DECODE}")
             self._warmed.add((self.DECODE,))
@@ -906,6 +1091,8 @@ class SlotGenerativeModel:
             tok = int(out[slot])
             self._tok[slot] = tok
             self._gen_count[slot] += 1
+            self._hist[slot].append(tok)
+            smetrics.TOKENS_PER_STEP.labels(model=self.name).observe(1.0)
             eos = self._eos[slot]
             done = None
             if eos is not None and tok == eos:
@@ -915,6 +1102,87 @@ class SlotGenerativeModel:
             if done:
                 self.release(slot, cause=done)
             events.append((slot, tok, done))
+        smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(
+            self.occupancy())
+        return events
+
+    def _step_verify(self, live) -> List[Tuple[int, int, Optional[str]]]:
+        """Draft→verify→commit (ISSUE 19). Window semantics: position 0
+        carries the slot's last committed token (re-writing its KV row
+        with bit-identical values), positions 1..K the drafts; the
+        sampled output at position i is the token the sequential engine
+        would emit at step gen_count + i GIVEN the window prefix, so
+        draft i survives iff it equals sample i-1 — and the commit is
+        the accepted prefix plus one bonus token. Greedy output is
+        bit-identical to the non-speculative scheduler; temperature>0
+        stays lossless because acceptance compares against the exact
+        counter-based sample of each (seed, step)."""
+        s, k1 = self.n_slots, self.spec_k + 1
+        tok_w = np.zeros((s, k1, 1), np.int64)
+        tok_w[:, 0, 0] = self._tok
+        win_len = np.ones((s, 1), np.int64)
+        drafts: Dict[int, List[int]] = {}
+        proposed = 0
+        for slot in live:
+            slot = int(slot)
+            # a window commits at most accepted+1 tokens: never draft
+            # past the remaining budget, nor past the cache end (the
+            # admission invariant makes the budget cap the binding one)
+            remaining = int(self._budget[slot] - self._gen_count[slot])
+            pos0 = int(self._gen0[slot] + self._gen_count[slot] - 1)
+            kq = min(self.spec_k, remaining - 1, self.cache_len - 1 - pos0)
+            d = []
+            if kq > 0:
+                d = [int(t) for t in
+                     self.drafter.propose(self._hist[slot], kq)][:kq]
+            drafts[slot] = d
+            for i, t in enumerate(d):
+                tok_w[slot, 1 + i, 0] = t
+            win_len[slot, 0] = 1 + len(d)
+            proposed += len(d)
+        if (self.VERIFY,) not in self._warmed:
+            smetrics.count_compile(self.name, f"steady_{self.VERIFY}")
+            self._warmed.add((self.VERIFY,))
+        out = self._run(self._cb_verify, (self.VERIFY,),
+                        self._verify_feeds(tok_w, win_len))
+        out = np.asarray(out).reshape(s, k1)
+        smetrics.DECODE_STEPS.labels(model=self.name).inc()
+        smetrics.SPEC_PROPOSED.labels(model=self.name).inc(proposed)
+        events = []
+        committed_total = accepted_total = 0
+        for slot in live:
+            slot = int(slot)
+            d = drafts[slot]
+            t = out[slot]
+            a = 0
+            while a < len(d) and d[a] == int(t[a]):
+                a += 1
+            accepted_total += a
+            commit = [int(x) for x in t[:a + 1]]
+            eos = self._eos[slot]
+            done = None
+            n_commit = 0
+            for tok in commit:
+                n_commit += 1
+                self._tok[slot] = tok
+                self._gen_count[slot] += 1
+                self._hist[slot].append(tok)
+                if eos is not None and tok == eos:
+                    done = "eos"
+                elif self._gen_count[slot] >= self._budget[slot]:
+                    done = "max_new"
+                events.append((slot, tok, done))
+                if done:
+                    break
+            committed_total += n_commit
+            smetrics.TOKENS_PER_STEP.labels(model=self.name).observe(
+                float(n_commit))
+            if done:
+                self.release(slot, cause=done)
+        smetrics.SPEC_ACCEPTED.labels(model=self.name).inc(
+            accepted_total)
+        smetrics.TOKENS_GENERATED.labels(model=self.name).inc(
+            committed_total)
         smetrics.SLOT_OCCUPANCY.labels(model=self.name).set(
             self.occupancy())
         return events
@@ -998,6 +1266,7 @@ class PagedSlotGenerativeModel(SlotGenerativeModel):
 
     PREFILL = "prefill_paged"
     DECODE = "decode_paged"
+    VERIFY = "decode_verify_paged"
 
     def _discover_pool(self, dec_main, dec_feeds):
         from paddle_tpu.serving import kv_pool
@@ -1035,6 +1304,11 @@ class PagedSlotGenerativeModel(SlotGenerativeModel):
         feeds["page_table"] = self._table.copy()
         return feeds
 
+    def _verify_feeds(self, tok_w=None, win_len=None):
+        feeds = SlotGenerativeModel._verify_feeds(self, tok_w, win_len)
+        feeds["page_table"] = self._table.copy()
+        return feeds
+
     def _admit_feeds(self, slot: int, p_len: int):
         """Prefill feed: the flat pool row for each prompt position —
         or the drop sentinel for positions whose pages are SHARED with
@@ -1051,7 +1325,12 @@ class PagedSlotGenerativeModel(SlotGenerativeModel):
 
     def _reserve_capacity(self, slot, prompt, p_len, budget):
         from paddle_tpu.serving import kv_pool
-        span = self.pool.span_for(p_len + budget)
+        # draft_window=0 even under speculation: _step_verify caps each
+        # window at remaining-1 drafts, so verify writes never pass row
+        # p_len + budget - 1. An engine drafting a FULL window at the
+        # max_new boundary would need span_for(..., draft_window=spec_k)
+        # here — the off-by-K the span formula's parameter guards.
+        span = self.pool.span_for(p_len + budget, draft_window=0)
         try:
             pages, n_shared = self.pool.acquire(
                 slot, [int(t) for t in prompt], span)
@@ -1103,16 +1382,20 @@ class PagedSlotGenerativeModel(SlotGenerativeModel):
 
 
 def make_slot_model(name: str, programs: Dict, scope=None,
-                    init: bool = True, dist=None) -> SlotGenerativeModel:
+                    init: bool = True, dist=None,
+                    drafter=None) -> SlotGenerativeModel:
     """Build the slot engine matching ``programs``' layout: paged views
     (``prefill_paged``/``decode_paged``, from ``FLAGS_kv_cache_layout=
     paged`` via ``transformer.slot_modes()``) get
     :class:`PagedSlotGenerativeModel`; the contiguous slot views get
     :class:`SlotGenerativeModel`. ``dist`` (a ``DistributeConfig``)
-    lowers every view over its mesh — see docs/serving.md."""
+    lowers every view over its mesh — see docs/serving.md. ``drafter``
+    overrides the speculative proposer (default
+    :class:`NgramDrafter`) for engines built with a verify view."""
     if any(k == "decode_paged" or k == "prefill_paged"
            or k.startswith("prefill_paged@") for k in programs):
         return PagedSlotGenerativeModel(name, programs, scope=scope,
-                                        init=init, dist=dist)
+                                        init=init, dist=dist,
+                                        drafter=drafter)
     return SlotGenerativeModel(name, programs, scope=scope, init=init,
-                               dist=dist)
+                               dist=dist, drafter=drafter)

@@ -129,9 +129,19 @@ class PagePool:
                 return nd.refs
         raise KeyError(f"page {page} is not in the prefix tree")
 
-    def span_for(self, total_len: int) -> int:
-        """Pages needed to hold ``total_len`` cache positions."""
-        return -(-int(total_len) // self.page_size)
+    def span_for(self, total_len: int, draft_window: int = 0) -> int:
+        """Pages needed to hold ``total_len`` cache positions.
+
+        ``draft_window`` reserves headroom for speculative decoding: a
+        draft–verify engine may write up to ``draft_window`` rows past
+        the committed frontier inside one dispatch, so an engine that
+        drafts a full window right up to its ``max_new`` budget needs
+        ``ceil((total_len + draft_window) / page_size)`` pages to avoid
+        an off-by-K overflow on the last step. (The in-tree engine caps
+        each window at ``remaining - 1`` drafts, which keeps writes
+        within ``total_len`` — the headroom is defensive for drafters
+        that do not.)"""
+        return -(-(int(total_len) + int(draft_window)) // self.page_size)
 
     def stats(self) -> dict:
         return {"pages_total": self.n_pages,

@@ -101,6 +101,31 @@ SLOT_EVICTIONS = _metrics.counter(
     "Slots freed, by cause: eos | max_new | cancelled | error",
     labelnames=("model", "cause"))
 
+# -- speculative decoding families (draft-verify slot engine) -----------
+# The acceptance economy of the draft-verify step: proposed counts every
+# DRAFT token placed in a verify window, accepted counts the drafts the
+# target model kept (accepted <= proposed; the acceptance RATE is their
+# ratio). tokens_per_step observes the COMMITTED token count of each
+# live slot per verify dispatch (accepted drafts + 1 bonus token), so
+# sum/count is the mean acceptance length — the speedup witness
+# SERVE_r06 reports. Non-speculative decode observes 1.0 per emitted
+# token, keeping the family comparable across arms.
+SPEC_PROPOSED = _metrics.counter(
+    "paddle_serving_spec_proposed_tokens_total",
+    "Draft tokens proposed into verify windows (speculative decoding)",
+    labelnames=("model",))
+SPEC_ACCEPTED = _metrics.counter(
+    "paddle_serving_spec_accepted_tokens_total",
+    "Draft tokens the target model accepted (longest-prefix match of "
+    "the verify dispatch; always <= proposed)", labelnames=("model",))
+TOKENS_PER_STEP = _metrics.histogram(
+    "paddle_serving_tokens_per_step",
+    "Tokens committed per slot per decode dispatch (1.0 on the "
+    "sequential path; up to spec_k + 1 under draft-verify — sum/count "
+    "is the mean acceptance length)", labelnames=("model",),
+    buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+             32.0))
+
 # -- paged KV pool families (serving/kv_pool.py) ------------------------
 # The paged layout replaces the single worst-case reservation the
 # paddle_hbm_kv_pool_bytes gauge reports with a page economy; these

@@ -513,3 +513,136 @@ def test_server_queues_when_pages_exhausted():
         assert m.pool.free_count() == 4      # everything released
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding over the paged pool (ISSUE 19 satellites)
+# ---------------------------------------------------------------------------
+
+def _spec_paged_lm():
+    """One warmed draft-verify paged engine (spec_k=3) shared by the
+    speculative satellites; tests swap ``m.drafter`` per schedule."""
+    m = _CACHE.get("spec_paged")
+    if m is None:
+        m = seng.make_slot_model(
+            "lm_spec_paged_kvp",
+            T.build_decoder_lm_programs(
+                **_LM_CFG, prompt_buckets=(4, 8),
+                modes=T.slot_modes("paged", spec=True), n_slots=4,
+                page_size=4, spec_k=3))
+        m.warmup()
+        _CACHE["spec_paged"] = m
+    m.reset()
+    m.drafter = seng.NgramDrafter()
+    return m
+
+
+class _ScriptedDrafter:
+    """Proposes the true continuation of ``target``, corrupting window
+    positions >= sched[call] — a deterministic accept/reject schedule
+    (see tests/test_spec_decode.py)."""
+
+    def __init__(self, target, sched=None):
+        self.target = [int(t) for t in target]
+        self.sched = sched
+        self.calls = 0
+
+    def propose(self, tokens, k):
+        n = len(tokens)
+        d = self.target[n:n + k]
+        keep = len(d) if self.sched is None else self.sched[self.calls]
+        self.calls += 1
+        return [t if i < keep else (t + 1) % 32
+                for i, t in enumerate(d)]
+
+
+def test_span_for_draft_window_off_by_k_regression():
+    """Satellite regression: at the max_new boundary an engine that
+    drafts a FULL window writes up to draft_window rows past
+    total_len; when total_len is page-aligned that overshoot needs one
+    extra page — the off-by-K span_for(total) alone would miss."""
+    pool = kv_pool.PagePool(n_pages=16, page_size=4)
+    assert pool.span_for(16) == 4
+    assert pool.span_for(16, draft_window=0) == 4
+    assert pool.span_for(16, draft_window=1) == 5      # the off-by-K
+    assert pool.span_for(16, draft_window=3) == 5
+    assert pool.span_for(16, draft_window=5) == 6
+    assert pool.span_for(15, draft_window=1) == 4      # unaligned: free
+    assert pool.span_for(13, draft_window=3) == 4
+
+
+def test_spec_window_kv_append_crosses_page_boundary():
+    """A multi-token KV append crossing a page boundary MID-window:
+    prompt bucket 4 (page 1 = rows 4..7), the first window commits 3
+    tokens (accept 2 + bonus) so the second window writes rows 7..10 —
+    row 7 in page 1, rows 8..10 in page 2 — and the stream must stay
+    bit-identical to the sequential engine."""
+    m = _spec_paged_lm()
+    prompt = [3, 12, 26]
+    ref = _paged_lm().generate([prompt], max_new=8)[0]
+    m.reset()
+    m.drafter = _ScriptedDrafter(list(prompt) + list(ref),
+                                 sched=[2, 3])
+    d0 = smetrics.DECODE_STEPS.labels(model=m.name).value
+    got = m.generate([prompt], max_new=8)[0]
+    np.testing.assert_array_equal(got, ref)
+    # admit commits 1, dispatch 1 commits 3 (frontier row 6), then the
+    # boundary window 7..10 accepts all 3 drafts and commits 4 — the
+    # whole budget-8 request drains in TWO verify dispatches
+    assert smetrics.DECODE_STEPS.labels(model=m.name).value - d0 == 2
+    m.reset()
+    assert m.pool.free_count() + m.pool.cached_count() == m.n_pages
+
+
+def test_spec_rollback_across_page_boundary():
+    """Rejected drafts whose KV rows landed in the NEXT page: the
+    logical frontier rewinds (pages stay leased), the stale rows are
+    never attended, and later windows overwrite them — witnessed by
+    bit-parity with the sequential stream after a reject-all window
+    that straddled the boundary."""
+    m = _spec_paged_lm()
+    prompt = [8, 8, 21]
+    ref = _paged_lm().generate([prompt], max_new=8)[0]
+    m.reset()
+    # dispatch 1: accept 2 of 3 -> frontier at row 6 (page 1);
+    # dispatch 2: window rows 7..10 straddles pages 1|2, REJECT ALL ->
+    # rows 8..10 in page 2 are stale, only row 7's token committed +
+    # bonus; the remaining dispatches must still replay the reference
+    m.drafter = _ScriptedDrafter(list(prompt) + list(ref),
+                                 sched=[2, 0, 3, 3, 3])
+    got = m.generate([prompt], max_new=8)[0]
+    np.testing.assert_array_equal(got, ref)
+    st = m.pool.stats()
+    assert st["slots"] == 0                  # lease released at done
+
+
+def test_spec_shared_prefix_refcount_safety():
+    """Prefix sharing under speculation: two in-flight requests share a
+    full prompt page while their verify windows write ONLY private
+    generated pages — refcount 2 while both live, decremented on
+    release, and both streams bit-match their unshared references."""
+    m = _spec_paged_lm()
+    pa = [5, 6, 7, 8, 1, 2]                  # shared full page [5,6,7,8]
+    pb = [5, 6, 7, 8, 3]
+    ref = {}
+    for key, pr in (("a", pa), ("b", pb)):
+        m.reset()
+        ref[key] = m.generate([pr], max_new=5)[0]
+    m.reset()
+    sa, first_a, _ = m.admit(pa, max_new=5)
+    sb, first_b, _ = m.admit(pb, max_new=5)
+    shared_page = m.pool.lease(sa).pages[0]
+    assert m.pool.lease(sb).pages[0] == shared_page
+    assert m.pool.page_refs(shared_page) == 2
+    assert first_a == ref["a"][0] and first_b == ref["b"][0]
+    toks = {sa: [first_a], sb: [first_b]}
+    done = set()
+    while len(done) < 2:
+        for slot, tok, d in m.step():
+            toks[slot].append(tok)
+            if d:
+                done.add(slot)
+    np.testing.assert_array_equal(toks[sa], ref["a"])
+    np.testing.assert_array_equal(toks[sb], ref["b"])
+    assert m.pool.page_refs(shared_page) == 0    # cached, resident
+    m.reset()

@@ -93,6 +93,11 @@ CONTEXT_OPS = {
     # a full paged engine build; driven end-to-end vs the wave oracle
     "kv_attention_prefill_paged": ("test_kv_pool.py", "prefill_paged"),
     "kv_attention_decode_paged": ("test_kv_pool.py", "decode_paged"),
+    # the paged verify window resolves its write rows through the same
+    # PagePool-owned table; driven end-to-end by the speculative-decode
+    # parity + rollback tests
+    "kv_attention_verify_paged": ("test_spec_decode.py",
+                                  "decode_verify_paged"),
 }
 
 
@@ -228,6 +233,16 @@ spec("kv_attention_decode",
       "Pos": [ints(2, 1, hi=6, seed=1)], "SeqLen": [ints(2, 1, hi=4)],
       "GenStart": [ints(2, 1, hi=4, seed=2)],
       "Active": [ints(2, 1, hi=2, seed=3)]},
+     {"n_head": 2})
+spec("kv_attention_verify",
+     {"X": [f(2, 3, 8)],
+      "Wq": [f(8, 8, seed=2)], "Wk": [f(8, 8, seed=3)],
+      "Wv": [f(8, 8, seed=4)], "Wo": [f(8, 8, seed=5)],
+      "CacheK": [f(2, 6, 2, 4, seed=6)], "CacheV": [f(2, 6, 2, 4, seed=7)],
+      "Pos": [ints(2, 1, hi=3, seed=1)], "SeqLen": [ints(2, 1, hi=3)],
+      "GenStart": [ints(2, 1, hi=3, seed=2)],
+      "Active": [ints(2, 1, hi=2, seed=3)],
+      "WinLen": [1 + ints(2, 1, hi=3, seed=4)]},
      {"n_head": 2})
 spec("token_sample",
      {"Logits": [f(2, 16)], "Temperature": [f(2, 1, lo=0.0, hi=1.0)],

@@ -41,6 +41,8 @@ LINT_SERVING_MODULES = (
     "paddle_tpu.models.transformer:serve_lint_decode_slot",
     "paddle_tpu.models.transformer:serve_lint_prefill_paged",
     "paddle_tpu.models.transformer:serve_lint_decode_paged",
+    "paddle_tpu.models.transformer:serve_lint_verify",
+    "paddle_tpu.models.transformer:serve_lint_verify_paged",
 )
 
 # a sharded-lookup training program (table marked __sharded__, lazy-adam
@@ -133,6 +135,17 @@ def run_lint_gate(root: str, timeout: int) -> int:
              "--memory", "--is-test", "--module",
              "paddle_tpu.models.transformer:serve_lint_decode_paged"],
             cwd=root, timeout=timeout, env=env)
+        if r.returncode:
+            return r.returncode
+        # speculative-decoding smoke: the draft-verify slot engine must
+        # emit the EXACT greedy stream of the sequential slot scheduler
+        # with zero steady-state compiles (forbid_compiles held over the
+        # whole generation) — the losslessness contract of ISSUE 19
+        # (docs/serving.md "Speculative decoding")
+        print("test_runner: lint gate — spec-decode smoke (draft-verify "
+              "greedy parity + zero steady-state recompiles)")
+        r = subprocess.run([sys.executable, "-c", _SPEC_SMOKE],
+                           cwd=root, timeout=timeout, env=env)
         if r.returncode:
             return r.returncode
         # SPMD gates, on 8 virtual CPU devices (the same harness the
@@ -295,6 +308,50 @@ delta = compile_count() - base
 assert delta == 0, f"{delta} steady-state recompiles"
 assert np.all(np.isfinite(last)), last
 print("spmd smoke ok: dp=8 one-step parity, 0 steady-state recompiles")
+"""
+
+
+# the spec-decode smoke: one contiguous slot engine WITH a verify view
+# vs one without, same weights discipline (per-engine init is seeded by
+# program build), greedy over a mixed prompt set — the draft-verify
+# stream must be token-for-token identical, and the whole speculative
+# generation must run under forbid_compiles after warmup (one verify
+# executable serves every draft-length mix via the win_len feed)
+_SPEC_SMOKE = """
+import numpy as np
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving import engine as seng
+from paddle_tpu.serving import metrics as smetrics
+
+CFG = dict(prompt_len=8, max_new=8, vocab=32, d_model=16, d_inner=32,
+           n_head=2, n_layer=2)
+rng = np.random.RandomState(3)
+prompts = [rng.randint(1, 32, (int(n),)) for n in (3, 7, 8, 5)]
+
+spec = seng.make_slot_model(
+    "lm_spec_smoke",
+    T.build_decoder_lm_programs(**CFG, prompt_buckets=(4, 8),
+                                modes=T.slot_modes(spec=True),
+                                n_slots=4, spec_k=3))
+spec.warmup()
+base = seng.make_slot_model(
+    "lm_base_smoke",
+    T.build_decoder_lm_programs(**CFG, prompt_buckets=(4, 8),
+                                modes=T.slot_modes(), n_slots=4))
+base.warmup()
+
+want = base.generate(prompts, max_new=6)
+with smetrics.forbid_compiles():
+    got = spec.generate(prompts, max_new=6)
+for i, (a, b) in enumerate(zip(want, got)):
+    np.testing.assert_array_equal(a, b, err_msg=f"prompt {i}")
+disp = smetrics.DECODE_STEPS.labels(model="lm_spec_smoke").value
+prop = smetrics.SPEC_PROPOSED.labels(model="lm_spec_smoke").value
+acc = smetrics.SPEC_ACCEPTED.labels(model="lm_spec_smoke").value
+assert acc <= prop, (acc, prop)
+print(f"spec smoke ok: greedy parity over {len(prompts)} prompts, "
+      f"{int(disp)} verify dispatches, {int(acc)}/{int(prop)} drafts "
+      f"accepted, 0 steady-state recompiles")
 """
 
 
