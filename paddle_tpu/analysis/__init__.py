@@ -40,6 +40,36 @@ from paddle_tpu.analysis.rules import (  # noqa: F401
     RuleSpec, all_rules, register_rule, run_rules, suppress_op)
 
 
+def run_concurrency_lint(paths=None, root=None,
+                         include_suppressed: bool = False):
+    """AST concurrency lint over the host-side orchestration packages
+    (serving/distributed/data/observability): unlocked shared writes,
+    lock-order cycles, blocking calls and callback invocation under a
+    lock. See :mod:`paddle_tpu.analysis.concurrency`; CLI:
+    ``tools/proglint.py --concurrency``."""
+    from paddle_tpu.analysis.concurrency import run_concurrency_lint as f
+    return f(paths=paths, root=root,
+             include_suppressed=include_suppressed)
+
+
+def verify_family(family):
+    """Cross-view program-contract verifier over one program family
+    (``{key: (main, startup, feed_specs, fetch_name)}``): shared-var
+    shape/dtype agreement, rng-salt alignment, donation coherence and
+    geometry-record drift. See :mod:`paddle_tpu.analysis.contracts`;
+    CLI: ``tools/proglint.py --contracts``."""
+    from paddle_tpu.analysis.contracts import verify_family as f
+    return f(family)
+
+
+def validate_geometry(mode, prompt_len, max_new, **kwargs):
+    """Normalize + validate one decoder_lm view's geometry constants
+    into a :class:`~paddle_tpu.analysis.contracts.GeometryRecord` (the
+    single source the view builders and the family verifier share)."""
+    from paddle_tpu.analysis.contracts import validate_geometry as f
+    return f(mode, prompt_len, max_new, **kwargs)
+
+
 def analyze_program(program, feed_names: Optional[Sequence[str]] = None,
                     fetch_names: Optional[Sequence[str]] = None,
                     is_test: bool = False,

@@ -1,0 +1,798 @@
+"""Concurrency lint: an AST pass over the host-side orchestration code.
+
+The ProgramDesc verifier (structural/shapes/dataflow) audits what runs
+ON the chip; the last several shipped bugs lived in the Python that
+orchestrates it — threaded routers, RPC accept loops, heartbeat threads
+(the sink-called-under-registry-lock race, the restart-path inversions).
+This pass parses the serving/distributed/data/observability sources and
+builds, per class, a lock-ownership model:
+
+- **lock attributes** — ``self.x = threading.Lock()/RLock()/Condition()``
+  (and ``lock_witness.make_lock(...)`` / ``ObservedLock(...)`` wrappers);
+- **thread entry points** — methods or nested functions handed to
+  ``Thread(target=...)``, ``handle``/``finish`` methods of
+  ``socketserver`` request handlers, plus the main thread (every public
+  method callable from outside counts as main-thread-reachable);
+- **guarded regions** — statements inside ``with self.x:`` /
+  ``with obj.x:`` where ``x`` is a known lock attribute (and explicit
+  ``.acquire()`` / ``.release()`` pairs).
+
+Four rule families run over that model (rule ids below, catalog in
+docs/static_analysis.md):
+
+- ``ccy-unlocked-shared-write`` — a read-modify-write (``+=`` et al.) or
+  plain store on an attribute that is reachable from two thread entry
+  points (or is guarded by a lock elsewhere in the class) executed with
+  no lock held;
+- ``ccy-lock-order-cycle`` — the module's lock-order graph (edges from
+  nested ``with`` regions and acquire-while-holding) has a cycle:
+  deadlock potential. The runtime twin of this rule is
+  ``observability.lock_witness`` (FLAGS_lock_witness);
+- ``ccy-blocking-under-lock`` — socket recv/accept/connect/sendall/
+  readline, ``subprocess`` waits, ``time.sleep``, thread ``join`` or an
+  RPC ``exchange``/``call`` dispatched while a lock is held;
+- ``ccy-callback-under-lock`` — invoking a user-registered callback
+  (an element of a ``self.*sink*/*callback*/*hook*/*listener*``
+  collection) while the registry's lock is held — the exact regression
+  class of the PR 12 tracing-sink fix.
+
+Suppression rides the ``__lint_suppress__`` discipline, source-comment
+form, **justification mandatory**::
+
+    self.hits += 1  # __lint_suppress__: ccy-unlocked-shared-write -- single writer: only the reaper thread mutates this
+
+A suppression without the ``-- why`` tail is itself a finding
+(``ccy-suppression-missing-justification``). The comment suppresses
+findings anchored to its own line or the line directly below it.
+
+Entry points: :func:`run_concurrency_lint` (returns ``Diagnostic``
+records with file/line provenance in ``details``), surfaced on the CLI
+as ``tools/proglint.py --concurrency`` and gated in
+``tools/test_runner.py`` (zero-unsuppressed-findings baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.rules import register_rule
+
+# the default scan surface: every package hosting threads or locks
+DEFAULT_PACKAGES = ("serving", "distributed", "data", "observability")
+
+SUPPRESS_MARK = "__lint_suppress__"
+
+# constructors recognized as lock objects when assigned to self.<attr>
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "ObservedLock", "make_lock"}
+
+# call names (attribute or dotted) considered blocking while a lock is
+# held. Attribute calls match the terminal name; dotted calls match the
+# rendered path.
+_BLOCKING_ATTRS = {"recv", "accept", "connect", "sendall", "readline",
+                   "exchange", "join", "wait", "select"}
+_BLOCKING_DOTTED = {"time.sleep", "subprocess.run", "subprocess.call",
+                    "subprocess.check_call", "subprocess.check_output",
+                    "socket.create_connection", "select.select"}
+
+# attribute-name fragments marking a collection of user callbacks
+_CALLBACK_HINTS = ("callback", "sink", "hook", "listener", "subscriber",
+                   "observer", "handler_fn")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: frozenset
+    justification: str
+
+
+@dataclass
+class LockRegion:
+    """One `with <lock>:` region (or acquire/release span)."""
+    lock: str                    # normalized lock key, e.g. "Router._pool_lock"
+    expr: str                    # source expression, e.g. "self._pool_lock"
+    line: int
+
+
+@dataclass
+class AttrAccess:
+    attr: str
+    line: int
+    is_write: bool
+    is_augmented: bool           # read-modify-write (+= etc.)
+    receiver: str                # "self" or the receiver expression
+    locks_held: Tuple[str, ...]  # normalized lock keys held at the access
+    method: str                  # qualname of the enclosing function
+
+
+@dataclass
+class MethodModel:
+    qualname: str                # "Class.method" or "func.<locals>.inner"
+    name: str
+    cls: Optional[str]
+    line: int
+    accesses: List[AttrAccess] = field(default_factory=list)
+    blocking: List[Tuple[str, int, Tuple[str, ...], str]] = \
+        field(default_factory=list)   # (call, line, locks_held, held_expr)
+    callbacks: List[Tuple[str, int, Tuple[str, ...]]] = \
+        field(default_factory=list)   # (descr, line, locks_held)
+    calls_self: Set[str] = field(default_factory=set)  # self.m() targets
+    is_thread_target: bool = False
+
+
+@dataclass
+class ClassModel:
+    name: str
+    line: int
+    lock_attrs: Dict[str, int] = field(default_factory=dict)  # attr -> line
+    attrs: Set[str] = field(default_factory=set)    # attrs assigned anywhere
+    methods: Dict[str, MethodModel] = field(default_factory=dict)
+    bases: Tuple[str, ...] = ()
+
+    def is_request_handler(self) -> bool:
+        return any("RequestHandler" in b or "TCPServer" in b
+                   for b in self.bases)
+
+
+@dataclass
+class ModuleModel:
+    path: str                    # path as given (relative when possible)
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    functions: List[MethodModel] = field(default_factory=list)
+    # lock-order edges: (lock_a, lock_b) -> (line, method qualname)
+    lock_edges: Dict[Tuple[str, str], Tuple[int, str]] = \
+        field(default_factory=dict)
+    suppressions: Dict[int, Suppression] = field(default_factory=dict)
+    bad_suppressions: List[Suppression] = field(default_factory=list)
+
+
+class ConcurrencyContext:
+    """What every concurrency rule reads: one :class:`ModuleModel` per
+    scanned file. Built by :func:`run_concurrency_lint`; rules
+    registered in the shared catalog no-op when handed the ProgramDesc
+    :class:`~paddle_tpu.analysis.rules.AnalysisContext` instead."""
+
+    def __init__(self, modules: Sequence[ModuleModel]):
+        self.modules = list(modules)
+
+
+# ---------------------------------------------------------------------------
+# source -> model
+# ---------------------------------------------------------------------------
+
+def _parse_suppressions(path: str, source: str,
+                        model: ModuleModel) -> None:
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True))
+                                          .__next__)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = [(i + 1, line[line.index("#"):])
+                    for i, line in enumerate(source.splitlines())
+                    if "#" in line]
+    for line, text in comments:
+        if SUPPRESS_MARK not in text:
+            continue
+        body = text.split(SUPPRESS_MARK, 1)[1].lstrip(" :")
+        rules_part, sep, why = body.partition("--")
+        rules = frozenset(r.strip() for r in rules_part.split(",")
+                          if r.strip())
+        sup = Suppression(line=line, rules=rules,
+                          justification=why.strip() if sep else "")
+        if not sep or not why.strip():
+            model.bad_suppressions.append(sup)
+        model.suppressions[line] = sup
+
+
+def _is_lock_ctor(call: ast.Call) -> bool:
+    name = _dotted(call.func) or ""
+    return name.split(".")[-1] in _LOCK_CTORS
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Walk one function body tracking the held-lock stack."""
+
+    def __init__(self, model: MethodModel, cls: Optional[ClassModel],
+                 module: ModuleModel):
+        self.m = model
+        self.cls = cls
+        self.module = module
+        self.held: List[LockRegion] = []
+        self.loop_vars: Dict[str, str] = {}   # name -> source attr it
+        #                                       iterates (callback hint)
+
+    # -- lock key normalization -------------------------------------------
+    def _lock_key(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """(normalized key, source expr) when `expr` names a known lock:
+        ``self.x`` where x is a lock attr of the enclosing class, or
+        ``obj.x`` where x is a lock attr of ANY class in the module
+        (cross-object locking, e.g. the router taking a replica's
+        lock)."""
+        dotted = _dotted(expr)
+        if not dotted or "." not in dotted:
+            return None
+        recv, attr = dotted.rsplit(".", 1)
+        if recv == "self" and self.cls is not None:
+            if attr in self.cls.lock_attrs:
+                return f"{self.cls.name}.{attr}", dotted
+            return None
+        for cm in self.module.classes.values():
+            if attr in cm.lock_attrs:
+                return f"{cm.name}.{attr}", dotted
+        return None
+
+    def _held_keys(self) -> Tuple[str, ...]:
+        return tuple(r.lock for r in self.held)
+
+    # -- visitors ----------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        pushed = 0
+        for item in node.items:
+            key = self._lock_key(item.context_expr)
+            if key is not None:
+                lock, expr = key
+                if self.held:
+                    edge = (self.held[-1].lock, lock)
+                    if edge[0] != edge[1]:
+                        self.module.lock_edges.setdefault(
+                            edge, (node.lineno, self.m.qualname))
+                self.held.append(LockRegion(lock=lock, expr=expr,
+                                            line=node.lineno))
+                pushed += 1
+        saved_loops = dict(self.loop_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_vars = saved_loops
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_For(self, node: ast.For):
+        # `for s in self._sinks:` — s is a callback candidate while the
+        # loop body executes
+        src = _dotted(node.iter)
+        if (isinstance(node.target, ast.Name) and src
+                and src.startswith("self.")
+                and any(h in src.lower() for h in _CALLBACK_HINTS)):
+            self.loop_vars[node.target.id] = src
+        self.generic_visit(node)
+
+    def _record_access(self, target: ast.Attribute, is_write: bool,
+                       augmented: bool):
+        dotted = _dotted(target)
+        if not dotted or "." not in dotted:
+            return
+        recv, attr = dotted.rsplit(".", 1)
+        self.m.accesses.append(AttrAccess(
+            attr=attr, line=target.lineno, is_write=is_write,
+            is_augmented=augmented, receiver=recv,
+            locks_held=self._held_keys(), method=self.m.qualname))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                self._record_access(t, True, False)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, ast.Attribute):
+            self._record_access(node.target, True, True)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load):
+            self._record_access(node, False, False)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func) or ""
+        terminal = dotted.split(".")[-1] if dotted else ""
+        # self.m(...) — intra-class call graph
+        if dotted.startswith("self.") and dotted.count(".") == 1:
+            self.m.calls_self.add(terminal)
+        # Thread(target=...) — mark the target an entry point
+        if terminal == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tgt = _dotted(kw.value)
+                    if tgt:
+                        self.module.__dict__.setdefault(
+                            "_thread_targets", set()).add(
+                            (self.m.qualname, tgt))
+        # acquire-while-holding also contributes lock-order edges
+        if terminal == "acquire" and isinstance(node.func, ast.Attribute):
+            key = self._lock_key(node.func.value)
+            if key is not None and self.held:
+                edge = (self.held[-1].lock, key[0])
+                if edge[0] != edge[1]:
+                    self.module.lock_edges.setdefault(
+                        edge, (node.lineno, self.m.qualname))
+        if self.held:
+            self._scan_blocking(node, dotted, terminal)
+            self._scan_callback(node, dotted)
+        self.generic_visit(node)
+
+    def _scan_blocking(self, node: ast.Call, dotted: str, terminal: str):
+        blocking = (dotted in _BLOCKING_DOTTED
+                    or (isinstance(node.func, ast.Attribute)
+                        and terminal in _BLOCKING_ATTRS))
+        if not blocking:
+            return
+        # `cond.wait()` on the lock object currently held is the normal
+        # Condition protocol, not a finding
+        if terminal == "wait" and isinstance(node.func, ast.Attribute):
+            recv = _dotted(node.func.value)
+            if recv and any(r.expr == recv for r in self.held):
+                return
+        self.m.blocking.append(
+            (dotted or terminal, node.lineno, self._held_keys(),
+             self.held[-1].expr))
+
+    def _scan_callback(self, node: ast.Call, dotted: str):
+        descr = None
+        # self._cbs[k](...) — direct subscript call on a callback attr
+        if isinstance(node.func, ast.Subscript):
+            src = _dotted(node.func.value)
+            if (src and src.startswith("self.")
+                    and any(h in src.lower() for h in _CALLBACK_HINTS)):
+                descr = f"{src}[...]"
+        # s(...) or s.emit(...) where s iterates a callback collection
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in self.loop_vars:
+            descr = f"{node.func.id} from {self.loop_vars[node.func.id]}"
+        elif isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in self.loop_vars:
+            src = self.loop_vars[node.func.value.id]
+            descr = f"{node.func.value.id}.{node.func.attr} from {src}"
+        if descr is not None:
+            self.m.callbacks.append(
+                (descr, node.lineno, self._held_keys()))
+
+    # nested defs: scanned as their own MethodModel by _scan_function;
+    # don't descend here (their lock context is their own)
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_function(fn: ast.FunctionDef, cls: Optional[ClassModel],
+                   module: ModuleModel, prefix: str = "") -> MethodModel:
+    qual = (f"{cls.name}.{fn.name}" if cls else
+            f"{prefix}{fn.name}" if prefix else fn.name)
+    m = MethodModel(qualname=qual, name=fn.name,
+                    cls=cls.name if cls else None, line=fn.lineno)
+    scanner = _FunctionScanner(m, cls, module)
+    for stmt in fn.body:
+        scanner.visit(stmt)
+    # nested functions (accept loops, heartbeat loops) get their own
+    # model — they are the usual Thread targets
+    for sub in _immediate_defs(fn):
+        nested = _scan_function(sub, cls, module,
+                                prefix=f"{qual}.<locals>.")
+        if cls is not None:
+            cls.methods[nested.qualname] = nested
+        else:
+            module.functions.append(nested)
+    return m
+
+
+def _immediate_defs(fn: ast.AST) -> List[ast.FunctionDef]:
+    """Function defs nested directly inside `fn` (not inside a deeper
+    def — those belong to their own parent's scan)."""
+    out: List[ast.FunctionDef] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            else:
+                walk(child)
+
+    walk(fn)
+    return out
+
+
+def _collect_class(node: ast.ClassDef, module: ModuleModel) -> ClassModel:
+    cm = ClassModel(name=node.name, line=node.lineno,
+                    bases=tuple(_dotted(b) or "" for b in node.bases))
+    # first pass: lock + plain attribute assignments across all methods
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(item):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    d = _dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        attr = d.split(".", 1)[1]
+                        cm.attrs.add(attr)
+                        if isinstance(sub.value, ast.Call) \
+                                and _is_lock_ctor(sub.value):
+                            cm.lock_attrs.setdefault(attr, sub.lineno)
+            elif isinstance(sub, ast.AugAssign):
+                d = _dotted(sub.target)
+                if d and d.startswith("self.") and d.count(".") == 1:
+                    cm.attrs.add(d.split(".", 1)[1])
+    # second pass: per-method scan with the lock model in place
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            m = _scan_function(item, cm, module)
+            cm.methods[m.qualname] = m
+    return cm
+
+
+def scan_file(path: str, display_path: Optional[str] = None
+              ) -> Optional[ModuleModel]:
+    """Parse one file into a :class:`ModuleModel`; None on syntax
+    errors (a broken file fails ruff, not this pass)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError):
+        return None
+    model = ModuleModel(path=display_path or path)
+    _parse_suppressions(path, source, model)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            model.classes[node.name] = _collect_class(node, model)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.functions.append(_scan_function(node, None, model))
+    _mark_thread_targets(model)
+    return model
+
+
+def _mark_thread_targets(model: ModuleModel):
+    """Resolve Thread(target=X) references onto MethodModels."""
+    targets = model.__dict__.get("_thread_targets", set())
+    names: Set[str] = set()
+    for _src, tgt in targets:
+        names.add(tgt.split(".")[-1])
+    all_methods = list(model.functions)
+    for cm in model.classes.values():
+        all_methods.extend(cm.methods.values())
+        if cm.is_request_handler():
+            for m in cm.methods.values():
+                if m.name in ("handle", "finish", "process_request"):
+                    m.is_thread_target = True
+    for m in all_methods:
+        if m.name in names:
+            m.is_thread_target = True
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _entry_reachable(cm: ClassModel) -> Dict[str, Set[str]]:
+    """method name -> set of entry-point labels it is reachable from.
+    Entry labels: 'thread:<target>' per thread target, 'main' for every
+    public method (callable from the owning thread)."""
+    # adjacency on short names within the class
+    adj: Dict[str, Set[str]] = {}
+    for m in cm.methods.values():
+        adj.setdefault(m.name, set()).update(m.calls_self)
+    reach: Dict[str, Set[str]] = {m.name: set()
+                                  for m in cm.methods.values()}
+
+    def flood(start: str, label: str):
+        stack, seen = [start], set()
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            if cur in reach:
+                reach[cur].add(label)
+            stack.extend(adj.get(cur, ()))
+
+    for m in cm.methods.values():
+        if m.is_thread_target:
+            flood(m.name, f"thread:{m.qualname}")
+        elif not m.name.startswith("_") or m.name == "__init__":
+            flood(m.name, "main")
+    return reach
+
+
+def _diag(rule: str, severity: Severity, message: str, module: ModuleModel,
+          line: int, var: Optional[str] = None,
+          method: Optional[str] = None, **extra) -> Diagnostic:
+    details = {"file": module.path, "line": line}
+    if method:
+        details["function"] = method
+    details.update(extra)
+    return Diagnostic(rule=rule, severity=severity,
+                      message=f"{module.path}:{line}: {message}",
+                      var=var, details=details)
+
+
+@register_rule(
+    "ccy-unlocked-shared-write", Severity.ERROR,
+    "read-modify-write (or store) on an attribute shared across thread "
+    "entry points, with no owning lock held", category="concurrency")
+def rule_unlocked_shared_write(ctx) -> Iterable[Diagnostic]:
+    if not isinstance(ctx, ConcurrencyContext):
+        return
+    for module in ctx.modules:
+        for cm in module.classes.values():
+            if not cm.lock_attrs:
+                continue
+            reach = _entry_reachable(cm)
+            # attr -> entry labels touching it, and whether it is ever
+            # accessed under a lock (the class's own claim of guarding)
+            touched: Dict[str, Set[str]] = {}
+            guarded: Set[str] = set()
+            for m in cm.methods.values():
+                for a in m.accesses:
+                    if a.receiver != "self" or a.attr not in cm.attrs:
+                        continue
+                    touched.setdefault(a.attr, set()).update(
+                        reach.get(m.name, set()))
+                    if a.locks_held:
+                        guarded.add(a.attr)
+            # cross-object accesses (router mutating replica.attr):
+            # receiver is not self but attr belongs to a lock-owning
+            # class of this module — count the accessor's entries too
+            for other in module.classes.values():
+                for m in other.methods.values():
+                    oreach = _entry_reachable(other)
+                    for a in m.accesses:
+                        if a.receiver == "self" or a.attr in ("self",):
+                            continue
+                        if a.attr in cm.attrs and a.attr not in \
+                                other.attrs:
+                            touched.setdefault(a.attr, set()).update(
+                                oreach.get(m.name, set()))
+                            if a.locks_held:
+                                guarded.add(a.attr)
+            for other in module.classes.values():
+                for m in other.methods.values():
+                    for a in m.accesses:
+                        if not a.is_write or a.locks_held:
+                            continue
+                        own = (a.receiver == "self" and other is cm)
+                        cross = (a.receiver != "self"
+                                 and a.attr in cm.attrs
+                                 and a.attr not in other.attrs)
+                        if not (own or cross):
+                            continue
+                        if a.attr not in cm.attrs \
+                                or a.attr in cm.lock_attrs:
+                            continue
+                        if m.name == "__init__" and own:
+                            continue        # construction precedes sharing
+                        entries = touched.get(a.attr, set())
+                        shared = len(entries) >= 2
+                        # a plain (non-RMW) store is only flagged when
+                        # the class guards this attr elsewhere — the
+                        # inconsistent-locking signal; RMWs are flagged
+                        # whenever the attr is shared at all
+                        if a.is_augmented and (shared
+                                               or a.attr in guarded):
+                            why = ("read-modify-write on shared "
+                                   f"attribute .{a.attr} with no lock "
+                                   f"held (reachable from "
+                                   f"{len(entries)} entry point(s))")
+                        elif not a.is_augmented and a.attr in guarded \
+                                and shared:
+                            why = (f"store to .{a.attr} with no lock "
+                                   "held, but other sites guard it "
+                                   "with a lock")
+                        else:
+                            continue
+                        yield _diag(
+                            "ccy-unlocked-shared-write", Severity.ERROR,
+                            f"{why} [class {cm.name}, in {a.method}]",
+                            module, a.line, var=f"{cm.name}.{a.attr}",
+                            method=a.method,
+                            entries=sorted(entries))
+
+
+@register_rule(
+    "ccy-lock-order-cycle", Severity.ERROR,
+    "the module's lock-order graph has a cycle — two threads taking the "
+    "locks in opposite orders deadlock", category="concurrency")
+def rule_lock_order_cycle(ctx) -> Iterable[Diagnostic]:
+    if not isinstance(ctx, ConcurrencyContext):
+        return
+    for module in ctx.modules:
+        edges = module.lock_edges
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def path_exists(src: str, dst: str) -> bool:
+            stack, seen = [src], set()
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(adj.get(cur, ()))
+            return False
+
+        reported = set()
+        for (a, b), (line, method) in sorted(edges.items(),
+                                             key=lambda kv: kv[1][0]):
+            if (b, a) in reported or (a, b) in reported:
+                continue
+            # drop the edge a->b, ask whether b still reaches a
+            adj[a].discard(b)
+            cyclic = path_exists(b, a)
+            adj[a].add(b)
+            if cyclic:
+                reported.add((a, b))
+                other = edges.get((b, a))
+                where = (f"; reverse order at line {other[0]} "
+                         f"in {other[1]}" if other else "")
+                yield _diag(
+                    "ccy-lock-order-cycle", Severity.ERROR,
+                    f"lock order {a} -> {b} (in {method}) completes a "
+                    f"cycle{where} — deadlock potential",
+                    module, line, var=f"{a}->{b}", method=method)
+
+
+@register_rule(
+    "ccy-blocking-under-lock", Severity.WARNING,
+    "blocking call (socket recv/accept, subprocess wait, sleep, RPC "
+    "dispatch) while holding a lock", category="concurrency")
+def rule_blocking_under_lock(ctx) -> Iterable[Diagnostic]:
+    if not isinstance(ctx, ConcurrencyContext):
+        return
+    for module in ctx.modules:
+        methods = list(module.functions)
+        for cm in module.classes.values():
+            methods.extend(cm.methods.values())
+        for m in methods:
+            for call, line, held, expr in m.blocking:
+                yield _diag(
+                    "ccy-blocking-under-lock", Severity.WARNING,
+                    f"blocking call {call}() while holding "
+                    f"{', '.join(held)} (taken as {expr}) "
+                    f"[in {m.qualname}]",
+                    module, line, var=held[-1], method=m.qualname,
+                    call=call, locks=list(held))
+
+
+@register_rule(
+    "ccy-callback-under-lock", Severity.WARNING,
+    "user-registered callback invoked while the registry's lock is "
+    "held — a callback that re-enters the registry deadlocks",
+    category="concurrency")
+def rule_callback_under_lock(ctx) -> Iterable[Diagnostic]:
+    if not isinstance(ctx, ConcurrencyContext):
+        return
+    for module in ctx.modules:
+        methods = list(module.functions)
+        for cm in module.classes.values():
+            methods.extend(cm.methods.values())
+        for m in methods:
+            for descr, line, held in m.callbacks:
+                yield _diag(
+                    "ccy-callback-under-lock", Severity.WARNING,
+                    f"callback {descr} invoked while holding "
+                    f"{', '.join(held)} [in {m.qualname}] — copy the "
+                    "registry under the lock, call outside it",
+                    module, line, var=held[-1], method=m.qualname,
+                    locks=list(held))
+
+
+@register_rule(
+    "ccy-suppression-missing-justification", Severity.ERROR,
+    "a __lint_suppress__ comment without the mandatory '-- why' "
+    "justification tail", category="concurrency")
+def rule_suppression_justified(ctx) -> Iterable[Diagnostic]:
+    if not isinstance(ctx, ConcurrencyContext):
+        return
+    for module in ctx.modules:
+        for sup in module.bad_suppressions:
+            yield _diag(
+                "ccy-suppression-missing-justification", Severity.ERROR,
+                f"suppression of {sorted(sup.rules)} carries no "
+                "justification — append '-- <why this is safe>'",
+                module, sup.line, var=",".join(sorted(sup.rules)))
+
+
+_CONCURRENCY_RULES = (
+    rule_unlocked_shared_write,
+    rule_lock_order_cycle,
+    rule_blocking_under_lock,
+    rule_callback_under_lock,
+    rule_suppression_justified,
+)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def default_scan_paths(root: Optional[str] = None) -> List[str]:
+    """Every .py file of the default packages under the paddle_tpu
+    source root (serving/, distributed/, data/, observability/)."""
+    if root is None:
+        root = os.path.dirname(os.path.abspath(__file__))  # analysis/
+        root = os.path.dirname(root)                       # paddle_tpu/
+    out: List[str] = []
+    for pkg in DEFAULT_PACKAGES:
+        d = os.path.join(root, pkg)
+        if not os.path.isdir(d):
+            continue
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".py"):
+                out.append(os.path.join(d, fn))
+    return out
+
+
+def _suppressed(module: ModuleModel, d: Diagnostic) -> bool:
+    line = d.details.get("line")
+    if line is None:
+        return False
+    for at in (line, line - 1):
+        sup = module.suppressions.get(at)
+        if sup is None or not sup.justification:
+            continue
+        if d.rule in sup.rules or "*" in sup.rules:
+            return True
+    return False
+
+
+def run_concurrency_lint(paths: Optional[Sequence[str]] = None,
+                         root: Optional[str] = None,
+                         include_suppressed: bool = False
+                         ) -> List[Diagnostic]:
+    """Scan `paths` (default: the serving/distributed/data/observability
+    packages) and return the surviving diagnostics, errors first. Each
+    diagnostic carries ``details={'file', 'line', 'function'}``
+    provenance; justified ``__lint_suppress__`` comments drop their
+    findings (``include_suppressed=True`` keeps them, for baseline
+    audits)."""
+    if paths is None:
+        paths = default_scan_paths(root)
+    cwd = os.getcwd()
+    modules: List[ModuleModel] = []
+    for p in paths:
+        disp = os.path.relpath(p, cwd) if os.path.isabs(p) else p
+        if disp.startswith(".."):
+            disp = p
+        m = scan_file(p, display_path=disp)
+        if m is not None:
+            modules.append(m)
+    ctx = ConcurrencyContext(modules)
+    by_path = {m.path: m for m in modules}
+
+    t0 = time.perf_counter()
+    diags: List[Diagnostic] = []
+    for rule in _CONCURRENCY_RULES:
+        for d in rule(ctx):
+            module = by_path.get(d.details.get("file", ""))
+            if include_suppressed or module is None \
+                    or not _suppressed(module, d):
+                diags.append(d)
+    diags.sort(key=lambda d: (-int(d.severity),
+                              d.details.get("file", ""),
+                              d.details.get("line", 0), d.rule))
+    from paddle_tpu.analysis.rules import _publish_metrics
+    _publish_metrics(diags, time.perf_counter() - t0)
+    return diags

@@ -181,8 +181,11 @@ class AnalysisContext:
 def _ensure_builtin_rules():
     # rule modules self-register on import (same pattern as ops/__init__
     # registering emitters); imported lazily to avoid a cycle with
-    # core.shape_inference
-    from paddle_tpu.analysis import dataflow, shapes, structural  # noqa: F401
+    # core.shape_inference. The concurrency + contracts rules live in
+    # the same catalog (--list-rules, docs) but run over their own
+    # contexts (ConcurrencyContext / FamilyContext) and no-op here.
+    from paddle_tpu.analysis import (  # noqa: F401
+        concurrency, contracts, dataflow, shapes, structural)
 
 
 def _op_suppressions(op: ir.OpDesc) -> frozenset:

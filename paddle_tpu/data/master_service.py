@@ -48,6 +48,7 @@ import time
 import uuid
 from typing import Optional, Tuple
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.data.master import Master, Task, verify_snapshot
 from paddle_tpu.distributed.resilience import RetryError, RetryPolicy
 from paddle_tpu.observability import metrics as _metrics
@@ -338,7 +339,8 @@ class MasterServer:
         self._reaper: Optional[threading.Thread] = None
         if heartbeat_timeout_s is not None:
             self._server.workers = {}  # type: ignore[attr-defined]
-            self._server.workers_lock = threading.Lock()  # type: ignore
+            self._server.workers_lock = lock_witness.make_lock(  # type: ignore
+                "MasterServer.workers_lock")
             self._reap_interval = (reap_interval_s
                                    if reap_interval_s is not None
                                    else heartbeat_timeout_s / 4.0)
@@ -483,7 +485,7 @@ class MasterClient:
         self._hb_retry: Optional[RetryPolicy] = None
         self._sock: Optional[socket.socket] = None
         self._rfile = None
-        self._lock = threading.Lock()
+        self._lock = lock_witness.make_lock("MasterClient._lock")
         self._last_done = False   # done flag from the last get_task reply
         self._polled = False
 

@@ -44,6 +44,7 @@ import numpy as np
 from paddle_tpu.fluid import framework
 
 from paddle_tpu.fluid.transpiler import GRAD_SUFFIX
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability import trace_context as tctx
 
@@ -83,7 +84,7 @@ class AsyncPServer:
         self.exe = Executor(CPUPlace())
         self.exe.run(startup_program, scope=self.scope)
         self.program = pserver_program
-        self._lock = threading.Lock()
+        self._lock = lock_witness.make_lock("AsyncParameterServer._lock")
         self._grad_progs: Dict[str, framework.Program] = {}
         self._listener = None
         self._threads: List[threading.Thread] = []

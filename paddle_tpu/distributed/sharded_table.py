@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability import trace_context as tctx
 
@@ -211,7 +212,7 @@ class TableShardServer:
         self.shard_id = int(shard_id)
         self._tables: Dict[str, Dict[str, np.ndarray]] = {}
         self._rows_of: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lock_witness.make_lock("TableShardServer._lock")
         self._listener = None
         self._stopping = threading.Event()
         self._threads: List[threading.Thread] = []

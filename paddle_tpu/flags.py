@@ -233,6 +233,15 @@ define("kv_cache_codec", str, "none",
        "scale per (position, head) row — the per-row-scale discipline "
        "of FLAGS_embed_exchange_codec applied at rest. Quantize on "
        "page write, dequantize in the attention gather.")
+define("lock_witness", bool, False,
+       "Runtime lock-order witness (observability/lock_witness.py): "
+       "ObservedLock records per-thread acquisition order and validates "
+       "the global lock DAG online. A held->acquiring edge that closes "
+       "a cycle is a witnessed inversion: it increments "
+       "paddle_lock_witness_violations_total and dumps BOTH stacks "
+       "(the inverted acquisition and the first-witnessed forward "
+       "order) through the flight recorder. Off by default; the chaos "
+       "suites run with it on and assert zero violations.")
 
 
 def _main():

@@ -252,54 +252,25 @@ def decoder_lm(mode: str, prompt_len: int = 16, max_new: int = 16,
     [n_slots, K+1] window — one fixed-shape executable per (n_slots,
     spec_k), sampling all K+1 window positions on-device so the host's
     accept rule is a pure comparison."""
-    _MODES = ("full", "prefill", "decode", "prefill_slot", "decode_slot",
-              "prefill_paged", "decode_paged", "decode_verify",
-              "decode_verify_paged")
-    if mode not in _MODES:
-        raise ValueError(f"decoder_lm mode {mode!r} not in {_MODES}")
-    if (mode.endswith("_slot") or mode.endswith("_paged")
-            or mode.startswith("decode_verify")) and not n_slots:
-        raise ValueError(f"mode {mode!r} needs n_slots")
-    cache_len = int(cache_len) if cache_len else prompt_len + max_new
-    if prompt_len > cache_len:
-        raise ValueError(f"prompt_len {prompt_len} > cache_len "
-                         f"{cache_len}")
-    if mode.startswith("decode_verify"):
-        # verify-window geometry validation: K >= 1 (K = 0 is plain
-        # decode — use decode_slot/decode_paged), and the K+1 window
-        # must fit the cache (a window can never be larger than the
-        # whole generated region it could commit into)
-        spec_k = int(spec_k) if spec_k else 4
-        if spec_k < 1:
-            raise ValueError(f"spec_k {spec_k} < 1 — the verify view "
-                             f"needs at least one drafted token")
-        if spec_k + 1 > cache_len - prompt_len + 1:
-            raise ValueError(
-                f"spec_k {spec_k}: the K+1={spec_k + 1} verify window "
-                f"exceeds the generated region "
-                f"(cache_len {cache_len} - prompt_len {prompt_len})")
-    if mode.endswith("_paged"):
-        from paddle_tpu import flags as _flags
-        page_size = int(page_size) if page_size else 4
-        if cache_len % page_size:
-            raise ValueError(f"page_size {page_size} must divide "
-                             f"cache_len {cache_len}")
-        max_pages = cache_len // page_size
-        n_pages = int(n_pages) if n_pages \
-            else int(n_slots) * max_pages
-        if n_pages < max_pages:
-            raise ValueError(f"n_pages {n_pages} < one slot's span "
-                             f"{max_pages} — no request could admit")
-        kv_codec = (kv_codec if kv_codec is not None
-                    else _flags.get("kv_cache_codec")) or "none"
-        if kv_codec not in ("none", "bf16", "int8"):
-            raise ValueError(f"kv_codec {kv_codec!r} not in "
-                             f"('none', 'bf16', 'int8')")
-        store_dt = {"none": "float32", "bf16": "bfloat16",
-                    "int8": "int8"}[kv_codec]
+    # all geometry validation + defaulting lives in ONE record shared
+    # with the cross-view family verifier (analysis/contracts.py) —
+    # the view consumes the normalized constants instead of re-deriving
+    from paddle_tpu.analysis.contracts import validate_geometry
+    geom = validate_geometry(mode, prompt_len, max_new,
+                             cache_len=cache_len, n_slots=n_slots,
+                             page_size=page_size, n_pages=n_pages,
+                             kv_codec=kv_codec, spec_k=spec_k)
+    cache_len = geom.cache_len
+    spec_k = geom.spec_k
+    page_size = geom.page_size
+    n_pages = geom.n_pages
+    max_pages = geom.max_pages
+    kv_codec = geom.kv_codec
+    store_dt = geom.store_dtype
     d_k = d_model // n_head
     main = fluid.default_main_program()
     startup = fluid.default_startup_program()
+    main._geometry = geom              # family verifier cross-checks this
     pe = _const_var(name + "_pos_enc",
                     position_encoding(cache_len, d_model))
 
@@ -661,6 +632,18 @@ def slot_modes(layout=None, spec=False):
         return modes + ("decode_verify_paged",) if spec else modes
     modes = ("prefill_slot", "decode_slot")
     return modes + ("decode_verify",) if spec else modes
+
+
+def contracts_lint_family():
+    """``proglint --contracts`` default target: the full decoder_lm
+    serving family (every mode, bucketed prefills, slot + paged + verify
+    views) at lint-sized dims — the cross-view contract verifier
+    (analysis/contracts.py) runs over what this returns."""
+    from paddle_tpu.analysis.contracts import DECODER_LM_MODES
+    return build_decoder_lm_programs(
+        prompt_len=8, max_new=8, vocab=32, d_model=16, d_inner=32,
+        n_head=2, n_layer=2, prompt_buckets=(4, 8), n_slots=4, spec_k=3,
+        modes=DECODER_LM_MODES)
 
 
 def serve_lint_prefill():

@@ -28,7 +28,12 @@ watchable:
   ``tools/trace_collect.py`` into one Perfetto trace;
 - :mod:`~paddle_tpu.observability.flight_recorder` — black-box ring of
   recent spans / metric deltas / fault fires, dumped on crash signals
-  (``FLAGS_flight_recorder_dir``).
+  (``FLAGS_flight_recorder_dir``);
+- :mod:`~paddle_tpu.observability.lock_witness` — runtime lock-order
+  witness (``FLAGS_lock_witness``): ``ObservedLock`` validates the
+  global lock DAG per acquisition, counting inversions and dumping
+  both offending stacks through the flight recorder — the dynamic twin
+  of the static ``ccy-lock-order-cycle`` lint.
 
 Everything is off by default; with no observability flag set the hot
 path pays one flag lookup per executor dispatch. Metric catalog and
@@ -44,6 +49,7 @@ from paddle_tpu.observability import runtime  # noqa: F401
 from paddle_tpu.observability import exporters  # noqa: F401
 from paddle_tpu.observability import spool  # noqa: F401
 from paddle_tpu.observability import flight_recorder  # noqa: F401
+from paddle_tpu.observability import lock_witness  # noqa: F401
 from paddle_tpu.observability import memory  # noqa: F401
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,

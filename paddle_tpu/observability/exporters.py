@@ -211,6 +211,7 @@ class MetricsServer:
                  registry: Optional[metrics.MetricsRegistry] = None):
         self._httpd = ThreadingHTTPServer((host, port), _ScrapeHandler)
         self._httpd.daemon_threads = True
+        # __lint_suppress__: ccy-unlocked-shared-write -- writes to the just-constructed HTTPServer before its serve thread starts (the lint matches .registry to MetricsDumper by attr name)
         self._httpd.registry = (registry  # type: ignore[attr-defined]
                                 or metrics.default_registry())
         self.host = host
@@ -276,6 +277,22 @@ def _preregister_catalog():
         # force them into the catalog so a scrape shows them at zero
         from paddle_tpu.analysis import rules as _analysis_rules
         _analysis_rules.declare_metrics()
+    except Exception:
+        pass
+    try:
+        # cross-view program-contract checks (paddle_analysis_contract_
+        # checks_total): each validate_geometry / verify_family run
+        # counts here — zero on a scrape means the verifier never ran
+        from paddle_tpu.analysis import contracts as _contracts
+        _contracts.declare_metrics()
+    except Exception:
+        pass
+    try:
+        # runtime lock-order witness (paddle_lock_witness_violations_
+        # total): the chaos suites assert this stays zero; a non-zero
+        # scrape in prod is a latent-deadlock page
+        from paddle_tpu.observability import lock_witness as _lock_witness
+        _lock_witness.declare_metrics()
     except Exception:
         pass
     try:

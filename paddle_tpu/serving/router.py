@@ -74,6 +74,7 @@ from typing import List, Optional
 from paddle_tpu.distributed.resilience import (CircuitBreaker,
                                                CircuitOpenError)
 from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability import lock_witness
 from paddle_tpu.observability import trace_context as tctx
 from paddle_tpu.serving import metrics as smetrics
 
@@ -106,7 +107,7 @@ class _Replica:
         self.gen = 0
         self.inflight = 0
         self.queue_depth = 0               # replica-reported (polled)
-        self.lock = threading.Lock()
+        self.lock = lock_witness.make_lock("_Replica.lock")
         self.restart_times: deque = deque(maxlen=16)
         self.restart_at = 0.0              # next supervised respawn time
         self.backoff_s = 0.0
@@ -172,10 +173,11 @@ class _Replica:
             raise
 
     def set_state(self, state: str):
-        prev = self.state
-        self.state = state
-        if state == READY and prev != READY:
-            self.ready_since = time.monotonic()
+        with self.lock:
+            prev = self.state
+            self.state = state
+            if state == READY and prev != READY:
+                self.ready_since = time.monotonic()
         smetrics.ROUTER_REPLICA_UP.labels(
             replica=str(self.index)).set(1.0 if state == READY else 0.0)
         for s in _STATES:
@@ -276,13 +278,14 @@ class Router:
             for i in range(n)]
         self._by_index = {r.index: r for r in self._replicas}
         self._next_index = n
-        self._pool_lock = threading.Lock()
+        self._pool_lock = lock_witness.make_lock("Router._pool_lock")
         self._sticky: "OrderedDict[str, int]" = OrderedDict()
         self._sticky_capacity = int(sticky_capacity)
-        self._sticky_lock = threading.Lock()
+        self._sticky_lock = lock_witness.make_lock("Router._sticky_lock")
         self._running = False
         self._monitor_thread: Optional[threading.Thread] = None
-        self._restart_lock = threading.Lock()
+        self._restart_lock = lock_witness.make_lock(
+            "Router._restart_lock")
         self._rpc: Optional["_RouterRpcServer"] = None
         self._rpc_thread = None
 
@@ -293,6 +296,7 @@ class Router:
         :meth:`wait_ready`."""
         if self._running:
             return self
+        # __lint_suppress__: ccy-unlocked-shared-write -- start/stop run on the control thread; the monitor loop only READS this bool and tolerates one stale poll tick
         self._running = True
         if self._supervised:
             if self._workdir is None:
@@ -415,13 +419,15 @@ class Router:
                     flight_recorder.note("replica_oom", replica=r.index,
                                          code=code, memdump=memdump)
                     fb = self._oom_fallback
-                    if fb is not None:
-                        base = (r.spec if r.spec is not None
-                                else self._spec)
-                        r.spec = fb(base) if callable(fb) else dict(fb)
-                    r.oom_replaced = True
-                    r.restart_times.clear()  # not crash-loop evidence
-                    r.backoff_s = 0.0
+                    with r.lock:
+                        if fb is not None:
+                            base = (r.spec if r.spec is not None
+                                    else self._spec)
+                            r.spec = (fb(base) if callable(fb)
+                                      else dict(fb))
+                        r.oom_replaced = True
+                        r.restart_times.clear()  # not crash-loop evidence
+                        r.backoff_s = 0.0
                     self._sticky_clear_replica(r.index)
                     smetrics.ROUTER_RESTARTS.labels(cause="oom").inc()
                     self._spawn(r)
@@ -439,17 +445,19 @@ class Router:
                           if now - t <= self._crash_window]
                 if len(recent) >= self._crash_limit:
                     r.set_state(FAILED)
-                    r.failed_at = now
-                    r.quarantines += 1
+                    with r.lock:
+                        r.failed_at = now
+                        r.quarantines += 1
                     flight_recorder.note("replica_crash_loop",
                                          replica=r.index,
                                          restarts=len(recent),
                                          quarantines=r.quarantines)
                     return
-                r.backoff_s = min(self._backoff_max,
-                                  max(self._backoff_base,
-                                      r.backoff_s * 2.0))
-                r.restart_at = now + r.backoff_s
+                with r.lock:
+                    r.backoff_s = min(self._backoff_max,
+                                      max(self._backoff_base,
+                                          r.backoff_s * 2.0))
+                    r.restart_at = now + r.backoff_s
                 return
             if r.state == FAILED:
                 # quarantine is a COOLDOWN, not a verdict: after a
@@ -462,8 +470,9 @@ class Router:
                         self._quarantine_backoff_max,
                         2.0 ** max(0, r.quarantines - 1))
                     if now - r.failed_at >= wait:
-                        r.restart_times.clear()
-                        r.backoff_s = 0.0
+                        with r.lock:
+                            r.restart_times.clear()
+                            r.backoff_s = 0.0
                         smetrics.ROUTER_RESTARTS.labels(
                             cause="quarantine_retry").inc()
                         flight_recorder.note("replica_quarantine_retry",
@@ -488,7 +497,8 @@ class Router:
                 if r.endpoint:
                     resp = self._probe(r)
                     if resp and resp.get("ready"):
-                        r.backoff_s = 0.0
+                        with r.lock:
+                            r.backoff_s = 0.0
                         r.breaker.record_success()
                         r.set_state(READY)
                         flight_recorder.note("replica_ready",
@@ -521,9 +531,10 @@ class Router:
         if now - r.ready_since < self._healthy_reset:
             return
         if r.restart_times or r.quarantines or r.backoff_s:
-            r.restart_times.clear()
-            r.backoff_s = 0.0
-            r.quarantines = 0
+            with r.lock:
+                r.restart_times.clear()
+                r.backoff_s = 0.0
+                r.quarantines = 0
             flight_recorder.note("replica_healthy_reset",
                                  replica=r.index)
 
@@ -654,13 +665,15 @@ class Router:
                     time.sleep(0.02)
                     continue
                 try:
-                    r.inflight += 1
+                    with r.lock:
+                        r.inflight += 1
                     try:
                         resp = r.breaker.call(
                             lambda: r.exchange(payload,
                                                self._request_timeout))
                     finally:
-                        r.inflight -= 1
+                        with r.lock:
+                            r.inflight -= 1
                 except CircuitOpenError as e:
                     last_err = repr(e)
                     self._failover(req_id, r, "breaker_open")
@@ -732,6 +745,7 @@ class Router:
             drained = False
             duration = 0.0
             try:
+                # __lint_suppress__: ccy-blocking-under-lock -- _restart_lock exists to serialize whole drain+respawn sequences; it is never taken on the request path
                 resp = r.exchange({"method": "drain",
                                    "timeout_s": self._drain_timeout,
                                    "exit": True},
@@ -748,9 +762,11 @@ class Router:
                 else time.monotonic() - t0)
             if r.proc is not None:
                 try:
+                    # __lint_suppress__: ccy-blocking-under-lock -- bounded-by-grace wait inside the serialized restart sequence, off the request path
                     r.proc.wait(timeout=self._grace)
                 except subprocess.TimeoutExpired:
                     r.proc.kill()
+                    # __lint_suppress__: ccy-blocking-under-lock -- post-kill reap, bounded by grace; restart sequence is serialized by design
                     r.proc.wait(timeout=self._grace)
             self._sticky_clear_replica(index)
             with r.lock:
@@ -774,6 +790,7 @@ class Router:
                                 time.monotonic() - t0, 3)}
                 if r.state == FAILED:
                     break
+                # __lint_suppress__: ccy-blocking-under-lock -- readiness poll of the restart sequence itself; holding _restart_lock here IS the serialization contract
                 time.sleep(0.05)
             return {"ok": False, "kind": "error", "replica": index,
                     "error": f"replica {index} did not pass readyz "
@@ -880,6 +897,7 @@ class Router:
             drained = False
             duration = 0.0
             try:
+                # __lint_suppress__: ccy-blocking-under-lock -- scale_down shares _restart_lock with restart_replica to serialize topology changes; never on the request path
                 resp = victim.exchange(
                     {"method": "drain",
                      "timeout_s": self._drain_timeout,
@@ -895,10 +913,12 @@ class Router:
                 duration if duration > 0 else time.monotonic() - t0)
             if self._supervised and victim.proc is not None:
                 try:
+                    # __lint_suppress__: ccy-blocking-under-lock -- bounded-by-grace reap inside the serialized scale-down sequence
                     victim.proc.wait(timeout=self._grace)
                 except subprocess.TimeoutExpired:
                     victim.proc.kill()
                     try:
+                        # __lint_suppress__: ccy-blocking-under-lock -- post-kill reap, bounded by grace; topology changes are serialized by design
                         victim.proc.wait(timeout=self._grace)
                     except subprocess.TimeoutExpired:
                         pass
@@ -964,6 +984,7 @@ class Router:
         return f"{host}:{port}"
 
     def stop(self, terminate_replicas: bool = True):
+        # __lint_suppress__: ccy-unlocked-shared-write -- shutdown flag flip; the monitor loop reads it unlocked and exits within one poll tick
         self._running = False
         if self._rpc is not None:
             self._rpc.shutdown()

@@ -401,8 +401,8 @@ class _HostedModel:
             return not self.queue and not self.inflight
 
     def stop(self):
-        self.running = False
         with self.cond:
+            self.running = False
             self.cond.notify_all()
         self.thread.join(timeout=5)
 

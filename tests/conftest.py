@@ -31,6 +31,33 @@ def fresh_programs():
 
 
 @pytest.fixture(autouse=True)
+def lock_witness_on_chaos(request):
+    """Chaos-marked tests run with the runtime lock-order witness armed
+    (FLAGS_lock_witness): every ObservedLock acquisition is checked
+    against the global lock DAG, and ANY inversion observed during the
+    test fails it with both stacks. Complements the static concurrency
+    lint — the lint proves order on paths it can see, the witness
+    proves it on the paths chaos actually exercised."""
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    from paddle_tpu import flags
+    from paddle_tpu.observability import lock_witness
+    lock_witness.reset()
+    old = flags.get("lock_witness")
+    flags.set("lock_witness", True)
+    try:
+        yield
+    finally:
+        flags.set("lock_witness", old)
+    bad = lock_witness.violations()
+    assert not bad, (
+        "lock-order witness observed inversions during a chaos test:\n"
+        + "\n".join(f"{v['held']} -> {v['acquiring']} on {v['thread']}"
+                    for v in bad))
+
+
+@pytest.fixture(autouse=True)
 def no_leaked_faults():
     """A chaos test that dies mid-plan must not leave armed fault sites
     behind for the rest of the suite. Zero-cost unless the registry

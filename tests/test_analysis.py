@@ -651,3 +651,87 @@ def test_proglint_list_rules(capsys):
     for rid in ("dangling-input", "shape-mismatch", "dead-op",
                 "waw-param", "rng-in-inference", "unknown-op"):
         assert rid in out
+
+
+# -- cross-view program contracts (analysis/contracts.py) --------------------
+
+def _decoder_family(modes):
+    from paddle_tpu.models import transformer
+    return transformer.build_decoder_lm_programs(
+        prompt_len=8, max_new=8, vocab=32, d_model=16, d_inner=32,
+        n_head=2, n_layer=2, prompt_buckets=(4, 8), n_slots=4, spec_k=3,
+        modes=modes)
+
+
+def test_contracts_full_family_green():
+    """The contract the CI gate (proglint --contracts) enforces: the
+    whole decoder_lm family — wave, slot, paged and verify views over
+    every prompt bucket — passes every cross-view rule."""
+    from paddle_tpu.models import transformer
+    fam = transformer.contracts_lint_family()
+    assert len(fam) == 15
+    diags = analysis.verify_family(fam)
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_contract_view_var_drift():
+    fam = _decoder_family(("prefill", "decode"))
+    fam["decode"][0].desc.global_block.vars["lm_emb"].shape = [33, 16]
+    diags = analysis.verify_family(fam)
+    assert [(d.rule, d.var) for d in diags] == \
+        [("ctr-view-var-drift", "lm_emb")]
+    assert diags[0].severity == Severity.ERROR
+    assert "drifts across views" in diags[0].message
+
+
+def test_contract_salt_misalignment():
+    fam = _decoder_family(("prefill", "decode"))
+    # shift every rng initializer of ONE view by one startup op index —
+    # per-index salting means the views would initialize different
+    # weights for the "shared" parameters
+    ops = fam["decode"][1].desc.global_block.ops
+    ops.insert(0, ops.pop())
+    diags = analysis.verify_family(fam)
+    assert diags and {d.rule for d in diags} == {"ctr-salt-misalignment"}
+    assert any(d.var == "lm_emb" for d in diags)
+
+
+def test_contract_stale_donation_read():
+    fam = _decoder_family(("prefill", "decode"))
+    # prefill demotes a KV cache that the decode view mutates in place:
+    # prefill would then read a local temp, never the donated buffer
+    fam["prefill"][0].desc.global_block.vars[
+        "lm_cache_k_0"].persistable = False
+    diags = analysis.verify_family(fam)
+    assert [d.rule for d in diags] == ["ctr-stale-donation-read"]
+    d = diags[0]
+    assert d.var == "lm_cache_k_0"
+    assert d.details["as"] == "a non-persistable temp"
+    assert d.details["offending_view"].startswith("prefill")
+
+
+def test_contract_geometry_drift():
+    import dataclasses
+    fam = _decoder_family(("prefill", "decode"))
+    m = fam["decode"][0]
+    m._geometry = dataclasses.replace(m._geometry, cache_len=32)
+    diags = analysis.verify_family(fam)
+    assert [(d.rule, d.var) for d in diags] == \
+        [("ctr-geometry-drift", "cache_len")]
+
+
+def test_validate_geometry_record():
+    from paddle_tpu.analysis.contracts import validate_geometry
+    g = validate_geometry("decode_verify_paged", 8, 8, n_slots=4,
+                          spec_k=3)
+    assert (g.cache_len, g.window, g.page_size) == (16, 4, 4)
+    assert g.max_pages == 4 and g.n_pages == 4 * g.max_pages
+    assert g.store_dtype == "float32"          # FLAGS default codec
+    with pytest.raises(ValueError, match="needs n_slots"):
+        validate_geometry("decode_slot", 8, 8)
+    with pytest.raises(ValueError, match="must divide"):
+        validate_geometry("prefill_paged", 8, 8, n_slots=4, page_size=3)
+    with pytest.raises(ValueError, match="verify window"):
+        validate_geometry("decode_verify", 8, 8, n_slots=4, spec_k=16)
+    with pytest.raises(ValueError, match="not in"):
+        validate_geometry("nope", 8, 8)

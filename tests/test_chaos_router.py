@@ -42,6 +42,9 @@ def _env_base():
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PADDLE_", "FLAGS_"))}
     env["JAX_PLATFORMS"] = "cpu"
+    # arm the runtime lock-order witness in every chaos subprocess: the
+    # router/replica ObservedLocks must show zero inversions under churn
+    env["FLAGS_lock_witness"] = "1"
     return env
 
 

@@ -1,0 +1,306 @@
+"""Concurrency lint (analysis/concurrency.py) + runtime lock-order
+witness (observability/lock_witness.py).
+
+The known-bad corpus below seeds one defect per file — an unlocked
+shared read-modify-write, a lock-order inversion, a blocking call under
+a lock, a callback dispatched under its registry lock — and asserts the
+lint names each with the right rule id AND file/line provenance. The
+suppression tests pin the ``__lint_suppress__`` policy (justification
+mandatory). The witness tests prove the dynamic twin fires on a real
+inversion with both stacks, lands the event in the flight-recorder
+dump, and stays silent when the flag is off.
+
+The zero-baseline test is the contract the CI gate
+(``tools/test_runner.py`` / ``proglint --concurrency``) enforces: the
+real tree must have NO unsuppressed findings.
+"""
+
+import json
+
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.analysis.concurrency import (default_scan_paths,
+                                             run_concurrency_lint)
+from paddle_tpu.observability import lock_witness
+
+
+# ---------------------------------------------------------------------------
+# known-bad corpus
+# ---------------------------------------------------------------------------
+
+CORPUS_RMW = '''\
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.hits = 0
+        self.thread = None
+
+    def start(self):
+        self.thread = threading.Thread(target=self._loop)
+        self.thread.start()
+
+    def _loop(self):
+        with self.lock:
+            self.hits += 1
+
+    def bump(self):
+        self.hits += 1
+'''
+CORPUS_RMW_BAD_LINE = 19        # the unlocked `self.hits += 1` in bump()
+
+CORPUS_CYCLE = '''\
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+    def forward(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def backward(self):
+        with self.b:
+            with self.a:
+                pass
+'''
+
+CORPUS_BLOCKING = '''\
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def poll(self):
+        with self.lock:
+            time.sleep(0.5)
+'''
+
+CORPUS_CALLBACK = '''\
+import threading
+
+
+class Bus:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sinks = []
+
+    def subscribe(self, fn):
+        with self.lock:
+            self.sinks.append(fn)
+
+    def publish(self, event):
+        with self.lock:
+            for s in self.sinks:
+                s(event)
+'''
+
+
+def _lint(tmp_path, name, source, **kw):
+    p = tmp_path / name
+    p.write_text(source)
+    return run_concurrency_lint(paths=[str(p)], **kw)
+
+
+def test_corpus_unlocked_shared_write(tmp_path):
+    diags = _lint(tmp_path, "corpus_rmw.py", CORPUS_RMW)
+    hits = [d for d in diags if d.rule == "ccy-unlocked-shared-write"]
+    assert len(hits) == 1, diags
+    d = hits[0]
+    assert d.details["file"].endswith("corpus_rmw.py")
+    assert d.details["line"] == CORPUS_RMW_BAD_LINE
+    assert d.details["function"] == "Stats.bump"
+    assert d.var == "Stats.hits"
+    assert str(d.severity) == "error"
+    # the locked RMW in the thread loop is NOT flagged
+    assert all(x.details["line"] != 16 for x in hits)
+
+
+def test_corpus_lock_order_cycle(tmp_path):
+    diags = _lint(tmp_path, "corpus_cycle.py", CORPUS_CYCLE)
+    cyc = [d for d in diags if d.rule == "ccy-lock-order-cycle"]
+    assert len(cyc) == 1, diags
+    d = cyc[0]
+    assert d.details["file"].endswith("corpus_cycle.py")
+    assert {"Pair.a", "Pair.b"} == set(d.var.split("->"))
+    assert "reverse order" in d.message
+
+
+def test_corpus_blocking_under_lock(tmp_path):
+    diags = _lint(tmp_path, "corpus_blocking.py", CORPUS_BLOCKING)
+    blk = [d for d in diags if d.rule == "ccy-blocking-under-lock"]
+    assert len(blk) == 1, diags
+    d = blk[0]
+    assert d.details["line"] == 11
+    assert d.details["call"] == "time.sleep"
+    assert d.details["locks"] == ["Poller.lock"]
+    assert str(d.severity) == "warning"
+
+
+def test_corpus_callback_under_lock(tmp_path):
+    diags = _lint(tmp_path, "corpus_callback.py", CORPUS_CALLBACK)
+    cb = [d for d in diags if d.rule == "ccy-callback-under-lock"]
+    assert len(cb) == 1, diags
+    d = cb[0]
+    assert d.details["line"] == 16
+    assert d.details["function"] == "Bus.publish"
+    assert "self.sinks" in d.message
+
+
+# ---------------------------------------------------------------------------
+# suppression policy
+# ---------------------------------------------------------------------------
+
+def test_justified_suppression_drops_finding(tmp_path):
+    src = CORPUS_RMW.replace(
+        "    def bump(self):\n        self.hits += 1",
+        "    def bump(self):\n"
+        "        # __lint_suppress__: ccy-unlocked-shared-write -- "
+        "corpus: single writer by construction\n"
+        "        self.hits += 1")
+    diags = _lint(tmp_path, "corpus_ok.py", src)
+    assert diags == [], diags
+    # include_suppressed keeps it (baseline audits)
+    diags = _lint(tmp_path, "corpus_ok.py", src, include_suppressed=True)
+    assert [d.rule for d in diags] == ["ccy-unlocked-shared-write"]
+
+
+def test_unjustified_suppression_is_itself_a_finding(tmp_path):
+    src = CORPUS_RMW.replace(
+        "    def bump(self):\n        self.hits += 1",
+        "    def bump(self):\n"
+        "        # __lint_suppress__: ccy-unlocked-shared-write\n"
+        "        self.hits += 1")
+    diags = _lint(tmp_path, "corpus_bad_sup.py", src)
+    rules = sorted(d.rule for d in diags)
+    # the original finding survives AND the bare suppression is flagged
+    assert rules == ["ccy-suppression-missing-justification",
+                     "ccy-unlocked-shared-write"], diags
+
+
+def test_suppression_only_covers_named_rules(tmp_path):
+    src = CORPUS_BLOCKING.replace(
+        "            time.sleep(0.5)",
+        "            # __lint_suppress__: ccy-unlocked-shared-write -- "
+        "wrong rule named\n"
+        "            time.sleep(0.5)")
+    diags = _lint(tmp_path, "corpus_wrong_rule.py", src)
+    assert [d.rule for d in diags] == ["ccy-blocking-under-lock"]
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+# ---------------------------------------------------------------------------
+
+def test_real_tree_zero_unsuppressed_findings():
+    """THE baseline the CI lint gate enforces: serving/, distributed/,
+    data/ and observability/ carry zero unsuppressed findings — a new
+    race gets fixed or suppressed WITH a justification, never ignored."""
+    paths = default_scan_paths()
+    assert paths, "scan surface vanished"
+    diags = run_concurrency_lint(paths=paths)
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness():
+    lock_witness.reset()
+    flags.set("lock_witness", True)
+    try:
+        yield lock_witness
+    finally:
+        flags.reset("lock_witness")
+        lock_witness.reset()
+
+
+def test_witness_fires_on_inversion_with_both_stacks(witness):
+    a = lock_witness.make_lock("W.a")
+    b = lock_witness.make_lock("W.b")
+    with a:
+        with b:
+            pass
+    assert lock_witness.violations() == []
+    before = lock_witness.declare_metrics().value
+    with b:
+        with a:              # W.b -> W.a closes the cycle
+            pass
+    bad = lock_witness.violations()
+    assert len(bad) == 1, bad
+    v = bad[0]
+    assert v["held"] == "W.b" and v["acquiring"] == "W.a"
+    # both stacks present: the acquisition happening now AND the stack
+    # that established the forward order
+    assert "test_witness_fires_on_inversion" in v["stack_now"]
+    assert "test_witness_fires_on_inversion" in v["prior_stack"]
+    assert v["thread"] and v["prior_thread"]
+    assert lock_witness.declare_metrics().value == before + 1
+
+
+def test_witness_off_records_nothing():
+    lock_witness.reset()
+    assert not flags.get("lock_witness")
+    a = lock_witness.make_lock("Off.a")
+    b = lock_witness.make_lock("Off.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert lock_witness.edges() == {}
+    assert lock_witness.violations() == []
+
+
+def test_witness_same_name_is_reentrant_not_inversion(witness):
+    """Two instances of the same lock SITE share a name (_Replica.lock
+    on replica #1 vs #2) — nesting them is not an inversion."""
+    l1 = lock_witness.make_lock("_Replica.lock")
+    l2 = lock_witness.make_lock("_Replica.lock")
+    with l1:
+        with l2:
+            pass
+    with l2:
+        with l1:
+            pass
+    assert lock_witness.violations() == []
+
+
+def test_witness_dumps_flight_recorder(witness, tmp_path):
+    from paddle_tpu.observability import flight_recorder
+    rec = flight_recorder.ensure_started(directory=str(tmp_path),
+                                         role="witness_test")
+    try:
+        a = lock_witness.make_lock("FR.a")
+        b = lock_witness.make_lock("FR.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(lock_witness.violations()) == 1
+        doc = json.loads(open(rec.dump_path).read())
+        assert doc["reason"] == "lock_witness"
+        notes = [e for e in doc["events"]
+                 if e.get("kind") == "note"
+                 and e.get("what") == "lock_witness_violation"]
+        assert len(notes) == 1
+        n = notes[0]
+        assert n["held"] == "FR.b" and n["acquiring"] == "FR.a"
+        assert n["stack_now"] and n["prior_stack"]
+    finally:
+        flight_recorder.shutdown()

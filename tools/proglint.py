@@ -246,6 +246,33 @@ def main(argv=None):
                          "FLAGS_hbm_bytes is set and the budget ladder "
                          "leaves a must-shard var silently replicated "
                          "(docs/performance.md, SPMD execution)")
+    ap.add_argument("--all", action="store_true",
+                    help="auto-discover every serve_lint_* entry of "
+                         "paddle_tpu.models.transformer and lint them "
+                         "as --module targets (the serving-program "
+                         "sweep tools/test_runner.py gates on — a new "
+                         "view only needs a serve_lint_ function, not "
+                         "a hand-list edit)")
+    ap.add_argument("--contracts", nargs="?", metavar="pkg.mod:fn",
+                    const="paddle_tpu.models.transformer:"
+                          "contracts_lint_family", default=None,
+                    help="cross-view program-contract verifier "
+                         "(analysis/contracts.py): call fn() -> "
+                         "{key: (main, startup, feed_specs, fetch)} "
+                         "and FAIL on shared-persistable drift, rng-"
+                         "salt misalignment, stale donation reads or "
+                         "geometry-record drift between the views. "
+                         "Default family: the full decoder_lm serving "
+                         "family")
+    ap.add_argument("--concurrency", nargs="?", metavar="PATHS",
+                    const="", default=None,
+                    help="AST concurrency lint (analysis/concurrency."
+                         "py) over the given comma-separated files, or "
+                         "the whole serving/distributed/data/"
+                         "observability tree with no value: unlocked "
+                         "shared writes, lock-order cycles, blocking "
+                         "calls and callback dispatch under a lock. "
+                         "FAILs on any unsuppressed error")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too")
     ap.add_argument("--json", action="store_true",
@@ -261,6 +288,12 @@ def main(argv=None):
                   f"{spec.help}")
         return 0
 
+    if args.all:
+        import paddle_tpu.models.transformer as _tf
+        args.module.extend(
+            f"paddle_tpu.models.transformer:{fn}"
+            for fn in sorted(dir(_tf)) if fn.startswith("serve_lint_"))
+
     targets = []
     for p in args.path:
         name, desc, feeds, fetches = _load_saved(p)
@@ -269,9 +302,10 @@ def main(argv=None):
         targets.extend(_build_zoo_model(m))
     for m in args.module:
         targets.extend(_build_module(m))
-    if not targets:
+    if not targets and args.contracts is None \
+            and args.concurrency is None:
         ap.error("nothing to lint: give a saved-model path, --model, "
-                 "or --module")
+                 "--module, --all, --contracts or --concurrency")
 
     n_err = n_warn = 0
     for name, program, feeds, fetches in targets:
@@ -383,7 +417,42 @@ def main(argv=None):
                 print(f"    {v}")
             n_shard += len(bad)
 
-    if n_err or n_mem or n_shard or (args.strict and n_warn):
+    n_ctr = 0
+    if args.contracts is not None:
+        modname, _, fn_name = args.contracts.partition(":")
+        fam_fn = getattr(importlib.import_module(modname),
+                         fn_name or "contracts_lint_family")
+        family = fam_fn()
+        diags = analysis.verify_family(family)
+        errs, warns, _infos = analysis.partition(diags)
+        n_ctr += len(errs)
+        n_warn += len(warns)
+        status = "FAIL" if errs else "warn" if warns else "ok"
+        print(f"[{status}] {args.contracts}: contract verifier — "
+              f"{len(family)} view(s), {len(errs)} error(s), "
+              f"{len(warns)} warning(s)")
+        for d in diags:
+            print("    " + (json.dumps(d.to_dict(), sort_keys=True)
+                            if args.json else d.format()))
+
+    n_ccy = 0
+    if args.concurrency is not None:
+        paths = _split(args.concurrency) or None
+        diags = analysis.run_concurrency_lint(paths=paths)
+        errs, warns, _infos = analysis.partition(diags)
+        n_ccy += len(errs)
+        n_warn += len(warns)
+        status = "FAIL" if errs else "warn" if warns else "ok"
+        scope = paths or "serving/distributed/data/observability"
+        print(f"[{status}] concurrency lint over {scope}: "
+              f"{len(errs)} error(s), {len(warns)} warning(s) "
+              f"unsuppressed")
+        for d in diags:
+            print("    " + (json.dumps(d.to_dict(), sort_keys=True)
+                            if args.json else d.format()))
+
+    if n_err or n_mem or n_shard or n_ctr or n_ccy \
+            or (args.strict and n_warn):
         return 1
     return 0
 
